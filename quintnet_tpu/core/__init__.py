@@ -1,0 +1,14 @@
+"""Core runtime: config, mesh, collectives, pytree utilities."""
+
+from quintnet_tpu.core.config import Config, load_config
+from quintnet_tpu.core.mesh import MeshSpec, build_mesh, local_axis_index
+from quintnet_tpu.core import collectives
+
+__all__ = [
+    "Config",
+    "load_config",
+    "MeshSpec",
+    "build_mesh",
+    "local_axis_index",
+    "collectives",
+]

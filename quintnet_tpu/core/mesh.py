@@ -1,0 +1,129 @@
+"""Device mesh construction.
+
+Replaces the reference's entire L0/L1 bootstrapping stack — NCCL process
+group init, per-dimension sub-group creation, and coordinate lookup
+(reference: core/mesh.py:124-294, core/process_groups.py:42-181) — with a
+single ``jax.sharding.Mesh`` carrying named axes. There is no rendezvous,
+no rank/shape metadata protocol, and no group objects: collectives take
+axis *names* and XLA routes them over ICI/DCN.
+
+The reference's coordinate lookup ``(mesh == rank).nonzero()``
+(mesh.py:268-294) becomes ``jax.lax.axis_index(axis)`` inside
+``shard_map``, or :func:`local_axis_index` outside.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quintnet_tpu.core.config import MeshConfig
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh description: axis names and sizes, in layout order.
+
+    Axis order matters for locality: later (minor) axes map to adjacent
+    devices, so put the heaviest-communication axis (``tp``) last —
+    its collectives then ride the fastest ICI links. The reference fixes
+    wrapping order TP->PP->DP structurally (hybrid_3d_coordinator.py:49-69);
+    here the same preference is expressed purely as device layout.
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @staticmethod
+    def create(**sizes: int) -> "MeshSpec":
+        """MeshSpec.create(dp=2, tp=2, pp=2); axes with size 1 are kept so
+        names are always valid inside shard_map."""
+        return MeshSpec(axes=tuple((k, int(v)) for k, v in sizes.items()))
+
+    @staticmethod
+    def from_config(cfg: MeshConfig) -> "MeshSpec":
+        return MeshSpec(axes=tuple(zip(cfg.mesh_name, cfg.mesh_dim)))
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.axes)
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(self.shape)) if self.axes else 1
+
+    def size(self, axis: str) -> int:
+        for n, s in self.axes:
+            if n == axis:
+                return s
+        return 1
+
+
+def build_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` from a spec.
+
+    Device order: ``jax.devices()`` already enumerates TPU chips in
+    torus-contiguous order, so a simple reshape gives contiguous minor
+    axes (the reference instead builds
+    ``torch.arange(world).view(dims)`` + one NCCL group per dim —
+    mesh.py:213-251; none of that machinery is needed here).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = spec.world_size
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {dict(spec.axes)} needs {n} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices[:n]).reshape(spec.shape)
+    return Mesh(dev_array, spec.names)
+
+
+def mesh_from_sizes(devices=None, **sizes: int) -> Mesh:
+    """Shorthand: ``mesh_from_sizes(dp=2, tp=2, pp=2)``."""
+    return build_mesh(MeshSpec.create(**sizes), devices)
+
+
+def local_axis_index(mesh: Mesh, axis: str, device: Optional[jax.Device] = None) -> int:
+    """Host-side coordinate of ``device`` along ``axis`` (the reference's
+    ``get_coordinates_tensor_search`` — process_groups.py:140-161). Inside
+    shard_map use ``jax.lax.axis_index`` instead."""
+    if device is None:
+        device = jax.devices()[0]
+    coords = np.argwhere(mesh.devices == device)
+    if coords.size == 0:
+        raise ValueError(f"device {device} not in mesh")
+    return int(coords[0][mesh.axis_names.index(axis)])
+
+
+def batch_sharding(mesh: Mesh, *, batch_axes: Sequence[str] = ("dp",)) -> NamedSharding:
+    """Sharding for a [batch, ...] array: batch dim split over the data
+    axes, everything else replicated."""
+    axes = [a for a in batch_axes if a in mesh.axis_names]
+    return NamedSharding(mesh, P(tuple(axes) if axes else None))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def describe(mesh: Mesh) -> str:
+    """Human-readable mesh summary (the reference's ``print_mesh_info``,
+    process_groups.py:120-138)."""
+    lines = [f"Mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+             f"({mesh.devices.size} devices)"]
+    for idx, dev in np.ndenumerate(mesh.devices):
+        coord = dict(zip(mesh.axis_names, idx))
+        lines.append(f"  {coord} -> {dev}")
+    return "\n".join(lines)

@@ -1,0 +1,63 @@
+"""Pytree utilities used across the framework (param counting, stage
+stacking for pipeline parallelism, global-norm clipping helpers)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_count_params(tree) -> int:
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def tree_stack(trees: Sequence[Any]):
+    """Stack a list of identically-structured pytrees along a new leading
+    axis. Used to turn per-stage parameter pytrees into one pytree whose
+    leaves have leading dim ``pp`` (sharded over the pp mesh axis) — the
+    TPU-native replacement for the reference's per-stage module objects
+    (pipeline_parallel/wrapper.py:105-129)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_unstack(tree, n: int) -> List[Any]:
+    """Inverse of :func:`tree_stack`."""
+    return [jax.tree.map(lambda x: x[i], tree) for i in range(n)]
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_cast(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree,
+    )
+
+
+def tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_scale(tree, s):
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def global_norm(tree) -> jax.Array:
+    """L2 norm over all leaves (for grad clipping; the reference clips via
+    torch.nn.utils.clip_grad_norm_ inside the schedule, schedule.py:493-501)."""
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def clip_by_global_norm(tree, max_norm: float):
+    norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda x: x * scale, tree), norm

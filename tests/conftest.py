@@ -1,0 +1,37 @@
+"""Pytest setup: run every test on a simulated 8-device CPU mesh.
+
+The reference's test story needs real GPUs + torchrun per rank and skips
+on world-size mismatch (reference: tests/conftest.py:48-135). JAX gives
+multi-device simulation for free: 8 virtual CPU devices in one process,
+so the full DPxTPxPP matrix runs in CI with no hardware.
+
+NOTE: this environment's sitecustomize pins JAX_PLATFORMS=axon (real TPU
+tunnel); ``jax.config.update('jax_platforms', 'cpu')`` after import
+overrides it, and XLA_FLAGS must be set before first backend use.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
