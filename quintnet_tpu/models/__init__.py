@@ -1,0 +1,5 @@
+"""Model zoo: ViT (MNIST-scale) and GPT-2 families, as init/apply pairs."""
+
+from quintnet_tpu.models import vit
+
+__all__ = ["vit"]

@@ -1,0 +1,159 @@
+"""Vision Transformer for image classification (MNIST-scale).
+
+TPU-native re-design of the reference ViT (utils/model.py:45-399):
+- patch embedding = patchify reshape + one matmul instead of Conv2d
+  (model.py:150-195) — same linear map, direct MXU lowering;
+- blocks stored stacked [depth, ...] and run with lax.scan instead of a
+  ModuleList Python loop (model.py:325-380);
+- CLS token + learned position embeddings, pre-LN blocks with ReLU MLP,
+  classification head reading the CLS position — structure and widths
+  match model.py:235-323 so convergence curves are comparable.
+
+The param tree is partitioned into the same three top-level groups the
+reference's pipeline wrapper depends on (``embedding`` / ``blocks`` /
+``head``; wrapper.py:89-96): PP slices ``blocks`` and replicates the
+small embedding/head params on every stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from quintnet_tpu.core.config import ModelConfig
+from quintnet_tpu.core.pytree import tree_stack
+from quintnet_tpu.nn.layers import (
+    layer_norm_apply,
+    layer_norm_init,
+    linear_apply,
+    linear_init,
+    patchify,
+)
+from quintnet_tpu.nn.transformer import block_init, stacked_blocks_apply
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 28
+    patch_size: int = 7
+    in_channels: int = 1
+    hidden_dim: int = 64
+    depth: int = 8
+    num_heads: int = 4
+    mlp_ratio: float = 4.0
+    num_classes: int = 10
+    dropout: float = 0.0
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + 1  # + CLS
+
+    @property
+    def mlp_hidden(self) -> int:
+        return int(self.hidden_dim * self.mlp_ratio)
+
+    @staticmethod
+    def from_model_config(m: ModelConfig) -> "ViTConfig":
+        names = {f.name for f in dataclasses.fields(ViTConfig)}
+        d = {k: v for k, v in dataclasses.asdict(m).items() if k in names}
+        return ViTConfig(**d)
+
+
+def vit_init(key, cfg: ViTConfig, *, dtype=jnp.float32):
+    k_patch, k_cls, k_pos, k_blocks, k_head = jax.random.split(key, 5)
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.in_channels
+
+    block_keys = jax.random.split(k_blocks, cfg.depth)
+    blocks = tree_stack(
+        [block_init(bk, cfg.hidden_dim, mlp_hidden=cfg.mlp_hidden, dtype=dtype)
+         for bk in block_keys]
+    )
+
+    return {
+        "embedding": {
+            "patch": linear_init(k_patch, patch_dim, cfg.hidden_dim, dtype=dtype),
+            "cls": jax.random.normal(k_cls, (1, 1, cfg.hidden_dim), dtype) * 0.02,
+            "pos": jax.random.normal(k_pos, (1, cfg.seq_len, cfg.hidden_dim), dtype) * 0.02,
+        },
+        "blocks": blocks,
+        "head": {
+            "ln": layer_norm_init(cfg.hidden_dim, dtype),
+            "fc": linear_init(k_head, cfg.hidden_dim, cfg.num_classes, dtype=dtype),
+        },
+    }
+
+
+def vit_embed(p_emb, images, patch_size: int):
+    """images [B, H, W, C] -> tokens [B, N+1, D] (reference ViTEmbedding,
+    model.py:271-323)."""
+    x = patchify(images, patch_size)
+    x = linear_apply(p_emb["patch"], x)
+    b = x.shape[0]
+    cls = jnp.broadcast_to(p_emb["cls"], (b, 1, x.shape[-1])).astype(x.dtype)
+    x = jnp.concatenate([cls, x], axis=1)
+    return x + p_emb["pos"].astype(x.dtype)
+
+
+def vit_head(p_head, x):
+    """CLS token -> logits (reference ClassificationHead, model.py:235-269)."""
+    cls = layer_norm_apply(p_head["ln"], x[:, 0])
+    return linear_apply(p_head["fc"], cls)
+
+
+def vit_apply(
+    params,
+    images,
+    cfg: ViTConfig,
+    *,
+    tp_axis: Optional[str] = None,
+    remat: bool = False,
+    compute_dtype=None,
+):
+    """Forward pass: [B, H, W, C] (or [B, C, H, W] — auto-detected) -> logits.
+
+    ``tp_axis``: see nn/transformer.py — heads/MLP column-row sharded;
+    ``num_heads`` passed to attention is LOCAL heads.
+    """
+    if images.ndim == 4 and images.shape[1] == cfg.in_channels \
+            and images.shape[-1] != cfg.in_channels:
+        images = images.transpose(0, 2, 3, 1)  # NCHW (torch layout) -> NHWC
+    if compute_dtype is not None:
+        images = images.astype(compute_dtype)
+        params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+
+    tp = 1
+    if tp_axis is not None:
+        tp = jax.lax.axis_size(tp_axis)
+    local_heads = cfg.num_heads // tp
+
+    x = vit_embed(params["embedding"], images, cfg.patch_size)
+    x = stacked_blocks_apply(
+        params["blocks"],
+        x,
+        num_heads=local_heads,
+        causal=False,
+        act=jax.nn.relu,  # reference ViT MLP uses ReLU (model.py:112-148)
+        tp_axis=tp_axis,
+        remat=remat,
+    )
+    return vit_head(params["head"], x).astype(jnp.float32)
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean CE over the batch (reference Trainer uses nn.CrossEntropyLoss,
+    trainer.py:90)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))

@@ -1,0 +1,32 @@
+"""Functional neural-net layer library (pure pytrees, no module objects).
+
+The reference builds models from torch ``nn.Module`` objects and then
+mutates them in place for parallelism (tensor_parallel/model_wrapper.py:37).
+Here every layer is an ``init`` function returning a param pytree plus an
+``apply`` function; parallelism is expressed by *how params are sharded*
+and by optional named-axis arguments to apply functions — the same code
+runs unsharded on one device and SPMD under shard_map.
+"""
+
+from quintnet_tpu.nn import layers
+from quintnet_tpu.nn.layers import (
+    linear_init,
+    linear_apply,
+    layer_norm_init,
+    layer_norm_apply,
+    embedding_init,
+    dropout,
+)
+from quintnet_tpu.nn.attention import mha_init, mha_apply
+
+__all__ = [
+    "layers",
+    "linear_init",
+    "linear_apply",
+    "layer_norm_init",
+    "layer_norm_apply",
+    "embedding_init",
+    "dropout",
+    "mha_init",
+    "mha_apply",
+]

@@ -1,0 +1,88 @@
+"""Multi-head attention with head-sharded tensor parallelism.
+
+Reference semantics: fused QKV as ColumnParallelLinear with
+``gather_output=False`` so each TP rank keeps H/tp heads, local scaled
+dot-product attention, then RowParallel output projection with a single
+all-reduce (reference: utils/GPT2/gpt2_attention.py:80-175; ViT variant
+utils/model.py:45-110 without the causal mask).
+
+Under shard_map the qkv weight arrives column-sharded [D, 3D/tp] and the
+proj weight row-sharded [D/tp, D]; with ``tp_axis=None`` the same code is
+plain single-device MHA. The inner attention dispatches to a Pallas flash
+kernel on TPU for long sequences (ops/flash_attention.py) and to the
+reference-equivalent jnp softmax path otherwise.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from einops import rearrange
+
+from quintnet_tpu.nn.layers import linear_init, linear_apply
+
+
+def mha_init(key, dim: int, *, qkv_bias: bool = True, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return {
+        "qkv": linear_init(k1, dim, 3 * dim, use_bias=qkv_bias, dtype=dtype),
+        "proj": linear_init(k2, dim, dim, dtype=dtype),
+    }
+
+
+def sdpa(q, k, v, *, causal: bool, softmax_dtype=jnp.float32):
+    """Plain scaled-dot-product attention: [B, H, S, Dh] -> [B, H, S, Dh].
+
+    Matches the reference's F.scaled_dot_product_attention call
+    (gpt2_attention.py:156-161). Softmax in f32 regardless of input dtype.
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(softmax_dtype)
+    scores = scores / math.sqrt(dh)
+    if causal:
+        s, t = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), dtype=bool))
+        scores = jnp.where(mask, scores, jnp.finfo(softmax_dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+
+
+def mha_apply(
+    p,
+    x,
+    *,
+    num_heads: int,
+    causal: bool = False,
+    tp_axis: Optional[str] = None,
+    use_flash: bool = False,
+):
+    """x: [B, S, D] -> [B, S, D].
+
+    ``num_heads`` is the number of LOCAL heads (global heads / tp_size when
+    sharded — head-sharding exactly as gpt2_attention.py:89-95).
+    """
+    qkv = linear_apply(p["qkv"], x)  # [B, S, 3*D_local]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
+    k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
+    v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
+
+    if use_flash:
+        from quintnet_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(q, k, v, causal=causal)
+    else:
+        o = sdpa(q, k, v, causal=causal)
+
+    o = rearrange(o, "b h s d -> b s (h d)")
+    y = jnp.dot(o, p["proj"]["w"])
+    if tp_axis is not None:
+        # RowParallel all-reduce (reference: layers.py:216 -> All_Reduce)
+        y = lax.psum(y, tp_axis)
+    if "b" in p["proj"]:
+        y = y + p["proj"]["b"]
+    return y

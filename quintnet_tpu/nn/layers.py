@@ -1,0 +1,122 @@
+"""Core layers as init/apply function pairs over plain pytrees.
+
+Conventions:
+- Params are dicts of jnp arrays with FULL (global) shapes; under
+  shard_map a device sees its local shard and the apply functions take
+  named-axis arguments where a collective is required.
+- Weights are stored [in_features, out_features] so forward is ``x @ w``
+  (no transpose; feeds the MXU directly). The reference stores torch's
+  [out, in] and the GPT-2 loader transposes Conv1D weights
+  (core/distributed_loading.py:295-306); our checkpoint importer does
+  that transpose once at load time instead of every step.
+- dtype policy: params kept in ``param_dtype`` (default f32), compute
+  optionally in bfloat16 — the TPU-native mixed-precision default.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _uniform_init(key, shape, scale, dtype):
+    return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+
+
+def linear_init(key, in_features: int, out_features: int, *,
+                use_bias: bool = True, dtype=jnp.float32):
+    """Kaiming-uniform fan-in init, matching torch.nn.Linear defaults so
+    convergence curves are comparable with the reference."""
+    kw, kb = jax.random.split(key)
+    scale = 1.0 / math.sqrt(in_features)
+    p = {"w": _uniform_init(kw, (in_features, out_features), scale, dtype)}
+    if use_bias:
+        p["b"] = _uniform_init(kb, (out_features,), scale, dtype)
+    return p
+
+
+def linear_apply(p, x, *, precision=None):
+    y = jnp.dot(x, p["w"], precision=precision)
+    if "b" in p:
+        y = y + p["b"]
+    return y
+
+
+def layer_norm_init(dim: int, dtype=jnp.float32):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layer_norm_apply(p, x, *, eps: float = 1e-5):
+    # Always normalise in f32 for stability, cast back to input dtype.
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(dtype)
+
+
+def embedding_init(key, num_embeddings: int, features: int, *,
+                   scale: float = 0.02, dtype=jnp.float32):
+    return {"table": jax.random.normal(key, (num_embeddings, features), dtype) * scale}
+
+
+def embedding_apply(p, ids):
+    return jnp.take(p["table"], ids, axis=0)
+
+
+def dropout(key, x, rate: float, *, deterministic: bool):
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def gelu(x):
+    # tanh approximation — what GPT-2 uses (reference: gpt2_mlp GELU)
+    return jax.nn.gelu(x, approximate=True)
+
+
+def patchify(images, patch_size: int):
+    """[B, H, W, C] -> [B, (H/p)*(W/p), p*p*C].
+
+    The reference patch-embeds with Conv2d(kernel=stride=p)
+    (utils/model.py:150-195); on TPU a reshape + one big matmul is the
+    same linear map and lands straight on the MXU with no conv lowering.
+    """
+    b, h, w, c = images.shape
+    p = patch_size
+    assert h % p == 0 and w % p == 0, (h, w, p)
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # B, H/p, W/p, p, p, C
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def mlp_init(key, dim: int, hidden: int, *, dtype=jnp.float32):
+    """Transformer MLP: fc (column-shardable) -> act -> proj (row-shardable).
+    Reference: utils/model.py:112-148 (ViT, ReLU), utils/GPT2/gpt2_mlp.py
+    (GPT-2, GELU)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "fc": linear_init(k1, dim, hidden, dtype=dtype),
+        "proj": linear_init(k2, hidden, dim, dtype=dtype),
+    }
+
+
+def mlp_apply(p, x, *, act=gelu, tp_axis: Optional[str] = None):
+    """With ``tp_axis``: fc weight is column-sharded [D, hidden/tp] and proj
+    row-sharded [hidden/tp, D]; the single psum after proj reproduces the
+    reference's ColumnParallel->RowParallel pair (gpt2_mlp.py:98-125)."""
+    # fc bias is sharded with the columns, so it adds locally (no collective)
+    h = act(linear_apply(p["fc"], x))
+    y = jnp.dot(h, p["proj"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    if "b" in p["proj"]:
+        y = y + p["proj"]["b"]
+    return y

@@ -1,0 +1,97 @@
+"""Pre-LN transformer block shared by ViT and GPT-2.
+
+Reference: utils/model.py:197-233 (ViT TransformerBlock, ReLU MLP) and
+utils/GPT2/gpt2_block.py:57-188 (GPT-2, GELU MLP, causal). Both are
+pre-LN residual blocks; LayerNorms are replicated across TP while
+attention/MLP weights are column/row sharded.
+
+Block params are designed to be STACKED along a leading ``depth`` axis
+(core/pytree.py:tree_stack) so a model runs them with ``lax.scan`` —
+one compiled block body regardless of depth — and pipeline parallelism
+becomes a reshape of that axis to [pp, depth/pp, ...].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from quintnet_tpu.nn.attention import mha_apply, mha_init
+from quintnet_tpu.nn.layers import (
+    gelu,
+    layer_norm_apply,
+    layer_norm_init,
+    mlp_apply,
+    mlp_init,
+)
+
+
+def block_init(key, dim: int, *, mlp_hidden: int, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return {
+        "ln1": layer_norm_init(dim, dtype),
+        "attn": mha_init(k1, dim, dtype=dtype),
+        "ln2": layer_norm_init(dim, dtype),
+        "mlp": mlp_init(k2, dim, mlp_hidden, dtype=dtype),
+    }
+
+
+def block_apply(
+    p,
+    x,
+    *,
+    num_heads: int,
+    causal: bool = False,
+    act: Callable = gelu,
+    tp_axis: Optional[str] = None,
+    use_flash: bool = False,
+):
+    x = x + mha_apply(
+        p["attn"],
+        layer_norm_apply(p["ln1"], x),
+        num_heads=num_heads,
+        causal=causal,
+        tp_axis=tp_axis,
+        use_flash=use_flash,
+    )
+    x = x + mlp_apply(p["mlp"], layer_norm_apply(p["ln2"], x), act=act, tp_axis=tp_axis)
+    return x
+
+
+def stacked_blocks_apply(
+    stacked_params,
+    x,
+    *,
+    num_heads: int,
+    causal: bool = False,
+    act: Callable = gelu,
+    tp_axis: Optional[str] = None,
+    use_flash: bool = False,
+    remat: bool = False,
+):
+    """Run a [depth, ...]-stacked block pytree with lax.scan.
+
+    Replaces the reference's Python loop over ``model.blocks``
+    (utils/model.py:325-380) — one traced block body, depth iterations,
+    constant compile time in depth. ``remat=True`` rematerialises each
+    block in backward (jax.checkpoint), trading FLOPs for HBM.
+    """
+    body = partial(
+        block_apply,
+        num_heads=num_heads,
+        causal=causal,
+        act=act,
+        tp_axis=tp_axis,
+        use_flash=use_flash,
+    )
+    if remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(h, blk_p):
+        return body(blk_p, h), None
+
+    out, _ = jax.lax.scan(scan_fn, x, stacked_params)
+    return out

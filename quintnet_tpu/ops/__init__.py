@@ -1,0 +1,1 @@
+"""TPU kernels (Pallas) and kernel-backed ops with reference jnp fallbacks."""

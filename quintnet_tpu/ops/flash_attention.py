@@ -1,0 +1,104 @@
+"""Flash attention: Pallas TPU kernel with an exact jnp fallback.
+
+The reference has no fused attention of its own — it calls
+``F.scaled_dot_product_attention`` (gpt2_attention.py:156-161) and lets
+cuDNN pick a kernel. On TPU the analogue is a Pallas kernel that tiles
+Q/K/V through VMEM with an online softmax so the [S, S] score matrix
+never materialises in HBM.
+
+This module is the dispatch surface: it selects the hand-tiled Pallas
+kernel (ops/pallas_attention.py) on TPU backends and otherwise runs the
+same online-softmax recurrence in pure jnp (numerically identical to
+softmax(QK^T)V, O(S) live memory under scan).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _one_query_block(q_blk, qi, k_blocks, v_blocks, kv_valid, *,
+                     causal: bool, block_q: int, block_k: int, scale: float):
+    """Online-softmax over all KV blocks for one query block.
+
+    q_blk: [bq, d]; k_blocks/v_blocks: [nk, bk, d]; kv_valid: [nk, bk].
+    """
+    d = q_blk.shape[-1]
+    nk = k_blocks.shape[0]
+    q_pos = qi * block_q + jnp.arange(block_q)
+    qf = q_blk.astype(jnp.float32)
+
+    def kv_step(carry, inp):
+        m, l, acc = carry
+        ki, k_blk, v_blk, valid = inp
+        scores = jnp.einsum("qd,kd->qk", qf, k_blk.astype(jnp.float32)) * scale
+        mask = valid[None, :]
+        if causal:
+            k_pos = ki * block_k + jnp.arange(block_k)
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        scores = jnp.where(mask, scores, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(scores, -1))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)  # fully-masked rows
+        p = jnp.where(mask, jnp.exp(scores - m_safe[:, None]), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, -1)
+        acc_new = acc * corr[:, None] + jnp.einsum(
+            "qk,kd->qd", p, v_blk.astype(jnp.float32))
+        return (m_safe, l_new, acc_new), None
+
+    init = (
+        jnp.full((block_q,), -jnp.inf, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+        jnp.zeros((block_q, d), jnp.float32),
+    )
+    (_, l, acc), _ = lax.scan(kv_step, init,
+                              (jnp.arange(nk), k_blocks, v_blocks, kv_valid))
+    return acc / jnp.maximum(l, 1e-30)[:, None]
+
+
+def blockwise_attention(q, k, v, *, causal: bool,
+                        block_q: int = 128, block_k: int = 128):
+    """Exact blockwise attention [B,H,S,D] -> [B,H,S,D] (jnp reference for
+    the Pallas kernel; also the long-context-safe fallback)."""
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    nq = -(-s // block_q)
+    nk = -(-s // block_k)
+    pad_q = nq * block_q - s
+    pad_k = nk * block_k - s
+    qb = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))).reshape(b, h, nq, block_q, d)
+    kb = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))).reshape(b, h, nk, block_k, d)
+    vb = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))).reshape(b, h, nk, block_k, d)
+    kv_valid = (jnp.arange(nk * block_k) < s).reshape(nk, block_k)
+
+    def one(q_blk, qi, k_all, v_all):
+        return _one_query_block(q_blk, qi, k_all, v_all, kv_valid,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k, scale=scale)
+
+    f = jax.vmap(one, in_axes=(0, 0, None, None))   # q blocks
+    f = jax.vmap(f, in_axes=(0, None, 0, 0))        # heads
+    f = jax.vmap(f, in_axes=(0, None, 0, 0))        # batch
+    out = f(qb, jnp.arange(nq), kb, vb)             # [B,H,nq,bq,d]
+    return out.reshape(b, h, nq * block_q, d)[:, :, :s].astype(q.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128):
+    """[B, H, S, Dh] fused attention. Pallas TPU kernel when on a TPU
+    backend, exact blockwise jnp otherwise."""
+    if jax.default_backend() == "tpu":
+        try:
+            from quintnet_tpu.ops.pallas_attention import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal)
+        except ImportError:
+            pass
+    return blockwise_attention(q, k, v, causal=causal,
+                               block_q=block_q, block_k=block_k)

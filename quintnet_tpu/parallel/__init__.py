@@ -1,0 +1,11 @@
+"""Parallelism engines: DP, TP, PP, SP, ZeRO, and the strategy facade.
+
+The reference implements these as nested module wrappers applied in a
+fixed TP->PP->DP order (coordinators/hybrid_3d_coordinator.py:49-236).
+Here each engine is a set of sharding rules + collective calls over one
+mesh; composition is axis coexistence, not wrapping.
+"""
+
+from quintnet_tpu.parallel.dp import make_dp_train_step
+
+__all__ = ["make_dp_train_step"]

@@ -1,0 +1,87 @@
+"""ViT model tests: shapes, determinism, and a short single-device training
+run that must reduce loss (the reference's acceptance style: convergence
+behavior, README.md:199-216)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from quintnet_tpu.models.vit import (
+    ViTConfig,
+    accuracy,
+    cross_entropy_loss,
+    vit_apply,
+    vit_init,
+)
+
+CFG = ViTConfig(image_size=28, patch_size=7, in_channels=1, hidden_dim=32,
+                depth=2, num_heads=4, num_classes=10)
+
+
+def test_init_shapes():
+    params = vit_init(jax.random.key(0), CFG)
+    assert params["embedding"]["patch"]["w"].shape == (49, 32)
+    assert params["embedding"]["pos"].shape == (1, 17, 32)
+    # blocks stacked along depth
+    assert params["blocks"]["attn"]["qkv"]["w"].shape == (2, 32, 96)
+    assert params["head"]["fc"]["w"].shape == (32, 10)
+
+
+def test_forward_shape_and_nchw_autodetect():
+    params = vit_init(jax.random.key(0), CFG)
+    x_nhwc = jnp.ones((4, 28, 28, 1))
+    x_nchw = jnp.ones((4, 1, 28, 28))
+    out1 = vit_apply(params, x_nhwc, CFG)
+    out2 = vit_apply(params, x_nchw, CFG)
+    assert out1.shape == (4, 10)
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_forward_deterministic():
+    params = vit_init(jax.random.key(0), CFG)
+    x = jax.random.normal(jax.random.key(1), (2, 28, 28, 1))
+    np.testing.assert_array_equal(vit_apply(params, x, CFG),
+                                  vit_apply(params, x, CFG))
+
+
+def test_remat_matches_no_remat():
+    params = vit_init(jax.random.key(0), CFG)
+    x = jax.random.normal(jax.random.key(1), (2, 28, 28, 1))
+    y = jax.random.randint(jax.random.key(2), (2,), 0, 10)
+
+    def loss(p, remat):
+        return cross_entropy_loss(vit_apply(p, x, CFG, remat=remat), y)
+
+    g1 = jax.grad(lambda p: loss(p, False))(params)
+    g2 = jax.grad(lambda p: loss(p, True))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_single_device_training_reduces_loss():
+    key = jax.random.key(0)
+    params = vit_init(key, CFG)
+    x = jax.random.normal(jax.random.key(1), (32, 28, 28, 1))
+    y = jax.random.randint(jax.random.key(2), (32,), 0, 10)
+
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        def loss_fn(p_):
+            return cross_entropy_loss(vit_apply(p_, x, CFG), y)
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    losses = []
+    for _ in range(30):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses
+    logits = vit_apply(params, x, CFG)
+    assert float(accuracy(logits, y)) > 0.5
