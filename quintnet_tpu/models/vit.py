@@ -147,6 +147,50 @@ def vit_apply(
     return vit_head(params["head"], x).astype(jnp.float32)
 
 
+def vit_partition_specs(cfg: Optional[ViTConfig] = None, *,
+                        tp_axis: Optional[str] = "tp",
+                        pp_axis: Optional[str] = None):
+    """PartitionSpec tree matching :func:`vit_init`'s param tree.
+
+    Embedding and head are small -> replicated (the reference replicates
+    them too: first/last stage modules, wrapper.py:131-184); blocks get
+    Megatron column/row TP sharding, and optionally their stacked depth
+    dim sharded over ``pp_axis``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.parallel.tp import block_specs
+
+    return {
+        "embedding": {
+            "patch": {"w": P(), "b": P()},
+            "cls": P(),
+            "pos": P(),
+        },
+        "blocks": block_specs(tp_axis=tp_axis, stacked=True, pp_axis=pp_axis),
+        "head": {
+            "ln": {"scale": P(), "bias": P()},
+            "fc": {"w": P(), "b": P()},
+        },
+    }
+
+
+def vit_to_tp_layout(params, cfg: ViTConfig, tp: int):
+    """Convert a single-device param tree to the tp-blocked fused-QKV
+    layout (parallel/tp.py docstring) so sharded and unsharded runs are
+    numerically identical. Identity for tp=1."""
+    from quintnet_tpu.parallel.tp import qkv_blocked_from_standard
+
+    if tp == 1:
+        return params
+    out = jax.tree.map(lambda x: x, params)  # shallow copy
+    qkv = out["blocks"]["attn"]["qkv"]
+    qkv["w"] = qkv_blocked_from_standard(qkv["w"], cfg.num_heads, tp)
+    if "b" in qkv:
+        qkv["b"] = qkv_blocked_from_standard(qkv["b"], cfg.num_heads, tp)
+    return out
+
+
 def cross_entropy_loss(logits, labels):
     """Mean CE over the batch (reference Trainer uses nn.CrossEntropyLoss,
     trainer.py:90)."""

@@ -1,0 +1,190 @@
+"""Tensor parallelism: Megatron-style 1D sharding as functions + specs.
+
+Reference implementation: ColumnParallelLinear / RowParallelLinear /
+VocabParallelEmbedding autograd modules (tensor_parallel/layers.py:42-297)
+plus an in-place ``nn.Linear`` rewriter (model_wrapper.py:37-166). Here
+the same semantics are:
+
+- explicit layer functions usable under ``shard_map`` (this module);
+- :class:`jax.sharding.PartitionSpec` rules describing how full param
+  trees are laid out over the ``tp`` axis (``column_spec``/``row_spec``
+  and the per-model spec builders in models/);
+- the reduction rule in parallel/train_step.py that psums grads of
+  tp-replicated params (LayerNorms, embeddings) over ``tp`` — a
+  correctness requirement the reference omits entirely (its replicated
+  LN params receive rank-partial grads and silently desync).
+
+Fused-QKV layout convention: the global [D, 3D] QKV weight is stored
+**tp-blocked** — the columns are ordered [q_0|k_0|v_0|q_1|k_1|v_1|...]
+per tp shard so that plain column slicing hands each rank whole heads of
+q, k and v (the reference instead naively column-slices torch's [q|k|v]
+layout, gpt2_attention.py:80-88 + distributed_loading.py:295-306, which
+mislabels head halves; checkpoint importers must permute — see
+models/gpt2_io.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from quintnet_tpu.core import collectives as cc
+
+
+def column_parallel_linear(p, x, *, axis: Optional[str] = "tp",
+                           gather_output: bool = False):
+    """y = x @ W_col (+ b_col); W column-sharded [in, out/tp].
+
+    ``gather_output=True`` all-gathers the sharded output on the feature
+    dim (reference: layers.py:42-131; gather is the default there, while
+    fused attention uses gather_output=False to keep heads local).
+    """
+    y = jnp.dot(x, p["w"])
+    if "b" in p:
+        y = y + p["b"]
+    if gather_output and axis is not None:
+        y = cc.all_gather(y, axis, gather_dim=-1)
+    return y
+
+
+def row_parallel_linear(p, x, *, axis: Optional[str] = "tp",
+                        input_is_parallel: bool = True):
+    """y = psum_tp(x_shard @ W_row) + b; W row-sharded [in/tp, out].
+
+    With ``input_is_parallel=False`` the (replicated) input is self-sliced
+    to this rank's rows first (reference: layers.py:134-221 supports the
+    same two input modes; bias added once, after the reduce).
+    """
+    if axis is not None and not input_is_parallel:
+        r = lax.axis_index(axis)
+        shard = p["w"].shape[0]
+        x = lax.dynamic_slice_in_dim(x, r * shard, shard, axis=-1)
+    y = jnp.dot(x, p["w"])
+    if axis is not None:
+        y = lax.psum(y, axis)
+    if "b" in p:
+        y = y + p["b"]
+    return y
+
+
+def vocab_parallel_embedding(p, ids, *, axis: Optional[str] = "tp"):
+    """Embedding lookup with the vocabulary sharded over ``tp``.
+
+    Out-of-shard ids contribute zeros; a single psum assembles the full
+    embedding (reference defines this but never uses it —
+    layers.py:224-297; GPT-2 replicates embeddings instead. Here it is a
+    first-class option for large-vocab models).
+    """
+    table = p["table"]
+    if axis is None:
+        return jnp.take(table, ids, axis=0)
+    per_shard = table.shape[0]
+    start = lax.axis_index(axis) * per_shard
+    local = ids - start
+    in_shard = (local >= 0) & (local < per_shard)
+    safe = jnp.clip(local, 0, per_shard - 1)
+    out = jnp.take(table, safe, axis=0)
+    out = jnp.where(in_shard[..., None], out, 0.0)
+    return lax.psum(out, axis)
+
+
+def vocab_parallel_logits(p, x, *, axis: Optional[str] = "tp"):
+    """lm_head with column-sharded (vocab-sharded) weight [D, V/tp]:
+    returns full logits via all-gather on the vocab dim."""
+    y = jnp.dot(x, p["w"] if isinstance(p, dict) else p)
+    if axis is not None:
+        y = cc.all_gather(y, axis, gather_dim=-1)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Fused-QKV layout conversion (see module docstring). Standard layout is
+# [q|k|v] on the last axis (torch/HF c_attn); blocked layout groups
+# columns per tp shard: [q_0|k_0|v_0|q_1|k_1|v_1|...], heads in original
+# order within each shard, so contiguous column slicing = head sharding.
+
+
+def qkv_blocked_from_standard(w, num_heads: int, tp: int):
+    """Permute the last axis of a fused-QKV weight [.., 3D] (or bias [3D])
+    from standard [q|k|v] to tp-blocked layout. tp=1 is the identity."""
+    d3 = w.shape[-1]
+    d = d3 // 3
+    assert num_heads % tp == 0 and d % num_heads == 0, (num_heads, tp, d)
+    hpr = num_heads // tp
+    dh = d // num_heads
+    # [.., 3, tp, hpr*dh] -> [.., tp, 3, hpr*dh]
+    x = w.reshape(w.shape[:-1] + (3, tp, hpr * dh))
+    x = jnp.moveaxis(x, -2, -3)
+    return x.reshape(w.shape[:-1] + (d3,))
+
+
+def qkv_standard_from_blocked(w, num_heads: int, tp: int):
+    """Inverse of :func:`qkv_blocked_from_standard` (for checkpoint export
+    back to HF layout — merge_checkpoints.py semantics)."""
+    d3 = w.shape[-1]
+    d = d3 // 3
+    hpr = num_heads // tp
+    dh = d // num_heads
+    x = w.reshape(w.shape[:-1] + (tp, 3, hpr * dh))
+    x = jnp.moveaxis(x, -3, -2)
+    return x.reshape(w.shape[:-1] + (d3,))
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec rule helpers. ``stacked`` prepends the depth/stage dim of
+# stacked block pytrees; ``pp_axis`` shards that leading dim for pipelining.
+
+
+def _lead(spec_tail, stacked: bool, pp_axis: Optional[str]):
+    if not stacked:
+        return P(*spec_tail)
+    return P(pp_axis, *spec_tail)
+
+
+def column_spec(*, tp_axis="tp", stacked=False, pp_axis=None):
+    """Specs for a column-parallel linear {w: [in, out], b: [out]}."""
+    return {
+        "w": _lead((None, tp_axis), stacked, pp_axis),
+        "b": _lead((tp_axis,), stacked, pp_axis),
+    }
+
+
+def row_spec(*, tp_axis="tp", stacked=False, pp_axis=None):
+    """Specs for a row-parallel linear {w: [in, out], b: [out]}; bias is
+    replicated (added once after the psum)."""
+    return {
+        "w": _lead((tp_axis, None), stacked, pp_axis),
+        "b": _lead((None,), stacked, pp_axis),
+    }
+
+
+def replicated_spec(*, stacked=False, pp_axis=None):
+    return _lead((), stacked, pp_axis) if stacked else P()
+
+
+def layer_norm_spec(*, stacked=False, pp_axis=None):
+    lead = _lead((None,), stacked, pp_axis)
+    return {"scale": lead, "bias": lead}
+
+
+def block_specs(*, tp_axis="tp", stacked=True, pp_axis=None):
+    """Specs for one (stacked) pre-LN transformer block: attention QKV
+    column-sharded, proj row-sharded, MLP fc column / proj row, LNs
+    replicated — the exact layout of reference GPT2Block/ViT TP rewrite."""
+    kw = dict(stacked=stacked, pp_axis=pp_axis)
+    return {
+        "ln1": layer_norm_spec(**kw),
+        "attn": {
+            "qkv": column_spec(tp_axis=tp_axis, **kw),
+            "proj": row_spec(tp_axis=tp_axis, **kw),
+        },
+        "ln2": layer_norm_spec(**kw),
+        "mlp": {
+            "fc": column_spec(tp_axis=tp_axis, **kw),
+            "proj": row_spec(tp_axis=tp_axis, **kw),
+        },
+    }

@@ -1,0 +1,182 @@
+"""Generic SPMD train-step builder for DP x TP (x SP) meshes.
+
+One shard_map'd function subsumes the reference's DataParallel wrapper,
+TP coordinator and (non-pipeline) Trainer step: batch sharded over data
+axes, params laid out by PartitionSpec rules, one grad-reduction pass,
+optimizer update executed on local shards.
+
+Grad reduction rule (parallel/tp.py docstring): a param's gradient is
+- psummed over every *model* axis the param is replicated over (tp/sp
+  shard the computation, so replicated-param grads arrive as partial
+  sums — e.g. LayerNorms under TP; the reference omits this sync);
+- pmeaned over the data axes (the reference's DDP bucket allreduce+mean,
+  ddp.py:113-125, intended semantics per SURVEY §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quintnet_tpu.core import collectives as cc
+from quintnet_tpu.parallel.dp import accumulate_grads
+
+
+def _spec_axes(spec) -> set:
+    """Mesh axis names appearing in a PartitionSpec."""
+    axes = set()
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, (tuple, list)):
+            axes.update(part)
+        else:
+            axes.add(part)
+    return axes
+
+
+def reduce_grads(grads, param_specs, *, data_axes: Tuple[str, ...],
+                 model_axes: Tuple[str, ...]):
+    """Apply the grad-reduction rule leaf-by-leaf.
+
+    The loss is computed redundantly on every member of each model axis
+    (post-psum activations are replicated), so by psum's transpose rule
+    EVERY grad leaf arrives scaled by prod(model axis sizes); we divide
+    that factor back out. Leaves replicated over a model axis addi-
+    tionally hold only their rank's partial sum and get psummed over the
+    axes missing from their spec. Finally data axes take the DP mean.
+    """
+    redundancy = 1
+    for a in model_axes:
+        redundancy *= lax.axis_size(a)
+
+    def red(g, spec):
+        present = _spec_axes(spec)
+        psum_axes = tuple(a for a in model_axes if a not in present)
+        if psum_axes:
+            g = lax.psum(g, psum_axes)
+        if redundancy != 1:
+            g = g / redundancy
+        if data_axes:
+            g = lax.pmean(g, data_axes)
+        return g
+
+    return jax.tree.map(red, grads, param_specs)
+
+
+def sharded_global_norm(grads, param_specs, *, model_axes: Tuple[str, ...]):
+    """Global L2 norm of a tp/sp-sharded grad tree (identical on all
+    ranks). Local sum-of-squares of sharded leaves are partial and get
+    psummed over their sharding axes before the final sqrt."""
+
+    def leaf_sumsq(g, spec):
+        ss = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        shard_axes = tuple(a for a in _spec_axes(spec) if a in model_axes)
+        if shard_axes:
+            ss = lax.psum(ss, shard_axes)
+        return ss
+
+    parts = jax.tree.leaves(jax.tree.map(leaf_sumsq, grads, param_specs))
+    return jnp.sqrt(jnp.sum(jnp.stack(parts)))
+
+
+def clip_sharded_grads(grads, param_specs, max_norm: float,
+                       *, model_axes: Tuple[str, ...]):
+    norm = sharded_global_norm(grads, param_specs, model_axes=model_axes)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * scale, grads), norm
+
+
+def opt_state_specs(optimizer: optax.GradientTransformation, params,
+                    param_specs):
+    """PartitionSpec tree for an optimizer state: param-shaped slots (mu,
+    nu, trace...) inherit the param's spec, scalars are replicated.
+    Uses optax.tree_map_params so it works for any optax chain."""
+    state_shape = jax.eval_shape(optimizer.init, params)
+    return optax.tree_map_params(
+        optimizer,
+        lambda _leaf, spec: spec,
+        state_shape,
+        param_specs,
+        transform_non_params=lambda _leaf: P(),
+    )
+
+
+def shard_pytree(mesh: Mesh, tree, specs):
+    """Place a host pytree onto the mesh according to a spec tree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def init_sharded_opt_state(optimizer, params, param_specs, mesh: Mesh):
+    """Initialise optimizer state directly with the right sharding."""
+    specs = opt_state_specs(optimizer, params, param_specs)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    state = jax.jit(optimizer.init, out_shardings=shardings)(params)
+    return state, specs
+
+
+def make_parallel_train_step(
+    mesh: Mesh,
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    param_specs,
+    *,
+    batch_axes: Sequence[str] = ("dp",),
+    model_axes: Sequence[str] = ("tp", "sp"),
+    grad_accum_steps: int = 1,
+    grad_clip_norm: Optional[float] = None,
+    has_aux: bool = False,
+    donate: bool = True,
+):
+    """Build a jitted train step over an arbitrary (dp, tp[, sp]) mesh.
+
+    ``loss_fn(params, batch)`` sees LOCAL param shards and the LOCAL batch
+    shard and may itself use collectives (e.g. tp psums inside the model).
+    Returns step(params, opt_state, batch) -> (params, opt_state, loss[, aux]).
+    """
+    data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    maxes = tuple(a for a in model_axes if a in mesh.axis_names)
+
+    o_specs = None  # filled below via opt_state_specs
+
+    def local_step(params, opt_state, batch):
+        out, grads = accumulate_grads(loss_fn, params, batch,
+                                      grad_accum_steps, has_aux)
+        grads = reduce_grads(grads, param_specs,
+                             data_axes=data_axes, model_axes=maxes)
+        if data_axes:
+            out = jax.tree.map(lambda x: lax.pmean(x, data_axes), out)
+        if grad_clip_norm is not None:
+            grads, _ = clip_sharded_grads(grads, param_specs, grad_clip_norm,
+                                          model_axes=maxes)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, out
+
+    # opt state specs need a params template; derive lazily on first call
+    # so the builder does not require materialised params.
+    compiled = {}
+
+    def step(params, opt_state, batch):
+        if "fn" not in compiled:
+            o_specs = opt_state_specs(optimizer, params, param_specs)
+            batch_spec = P(data_axes if data_axes else None)
+            smapped = cc.shard_map_fn(
+                local_step,
+                mesh,
+                in_specs=(param_specs, o_specs, batch_spec),
+                out_specs=(param_specs, o_specs, P()),
+            )
+            compiled["fn"] = jax.jit(
+                smapped, donate_argnums=(0, 1) if donate else ()
+            )
+        return compiled["fn"](params, opt_state, batch)
+
+    return step
