@@ -109,8 +109,10 @@ def broadcast_from(x, axis: str, *, src: int = 0):
     broadcast: parameter_broadcaster.py:30-79). Implemented as a masked
     psum so it stays differentiable and jit-friendly."""
     idx = lax.axis_index(axis)
-    mask = (idx == src).astype(x.dtype)
-    return lax.psum(x * mask, axis)
+    # jnp.where (not multiply-by-mask) so NaN/Inf garbage on non-src ranks
+    # cannot poison the psum — e.g. pipeline outputs that are only
+    # meaningful on the last stage.
+    return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), axis)
 
 
 def tree_all_reduce(tree, axis: AxisName):

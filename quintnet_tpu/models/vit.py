@@ -191,6 +191,38 @@ def vit_to_tp_layout(params, cfg: ViTConfig, tp: int):
     return out
 
 
+def vit_pipeline_fns(cfg: ViTConfig, *, tp_axis: Optional[str] = None,
+                     remat: bool = False):
+    """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py schedules.
+
+    Replaces the reference's PipelineParallelWrapper attribute plumbing
+    (wrapper.py:89-96: embedding -> stage 0, classification_head -> last
+    stage, blocks split in between).
+    """
+
+    def embed_fn(params, x):
+        if x.ndim == 4 and x.shape[1] == cfg.in_channels \
+                and x.shape[-1] != cfg.in_channels:
+            x = x.transpose(0, 2, 3, 1)
+        return vit_embed(params["embedding"], x, cfg.patch_size)
+
+    def stage_fn(blocks_local, h):
+        tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
+        return stacked_blocks_apply(
+            blocks_local, h,
+            num_heads=cfg.num_heads // tp,
+            causal=False,
+            act=jax.nn.relu,
+            tp_axis=tp_axis,
+            remat=remat,
+        )
+
+    def head_loss_fn(params, h, y):
+        return cross_entropy_loss(vit_head(params["head"], h), y)
+
+    return embed_fn, stage_fn, head_loss_fn
+
+
 def cross_entropy_loss(logits, labels):
     """Mean CE over the batch (reference Trainer uses nn.CrossEntropyLoss,
     trainer.py:90)."""

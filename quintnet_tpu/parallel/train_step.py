@@ -41,15 +41,23 @@ def _spec_axes(spec) -> set:
 
 
 def reduce_grads(grads, param_specs, *, data_axes: Tuple[str, ...],
-                 model_axes: Tuple[str, ...]):
+                 model_axes: Tuple[str, ...],
+                 partial_axes: Tuple[str, ...] = ()):
     """Apply the grad-reduction rule leaf-by-leaf.
 
-    The loss is computed redundantly on every member of each model axis
-    (post-psum activations are replicated), so by psum's transpose rule
-    EVERY grad leaf arrives scaled by prod(model axis sizes); we divide
-    that factor back out. Leaves replicated over a model axis addi-
-    tionally hold only their rank's partial sum and get psummed over the
-    axes missing from their spec. Finally data axes take the DP mean.
+    ``model_axes`` (tp/sp): the loss is computed redundantly on every
+    member (post-psum activations are replicated), so by psum's transpose
+    rule EVERY grad leaf arrives scaled by prod(model axis sizes); we
+    divide that factor back out. Leaves replicated over a model axis
+    additionally hold only their rank's partial sum and get psummed over
+    the axes missing from their spec.
+
+    ``partial_axes`` (pp): the loss is NOT redundant (it is masked to one
+    stage), but grads of axis-replicated params (embedding on stage 0,
+    head on the last stage) are rank-partial — psum, no redundancy
+    division.
+
+    Finally data axes take the DP mean.
     """
     redundancy = 1
     for a in model_axes:
@@ -57,7 +65,8 @@ def reduce_grads(grads, param_specs, *, data_axes: Tuple[str, ...],
 
     def red(g, spec):
         present = _spec_axes(spec)
-        psum_axes = tuple(a for a in model_axes if a not in present)
+        psum_axes = tuple(a for a in (*model_axes, *partial_axes)
+                          if a not in present)
         if psum_axes:
             g = lax.psum(g, psum_axes)
         if redundancy != 1:
@@ -130,32 +139,44 @@ def make_parallel_train_step(
     *,
     batch_axes: Sequence[str] = ("dp",),
     model_axes: Sequence[str] = ("tp", "sp"),
+    partial_axes: Sequence[str] = ("pp",),
     grad_accum_steps: int = 1,
     grad_clip_norm: Optional[float] = None,
     has_aux: bool = False,
     donate: bool = True,
+    grad_fn: Optional[Callable] = None,
 ):
-    """Build a jitted train step over an arbitrary (dp, tp[, sp]) mesh.
+    """Build a jitted train step over an arbitrary (dp, tp, pp[, sp]) mesh.
 
     ``loss_fn(params, batch)`` sees LOCAL param shards and the LOCAL batch
-    shard and may itself use collectives (e.g. tp psums inside the model).
+    shard and may itself use collectives (tp psums inside the model,
+    pipeline ppermutes for a pp loss fn built by parallel/pp.py).
+
+    ``grad_fn(params, batch) -> (loss_or_(loss,aux), grads)``: schedules
+    that compute grads without outer AD (1F1B) plug in here, replacing
+    value_and_grad + accumulate.
+
     Returns step(params, opt_state, batch) -> (params, opt_state, loss[, aux]).
     """
     data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     maxes = tuple(a for a in model_axes if a in mesh.axis_names)
-
-    o_specs = None  # filled below via opt_state_specs
+    paxes = tuple(a for a in partial_axes if a in mesh.axis_names)
 
     def local_step(params, opt_state, batch):
-        out, grads = accumulate_grads(loss_fn, params, batch,
-                                      grad_accum_steps, has_aux)
+        if grad_fn is not None:
+            out, grads = grad_fn(params, batch)
+        else:
+            out, grads = accumulate_grads(loss_fn, params, batch,
+                                          grad_accum_steps, has_aux)
         grads = reduce_grads(grads, param_specs,
-                             data_axes=data_axes, model_axes=maxes)
+                             data_axes=data_axes, model_axes=maxes,
+                             partial_axes=paxes)
         if data_axes:
             out = jax.tree.map(lambda x: lax.pmean(x, data_axes), out)
         if grad_clip_norm is not None:
+            # pp-sharded leaves are partial across pp too: include paxes
             grads, _ = clip_sharded_grads(grads, param_specs, grad_clip_norm,
-                                          model_axes=maxes)
+                                          model_axes=maxes + paxes)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, out
