@@ -231,5 +231,34 @@ def cross_entropy_loss(logits, labels):
     return jnp.mean(nll)
 
 
+def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
+    """Package the ViT as a strategy-pluggable ModelSpec
+    (parallel/strategy.py)."""
+    from quintnet_tpu.parallel.strategy import ModelSpec
+
+    def loss_fn(params, batch, tp_axis=None, sp_axis=None):
+        x, y = batch
+        return cross_entropy_loss(
+            vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat), y)
+
+    def pipeline_fns(tp_axis=None, sp_axis=None):
+        return vit_pipeline_fns(cfg, tp_axis=tp_axis, remat=remat)
+
+    def partition_specs(tp_axis=None, pp_axis=None):
+        return vit_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
+
+    def to_tp_layout(params, tp):
+        return vit_to_tp_layout(params, cfg, tp)
+
+    return ModelSpec(
+        init=lambda key: vit_init(key, cfg),
+        loss_fn=loss_fn,
+        partition_specs=partition_specs,
+        pipeline_fns=pipeline_fns,
+        to_tp_layout=to_tp_layout,
+        depth=cfg.depth,
+    )
+
+
 def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))

@@ -1,0 +1,212 @@
+"""Strategy facade: named parallelism bundles over one mesh.
+
+Reference: ``get_strategy(name, pg_manager, config, ...)`` returning a
+BaseStrategy whose ``apply(model)`` walks a coordinator that nests
+wrappers in TP->PP->DP order (strategy/__init__.py:52-105,
+coordinators/*.py). Seven strategies exist: dp, tp, pp, dp_tp, dp_pp,
+tp_pp, 3d (coordinators/__init__.py:1-20).
+
+Here a strategy is data, not machinery: which mesh axes participate in
+what role. Composition is axis coexistence on a single mesh — there is
+no wrapping order because there are no wrappers; the TP-innermost
+preference survives only as mesh layout (tp on the fastest/minor axis,
+core/mesh.py docstring).
+
+A model plugs in through :class:`ModelSpec` (init / loss / specs /
+pipeline fns); ``Strategy.make_train_step`` assembles the shard_map'd
+step via parallel/train_step.py + parallel/pp.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quintnet_tpu.core.config import Config
+from quintnet_tpu.core.mesh import MeshSpec, build_mesh
+from quintnet_tpu.parallel.pp import (
+    PipelineSpec,
+    make_afab_loss_fn,
+    make_1f1b_grad_fn,
+    validate_pp,
+)
+from quintnet_tpu.parallel.train_step import (
+    init_sharded_opt_state,
+    make_parallel_train_step,
+    shard_pytree,
+)
+
+STRATEGY_AXES = {
+    "single": (),
+    "dp": ("dp",),
+    "tp": ("tp",),
+    "pp": ("pp",),
+    "sp": ("sp",),
+    "dp_tp": ("dp", "tp"),
+    "dp_pp": ("dp", "pp"),
+    "tp_pp": ("tp", "pp"),
+    "dp_sp": ("dp", "sp"),
+    "3d": ("dp", "tp", "pp"),
+    "4d": ("dp", "tp", "pp", "sp"),
+}
+
+
+@dataclass
+class ModelSpec:
+    """What a model must provide to participate in any strategy.
+
+    ``loss_fn(params, batch, tp_axis, sp_axis)`` -> scalar (whole model,
+    non-pipelined); ``pipeline_fns(tp_axis, sp_axis)`` ->
+    (embed_fn, stage_fn, head_loss_fn) per parallel/pp.py's convention;
+    ``partition_specs(tp_axis, pp_axis)`` -> PartitionSpec pytree;
+    ``to_tp_layout(params, tp)`` -> layout fixup (fused-QKV blocking);
+    ``depth`` for pp divisibility validation.
+    """
+
+    init: Callable[[Any], Any]
+    loss_fn: Callable
+    partition_specs: Callable
+    pipeline_fns: Callable
+    to_tp_layout: Callable
+    depth: int
+
+
+@dataclass
+class Strategy:
+    name: str
+    mesh: Mesh
+    config: Config
+    batch_axes: Tuple[str, ...]
+    model_axes: Tuple[str, ...]   # redundant-loss axes (tp, sp)
+    partial_axes: Tuple[str, ...]  # pipeline axes
+
+    @property
+    def uses_pp(self) -> bool:
+        return any(self.mesh.shape.get(a, 1) > 1 for a in self.partial_axes)
+
+    def axis_or_none(self, axis: str) -> Optional[str]:
+        return axis if self.mesh.shape.get(axis, 1) > 1 else None
+
+    # -- placement helpers -------------------------------------------------
+    def param_specs(self, model: ModelSpec):
+        return model.partition_specs(
+            tp_axis=self.axis_or_none("tp"),
+            pp_axis=self.axis_or_none("pp"),
+        )
+
+    def shard_params(self, model: ModelSpec, params):
+        """Host/global params -> mesh-placed params (incl. tp layout fix)."""
+        tp = self.mesh.shape.get("tp", 1)
+        params = model.to_tp_layout(params, tp)
+        return shard_pytree(self.mesh, params, self.param_specs(model))
+
+    def shard_batch(self, batch):
+        spec = P(self.batch_axes if self.batch_axes else None)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch
+        )
+
+    def init_opt_state(self, model: ModelSpec, optimizer, params):
+        state, _ = init_sharded_opt_state(
+            optimizer, params, self.param_specs(model), self.mesh)
+        return state
+
+    # -- step construction -------------------------------------------------
+    def make_train_step(self, model: ModelSpec,
+                        optimizer: optax.GradientTransformation):
+        cfg = self.config
+        tp_axis = self.axis_or_none("tp")
+        sp_axis = self.axis_or_none("sp")
+        specs = self.param_specs(model)
+
+        if self.uses_pp:
+            validate_pp(model.depth, self.mesh.shape["pp"])
+            n_micro = cfg.training.gradient_accumulation_steps
+            embed_fn, stage_fn, head_loss_fn = model.pipeline_fns(
+                tp_axis=tp_axis, sp_axis=sp_axis)
+            pspec = PipelineSpec(n_micro=n_micro, pp_axis="pp")
+            if cfg.training.schedule.lower() in ("1f1b", "one_f_one_b"):
+                grad_fn = make_1f1b_grad_fn(
+                    embed_fn, stage_fn, head_loss_fn, pspec)
+                return make_parallel_train_step(
+                    self.mesh, None, optimizer, specs,
+                    batch_axes=self.batch_axes,
+                    model_axes=self.model_axes,
+                    partial_axes=self.partial_axes,
+                    grad_clip_norm=cfg.training.grad_clip_norm,
+                    grad_fn=grad_fn,
+                )
+            loss = make_afab_loss_fn(embed_fn, stage_fn, head_loss_fn, pspec)
+            return make_parallel_train_step(
+                self.mesh, loss, optimizer, specs,
+                batch_axes=self.batch_axes,
+                model_axes=self.model_axes,
+                partial_axes=self.partial_axes,
+                grad_clip_norm=cfg.training.grad_clip_norm,
+            )
+
+        def loss(params, batch):
+            return model.loss_fn(params, batch, tp_axis=tp_axis,
+                                 sp_axis=sp_axis)
+
+        return make_parallel_train_step(
+            self.mesh, loss, optimizer, specs,
+            batch_axes=self.batch_axes,
+            model_axes=self.model_axes,
+            partial_axes=(),
+            grad_accum_steps=cfg.training.gradient_accumulation_steps,
+            grad_clip_norm=cfg.training.grad_clip_norm,
+        )
+
+
+def get_strategy(name: Optional[str] = None, config: Optional[Config] = None,
+                 *, devices=None) -> Strategy:
+    """Build a Strategy from a name + config (reference:
+    strategy/__init__.py:52-105; names match the reference's seven plus
+    the sp upgrades).
+
+    ``name=None``/'auto' derives the strategy from which mesh axes have
+    size > 1 in ``config.mesh``.
+    """
+    config = config or Config.from_dict({})
+    sizes = dict(config.mesh.axis_sizes)
+
+    if name in (None, "auto"):
+        active = tuple(a for a, s in sizes.items() if s > 1)
+        name = next(
+            (k for k, v in STRATEGY_AXES.items() if tuple(sorted(v)) ==
+             tuple(sorted(active))), None)
+        if name is None:
+            name = "custom"
+    elif name not in STRATEGY_AXES:
+        raise ValueError(
+            f"unknown strategy {name!r}; known: {sorted(STRATEGY_AXES)}")
+
+    if name != "custom":
+        wanted = STRATEGY_AXES[name]
+        for a in wanted:
+            if sizes.get(a, 1) <= 1 and config.mesh.world_size > 1:
+                raise ValueError(
+                    f"strategy {name!r} needs mesh axis {a!r} > 1; mesh is "
+                    f"{sizes}")
+
+    # mesh always carries every configured axis (size-1 axes are free)
+    spec = MeshSpec.from_config(config.mesh)
+    mesh = build_mesh(spec, devices)
+
+    batch_axes = tuple(a for a in ("dp",) if a in sizes)
+    model_axes = tuple(a for a in ("tp", "sp") if sizes.get(a, 1) > 1)
+    partial_axes = tuple(a for a in ("pp",) if sizes.get(a, 1) > 1)
+
+    return Strategy(
+        name=name,
+        mesh=mesh,
+        config=config,
+        batch_axes=batch_axes,
+        model_axes=model_axes,
+        partial_axes=partial_axes,
+    )

@@ -1,0 +1,124 @@
+"""Strategy facade + hybrid (2D/3D) integration tests.
+
+The reference's 3D integration test is an empty TODO class
+(tests/test_hybrid.py:10-19); these are the real thing: every strategy
+in the registry produces the same loss and parameter update as
+single-device training on the global batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from quintnet_tpu.core.config import Config
+from quintnet_tpu.models.vit import (
+    ViTConfig,
+    cross_entropy_loss,
+    vit_apply,
+    vit_init,
+    vit_model_spec,
+    vit_to_tp_layout,
+)
+from quintnet_tpu.parallel.strategy import get_strategy
+
+CFG = ViTConfig(image_size=14, patch_size=7, in_channels=1, hidden_dim=16,
+                depth=4, num_heads=2, num_classes=10)
+
+
+def _config(mesh_dim, mesh_name, schedule="afab", grad_acc=1):
+    return Config.from_dict({
+        "mesh_dim": list(mesh_dim),
+        "mesh_name": list(mesh_name),
+        "training": {
+            "batch_size": 16,
+            "gradient_accumulation_steps": grad_acc,
+            "schedule": schedule,
+            "grad_clip_norm": None,
+        },
+    })
+
+
+def _data(n=16):
+    x = jax.random.normal(jax.random.key(1), (n, 14, 14, 1))
+    y = jax.random.randint(jax.random.key(2), (n,), 0, 10)
+    return x, y
+
+
+def _reference_update(params, batch, opt):
+    def loss_fn(p):
+        x, y = batch
+        return cross_entropy_loss(vit_apply(p, x, CFG), y)
+
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    p2 = optax.apply_updates(params, opt.update(g, opt.init(params), params)[0])
+    return loss, p2
+
+
+def _run_strategy(name, cfg, params, batch):
+    strat = get_strategy(name, cfg)
+    model = vit_model_spec(CFG)
+    opt = optax.sgd(0.05)
+    p = strat.shard_params(model, params)
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch(batch)
+    step = strat.make_train_step(model, opt)
+    p2, _, loss = step(p, s, b)
+    return float(loss), p2
+
+
+@pytest.mark.parametrize(
+    "name,mesh_dim,mesh_name,schedule,grad_acc",
+    [
+        ("dp", [8], ["dp"], "afab", 1),
+        ("tp", [2], ["tp"], "afab", 1),
+        ("pp", [4], ["pp"], "afab", 4),
+        ("pp", [4], ["pp"], "1f1b", 4),
+        ("dp_tp", [4, 2], ["dp", "tp"], "afab", 1),
+        ("dp_pp", [2, 4], ["dp", "pp"], "1f1b", 4),
+        ("tp_pp", [2, 4], ["tp", "pp"], "1f1b", 2),
+        ("3d", [2, 2, 2], ["dp", "tp", "pp"], "1f1b", 2),
+        ("3d", [2, 2, 2], ["dp", "tp", "pp"], "afab", 2),
+    ],
+)
+def test_strategy_matches_single_device(name, mesh_dim, mesh_name,
+                                        schedule, grad_acc):
+    cfg = _config(mesh_dim, mesh_name, schedule, grad_acc)
+    params = vit_init(jax.random.key(0), CFG)
+    batch = _data()
+    opt = optax.sgd(0.05)
+
+    loss_ref, p_ref = _reference_update(params, batch, opt)
+    loss, p2 = _run_strategy(name, cfg, params, batch)
+
+    np.testing.assert_allclose(loss, float(loss_ref), rtol=1e-5)
+
+    tp = cfg.tp_size
+    p_ref_layout = vit_to_tp_layout(p_ref, CFG, tp)
+    flat = jax.tree_util.tree_leaves_with_path(p2)
+    ref = dict(jax.tree_util.tree_leaves_with_path(p_ref_layout))
+    for path, leaf in flat:
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(leaf)), np.asarray(ref[path]),
+            rtol=2e-4, atol=1e-5, err_msg=f"{name}:{path}")
+
+
+def test_auto_strategy_derivation():
+    cfg = _config([2, 2, 2], ["dp", "tp", "pp"])
+    strat = get_strategy("auto", cfg)
+    assert strat.name == "3d"
+    assert strat.batch_axes == ("dp",)
+    assert strat.model_axes == ("tp",)
+    assert strat.partial_axes == ("pp",)
+
+
+def test_unknown_strategy_rejected():
+    with pytest.raises(ValueError):
+        get_strategy("5d_hype", _config([1], ["dp"]))
+
+
+def test_strategy_axis_mismatch_rejected():
+    cfg = _config([2, 4], ["dp", "pp"])
+    with pytest.raises(ValueError):
+        get_strategy("tp", cfg)
