@@ -36,6 +36,7 @@ from quintnet_tpu.parallel.pp import (
 )
 from quintnet_tpu.parallel.train_step import (
     init_sharded_opt_state,
+    init_zero1_opt_state,
     make_parallel_train_step,
     shard_pytree,
 )
@@ -110,7 +111,21 @@ class Strategy:
             lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch
         )
 
+    @property
+    def zero1_axis(self) -> Optional[str]:
+        """ZeRO-1 shards optimizer state over dp when the config asks for
+        a zero1_* optimizer (reference stub: optimizers/zero.py)."""
+        if (self.config.training.optimizer.startswith("zero1")
+                and self.mesh.shape.get("dp", 1) > 1):
+            return "dp"
+        return None
+
     def init_opt_state(self, model: ModelSpec, optimizer, params):
+        if self.zero1_axis is not None:
+            state, _ = init_zero1_opt_state(
+                optimizer, params, self.param_specs(model), self.mesh,
+                axis=self.zero1_axis)
+            return state
         state, _ = init_sharded_opt_state(
             optimizer, params, self.param_specs(model), self.mesh)
         return state
@@ -139,6 +154,7 @@ class Strategy:
                     partial_axes=self.partial_axes,
                     grad_clip_norm=cfg.training.grad_clip_norm,
                     grad_fn=grad_fn,
+                    zero1_axis=self.zero1_axis,
                 )
             loss = make_afab_loss_fn(embed_fn, stage_fn, head_loss_fn, pspec)
             return make_parallel_train_step(
@@ -147,6 +163,7 @@ class Strategy:
                 model_axes=self.model_axes,
                 partial_axes=self.partial_axes,
                 grad_clip_norm=cfg.training.grad_clip_norm,
+                zero1_axis=self.zero1_axis,
             )
 
         def loss(params, batch):
@@ -160,6 +177,7 @@ class Strategy:
             partial_axes=(),
             grad_accum_steps=cfg.training.gradient_accumulation_steps,
             grad_clip_norm=cfg.training.grad_clip_norm,
+            zero1_axis=self.zero1_axis,
         )
 
 

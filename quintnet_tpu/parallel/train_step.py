@@ -131,6 +131,20 @@ def init_sharded_opt_state(optimizer, params, param_specs, mesh: Mesh):
     return state, specs
 
 
+def init_zero1_opt_state(optimizer, params, param_specs, mesh: Mesh,
+                         *, axis: str = "dp"):
+    """Initialise a dp-sharded (ZeRO-1) optimizer state (parallel/zero.py)."""
+    from quintnet_tpu.parallel import zero
+
+    init_local, _ = zero.make_zero1(optimizer, axis=axis)
+    p_template = jax.eval_shape(lambda t: t, params)
+    local_t = zero.local_template(p_template, param_specs, mesh)
+    specs = zero.state_specs(optimizer, local_t, mesh, axis=axis)
+    fn = cc.shard_map_fn(init_local, mesh, in_specs=(param_specs,),
+                         out_specs=specs)
+    return jax.jit(fn)(params), specs
+
+
 def make_parallel_train_step(
     mesh: Mesh,
     loss_fn: Callable,
@@ -145,6 +159,7 @@ def make_parallel_train_step(
     has_aux: bool = False,
     donate: bool = True,
     grad_fn: Optional[Callable] = None,
+    zero1_axis: Optional[str] = None,
 ):
     """Build a jitted train step over an arbitrary (dp, tp, pp[, sp]) mesh.
 
@@ -177,8 +192,14 @@ def make_parallel_train_step(
             # pp-sharded leaves are partial across pp too: include paxes
             grads, _ = clip_sharded_grads(grads, param_specs, grad_clip_norm,
                                           model_axes=maxes + paxes)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if zero1_axis is not None:
+            from quintnet_tpu.parallel import zero
+
+            _, update_local = zero.make_zero1(optimizer, axis=zero1_axis)
+            params, opt_state = update_local(grads, opt_state, params)
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         return params, opt_state, out
 
     # opt state specs need a params template; derive lazily on first call
@@ -187,7 +208,15 @@ def make_parallel_train_step(
 
     def step(params, opt_state, batch):
         if "fn" not in compiled:
-            o_specs = opt_state_specs(optimizer, params, param_specs)
+            if zero1_axis is not None:
+                from quintnet_tpu.parallel import zero
+
+                p_template = jax.eval_shape(lambda t: t, params)
+                local_t = zero.local_template(p_template, param_specs, mesh)
+                o_specs = zero.state_specs(optimizer, local_t, mesh,
+                                           axis=zero1_axis)
+            else:
+                o_specs = opt_state_specs(optimizer, params, param_specs)
             batch_spec = P(data_axes if data_axes else None)
             smapped = cc.shard_map_fn(
                 local_step,

@@ -1,0 +1,132 @@
+"""ZeRO-1: optimizer state sharded over the data-parallel axis.
+
+The reference declares this and never implements it (optimizers/zero.py
+and optimizers/distributed_adamw.py are TODO stubs, 1-7; BASELINE.json's
+north-star config nonetheless requires "ZeRO-1 distributed_adamw").
+
+Scheme: the device-local parameter pytree (already tp/pp-sharded) is
+flattened to one vector, padded to a multiple of dp_size, and split into
+equal contiguous chunks; dp rank r owns chunk r. The inner optax
+optimizer (AdamW etc.) runs on the chunk only, so its state (m, v) costs
+1/dp of the replicated footprint. Updated chunks are re-assembled with
+one all-gather on the dp axis.
+
+Comm per step: grad allreduce (mean) + param all-gather — the classic
+ZeRO-1 exchange. Chunk contents differ across tp/pp coordinates as well,
+so globally the chunk state is sharded over EVERY mesh axis
+(:func:`state_specs` uses P((all mesh axes,)) on the flat dim).
+
+Requires a uniform param dtype (ravel_pytree concatenates into one
+vector); mixed-precision param trees should keep a uniform master dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quintnet_tpu.core import collectives as cc
+
+
+def _chunk_size(n_local: int, dp: int) -> int:
+    return -(-n_local // dp)
+
+
+def flatten_local(tree):
+    """Local pytree -> (flat vector, unravel fn)."""
+    return ravel_pytree(tree)
+
+
+def local_chunk(flat, dp: int, rank, chunk: int):
+    padded = jnp.pad(flat, (0, chunk * dp - flat.shape[0]))
+    return lax.dynamic_slice_in_dim(padded, rank * chunk, chunk)
+
+
+def make_zero1(
+    optimizer: optax.GradientTransformation,
+    *,
+    axis: str = "dp",
+):
+    """Return (init_local, update_local) for use inside shard_map.
+
+    - ``init_local(params_local) -> opt_state`` (chunk-shaped);
+    - ``update_local(grads_local, opt_state, params_local) ->
+      (new_params_local, new_opt_state)``. ``grads_local`` must already be
+      fully reduced (post reduce_grads INCLUDING the dp mean).
+    """
+
+    def init_local(params):
+        flat, _ = ravel_pytree(params)
+        dp = lax.axis_size(axis)
+        chunk = _chunk_size(flat.shape[0], dp)
+        r = lax.axis_index(axis)
+        return optimizer.init(local_chunk(flat, dp, r, chunk))
+
+    def update_local(grads, opt_state, params):
+        flat_p, unravel = ravel_pytree(params)
+        flat_g, _ = ravel_pytree(grads)
+        dp = lax.axis_size(axis)
+        chunk = _chunk_size(flat_p.shape[0], dp)
+        r = lax.axis_index(axis)
+        p_chunk = local_chunk(flat_p, dp, r, chunk)
+        g_chunk = local_chunk(flat_g, dp, r, chunk)
+        updates, opt_state = optimizer.update(g_chunk, opt_state, p_chunk)
+        p_chunk = optax.apply_updates(p_chunk, updates)
+        flat_new = cc.all_gather(p_chunk, axis, gather_dim=0)  # [dp*chunk]
+        flat_new = flat_new[: flat_p.shape[0]]
+        return unravel(flat_new), opt_state
+
+    return init_local, update_local
+
+
+def state_specs(
+    optimizer: optax.GradientTransformation,
+    params_local_template,
+    mesh: Mesh,
+    *,
+    axis: str = "dp",
+):
+    """PartitionSpec tree for the chunked optimizer state.
+
+    Chunk-shaped leaves get P((every mesh axis,)) on their flat dim —
+    each device holds a distinct chunk; scalars are replicated.
+    ``params_local_template``: ShapeDtypeStructs of the LOCAL param tree
+    (i.e. global shapes divided by their tp/pp sharding).
+    """
+    flat_template = jax.eval_shape(lambda t: ravel_pytree(t)[0],
+                                   params_local_template)
+    dp = mesh.shape.get(axis, 1)
+    chunk = _chunk_size(flat_template.shape[0], dp)
+    chunk_t = jax.ShapeDtypeStruct((chunk,), flat_template.dtype)
+    state_shape = jax.eval_shape(optimizer.init, chunk_t)
+    all_axes = tuple(mesh.axis_names)
+    chunk_spec = P(all_axes if len(all_axes) > 1 else all_axes[0])
+    return optax.tree_map_params(
+        optimizer,
+        lambda _leaf: chunk_spec if _leaf.ndim else P(),
+        state_shape,
+        transform_non_params=lambda _leaf: P(),
+    )
+
+
+def local_template(params_global_template, param_specs, mesh: Mesh):
+    """Global param ShapeDtypeStructs -> local (per-device) shapes given
+    their PartitionSpecs."""
+
+    def shrink(t, spec):
+        shape = list(t.shape)
+        for d, part in enumerate(spec):
+            if part is None:
+                continue
+            parts = part if isinstance(part, tuple) else (part,)
+            for a in parts:
+                shape[d] //= mesh.shape.get(a, 1)
+        return jax.ShapeDtypeStruct(tuple(shape), t.dtype)
+
+    return jax.tree.map(shrink, params_global_template, param_specs)
