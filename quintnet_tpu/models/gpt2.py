@@ -1,0 +1,242 @@
+"""GPT-2 family (124M "base" through XL) for causal LM / finetuning.
+
+TPU-native re-design of the reference's GPT-2 stack
+(utils/GPT2/{gpt2_config,gpt2_embeddings,gpt2_attention,gpt2_mlp,
+gpt2_block,gpt2_stage}.py). Notable differences:
+
+- One whole-model definition (the reference has no full-model class —
+  gpt2_model.py is a 3-line placeholder; GPT-2 exists only as pipeline
+  stages). Pipelining here is a view over the same param tree.
+- Weights stored [in, out] so forward is x @ w; HF GPT-2's Conv1D
+  weights are already [in, out], so the import path needs NO transpose
+  (the reference transposes every matrix to torch Linear layout —
+  core/distributed_loading.py:295-306, 331-341).
+- Weight tying: ``lm_head = wte`` is literally the same array. Under
+  pipeline parallelism wte is replicated across pp; stage 0 produces the
+  embedding grad, the last stage the lm-head grad, and the standard
+  partial_axes psum (parallel/train_step.py) sums them — the reference
+  needs a dedicated ``sync_tied_weights_grad`` allreduce after every
+  backward (gpt2_stage.py:112-141, GPT2_Trainer.py:290-291, 347-348).
+- Blocks reuse nn/transformer.py (same pytree schema as ViT): pre-LN,
+  fused QKV, GELU(tanh) — matching HF gpt2's gelu_new.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from quintnet_tpu.core.pytree import tree_stack
+from quintnet_tpu.nn.layers import (
+    embedding_init,
+    gelu,
+    layer_norm_apply,
+    layer_norm_init,
+)
+from quintnet_tpu.nn.transformer import block_init, stacked_blocks_apply
+
+IGNORE_INDEX = -100  # reference: CE ignore_index=-100 (GPT2_Trainer.py:109)
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    """Sizes follow the reference's presets (gpt2_config.py:22-168)."""
+
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.0
+
+    @property
+    def mlp_hidden(self) -> int:
+        return 4 * self.n_embd
+
+    @staticmethod
+    def base() -> "GPT2Config":
+        return GPT2Config()
+
+    @staticmethod
+    def medium() -> "GPT2Config":
+        return GPT2Config(n_embd=1024, n_layer=24, n_head=16)
+
+    @staticmethod
+    def large() -> "GPT2Config":
+        return GPT2Config(n_embd=1280, n_layer=36, n_head=20)
+
+    @staticmethod
+    def xl() -> "GPT2Config":
+        return GPT2Config(n_embd=1600, n_layer=48, n_head=25)
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        """Test-scale config (not in the reference; used by the simulated-
+        mesh test suite)."""
+        d = dict(vocab_size=128, n_positions=64, n_embd=32, n_layer=4,
+                 n_head=4)
+        d.update(kw)
+        return GPT2Config(**d)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "GPT2Config":
+        names = {f.name for f in dataclasses.fields(GPT2Config)}
+        return GPT2Config(**{k: v for k, v in d.items() if k in names})
+
+
+def gpt2_init(key, cfg: GPT2Config, *, dtype=jnp.float32):
+    k_wte, k_wpe, k_blocks = jax.random.split(key, 3)
+    block_keys = jax.random.split(k_blocks, cfg.n_layer)
+    blocks = tree_stack(
+        [block_init(bk, cfg.n_embd, mlp_hidden=cfg.mlp_hidden, dtype=dtype)
+         for bk in block_keys]
+    )
+    return {
+        "embedding": {
+            "wte": embedding_init(k_wte, cfg.vocab_size, cfg.n_embd,
+                                  dtype=dtype)["table"],
+            "wpe": embedding_init(k_wpe, cfg.n_positions, cfg.n_embd,
+                                  scale=0.01, dtype=dtype)["table"],
+        },
+        "blocks": blocks,
+        "head": {"ln_f": layer_norm_init(cfg.n_embd, dtype)},
+    }
+
+
+def gpt2_embed(params, input_ids):
+    """[B, T] ids -> [B, T, D] (reference GPT2Embedding, replicated across
+    TP — gpt2_embeddings.py:16-103)."""
+    emb = params["embedding"]
+    T = input_ids.shape[-1]
+    tok = jnp.take(emb["wte"], input_ids, axis=0)
+    pos = emb["wpe"][:T]
+    return tok + pos[None, :, :]
+
+
+def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
+                tp_axis: Optional[str] = None, remat: bool = False,
+                use_flash: bool = False):
+    tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
+    return stacked_blocks_apply(
+        params_blocks, h,
+        num_heads=cfg.n_head // tp,
+        causal=True,
+        act=gelu,
+        tp_axis=tp_axis,
+        remat=remat,
+        use_flash=use_flash,
+    )
+
+
+def gpt2_logits(params, h, cfg: GPT2Config):
+    """ln_f then tied lm_head: logits = ln_f(h) @ wte^T
+    (reference: lm_head is a copy of wte synced by hand,
+    gpt2_stage.py:112-141; here it IS wte)."""
+    h = layer_norm_apply(params["head"]["ln_f"], h, eps=cfg.layer_norm_epsilon)
+    return jnp.dot(h, params["embedding"]["wte"].T).astype(jnp.float32)
+
+
+def gpt2_apply(params, input_ids, cfg: GPT2Config, *,
+               tp_axis: Optional[str] = None, remat: bool = False,
+               use_flash: bool = False):
+    h = gpt2_embed(params, input_ids)
+    h = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis, remat=remat,
+                    use_flash=use_flash)
+    return gpt2_logits(params, h, cfg)
+
+
+def clm_loss(logits, labels):
+    """Shifted causal-LM cross entropy with IGNORE_INDEX masking, mean
+    over valid tokens (reference: HF-internal shift + CE ignore_index=-100,
+    GPT2_Trainer.py:105-118)."""
+    logits = logits[:, :-1]
+    targets = labels[:, 1:]
+    valid = targets != IGNORE_INDEX
+    safe = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / count
+
+
+def perplexity(loss):
+    """exp(loss) with the reference's overflow guard at 20
+    (GPT2_Trainer.py:316-318, schedule.py:505-516)."""
+    return jnp.exp(jnp.minimum(loss, 20.0))
+
+
+def gpt2_partition_specs(cfg: Optional[GPT2Config] = None, *,
+                         tp_axis: Optional[str] = "tp",
+                         pp_axis: Optional[str] = None):
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.parallel.tp import block_specs
+
+    return {
+        "embedding": {"wte": P(), "wpe": P()},
+        "blocks": block_specs(tp_axis=tp_axis, stacked=True, pp_axis=pp_axis),
+        "head": {"ln_f": {"scale": P(), "bias": P()}},
+    }
+
+
+def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
+    """Standard [q|k|v] fused-QKV columns -> tp-blocked layout
+    (parallel/tp.py docstring). Identity at tp=1."""
+    from quintnet_tpu.parallel.tp import qkv_blocked_from_standard
+
+    if tp == 1:
+        return params
+    out = jax.tree.map(lambda x: x, params)
+    qkv = out["blocks"]["attn"]["qkv"]
+    qkv["w"] = qkv_blocked_from_standard(qkv["w"], cfg.n_head, tp)
+    if "b" in qkv:
+        qkv["b"] = qkv_blocked_from_standard(qkv["b"], cfg.n_head, tp)
+    return out
+
+
+def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
+                      remat: bool = False, use_flash: bool = False):
+    """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py."""
+
+    def embed_fn(params, input_ids):
+        return gpt2_embed(params, input_ids)
+
+    def stage_fn(blocks_local, h):
+        return gpt2_blocks(blocks_local, h, cfg, tp_axis=tp_axis,
+                           remat=remat, use_flash=use_flash)
+
+    def head_loss_fn(params, h, labels):
+        return clm_loss(gpt2_logits(params, h, cfg), labels)
+
+    return embed_fn, stage_fn, head_loss_fn
+
+
+def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
+                    use_flash: bool = False):
+    from quintnet_tpu.parallel.strategy import ModelSpec
+
+    def loss_fn(params, batch, tp_axis=None, sp_axis=None):
+        input_ids, labels = batch
+        logits = gpt2_apply(params, input_ids, cfg, tp_axis=tp_axis,
+                            remat=remat, use_flash=use_flash)
+        return clm_loss(logits, labels)
+
+    def pipeline_fns(tp_axis=None, sp_axis=None):
+        return gpt2_pipeline_fns(cfg, tp_axis=tp_axis, remat=remat,
+                                 use_flash=use_flash)
+
+    return ModelSpec(
+        init=lambda key: gpt2_init(key, cfg),
+        loss_fn=loss_fn,
+        partition_specs=lambda tp_axis=None, pp_axis=None:
+            gpt2_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis),
+        pipeline_fns=pipeline_fns,
+        to_tp_layout=lambda p, tp: gpt2_to_tp_layout(p, cfg, tp),
+        depth=cfg.n_layer,
+    )

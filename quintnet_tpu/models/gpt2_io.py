@@ -1,0 +1,146 @@
+"""GPT-2 checkpoint import/export: HF safetensors <-> quintnet_tpu trees.
+
+Covers the reference's three checkpoint paths in one module:
+- sharded pretrained load (core/distributed_loading.py:203-376) — here
+  :func:`load_hf_gpt2` reads the HF file lazily (mmap) into the host
+  tree and the Strategy places shards; per-(tp,pp) byte-level slicing
+  is unnecessary on TPU hosts but the reader supports it (memmap views);
+- per-shard save + offline merge to HF (GPT2_Trainer.py:453-507,
+  merge_checkpoints.py:191-244) — here :func:`save_hf_gpt2` writes a
+  standard HF-layout file directly from the (gathered) param tree;
+- Conv1D transposes (distributed_loading.py:295-306): NOT needed —
+  HF GPT-2 Conv1D weights are [in, out], which is this framework's
+  native layout.
+
+HF key schema handled: optional "transformer." prefix, "h.{i}." blocks,
+attention mask buffers ("attn.bias"/"attn.masked_bias") skipped,
+"lm_head.weight" skipped (tied to wte).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from quintnet_tpu.models.gpt2 import GPT2Config
+from quintnet_tpu.core.pytree import tree_stack
+from quintnet_tpu.utils import safetensors_io as st
+
+
+def _norm_key(k: str) -> str:
+    return k[len("transformer."):] if k.startswith("transformer.") else k
+
+
+def load_hf_gpt2(path: str, cfg: Optional[GPT2Config] = None,
+                 *, dtype=jnp.float32):
+    """HF gpt2 safetensors file -> (params tree, GPT2Config).
+
+    The returned tree uses the standard [q|k|v] fused-QKV layout;
+    ``Strategy.shard_params`` applies the tp-blocked permutation.
+    """
+    def _skip(k: str) -> bool:
+        # causal-mask buffers ("...attn.bias"/"...attn.masked_bias" — NOT
+        # "c_attn.bias") and the tied lm_head
+        tail = k.split(".")[-2:]
+        return tail in (["attn", "bias"], ["attn", "masked_bias"]) \
+            or _norm_key(k) == "lm_head.weight"
+
+    with st.SafeTensorFile(path) as f:
+        t = {_norm_key(k): f.tensor(k) for k in f.keys() if not _skip(k)}
+
+    wte = t["wte.weight"]
+    wpe = t["wpe.weight"]
+    n_layer = 1 + max(int(k.split(".")[1]) for k in t if k.startswith("h."))
+    if cfg is None:
+        cfg = GPT2Config.from_dict({
+            "vocab_size": wte.shape[0],
+            "n_positions": wpe.shape[0],
+            "n_embd": wte.shape[1],
+            "n_layer": n_layer,
+            "n_head": 12 if wte.shape[1] == 768 else
+                      16 if wte.shape[1] == 1024 else
+                      20 if wte.shape[1] == 1280 else 25,
+        })
+
+    def arr(x):
+        return jnp.asarray(x, dtype)
+
+    def block(i):
+        p = f"h.{i}."
+        return {
+            "ln1": {"scale": arr(t[p + "ln_1.weight"]),
+                    "bias": arr(t[p + "ln_1.bias"])},
+            "attn": {
+                "qkv": {"w": arr(t[p + "attn.c_attn.weight"]),
+                        "b": arr(t[p + "attn.c_attn.bias"])},
+                "proj": {"w": arr(t[p + "attn.c_proj.weight"]),
+                         "b": arr(t[p + "attn.c_proj.bias"])},
+            },
+            "ln2": {"scale": arr(t[p + "ln_2.weight"]),
+                    "bias": arr(t[p + "ln_2.bias"])},
+            "mlp": {
+                "fc": {"w": arr(t[p + "mlp.c_fc.weight"]),
+                       "b": arr(t[p + "mlp.c_fc.bias"])},
+                "proj": {"w": arr(t[p + "mlp.c_proj.weight"]),
+                         "b": arr(t[p + "mlp.c_proj.bias"])},
+            },
+        }
+
+    params = {
+        "embedding": {"wte": arr(wte), "wpe": arr(wpe)},
+        "blocks": tree_stack([block(i) for i in range(cfg.n_layer)]),
+        "head": {"ln_f": {"scale": arr(t["ln_f.weight"]),
+                          "bias": arr(t["ln_f.bias"])}},
+    }
+    return params, cfg
+
+
+def save_hf_gpt2(params, cfg: GPT2Config, path: str,
+                 *, prefix: str = "", tp_layout: int = 1) -> None:
+    """Param tree -> HF-layout safetensors (merge_checkpoints.py
+    semantics: one file loadable by transformers GPT2LMHeadModel).
+
+    ``tp_layout``: if the tree is in tp-blocked QKV layout, pass the tp
+    size used so columns are permuted back to standard [q|k|v].
+    """
+    from quintnet_tpu.parallel.tp import qkv_standard_from_blocked
+
+    def n(x):
+        return np.asarray(jnp.asarray(x, jnp.float32))
+
+    out: Dict[str, np.ndarray] = {
+        prefix + "wte.weight": n(params["embedding"]["wte"]),
+        prefix + "wpe.weight": n(params["embedding"]["wpe"]),
+        prefix + "ln_f.weight": n(params["head"]["ln_f"]["scale"]),
+        prefix + "ln_f.bias": n(params["head"]["ln_f"]["bias"]),
+    }
+    blocks = params["blocks"]
+    for i in range(cfg.n_layer):
+        p = f"{prefix}h.{i}."
+        blk = _index_block(blocks, i)
+        qkv_w = blk["attn"]["qkv"]["w"]
+        qkv_b = blk["attn"]["qkv"]["b"]
+        if tp_layout > 1:
+            qkv_w = qkv_standard_from_blocked(qkv_w, cfg.n_head, tp_layout)
+            qkv_b = qkv_standard_from_blocked(qkv_b, cfg.n_head, tp_layout)
+        out[p + "ln_1.weight"] = n(blk["ln1"]["scale"])
+        out[p + "ln_1.bias"] = n(blk["ln1"]["bias"])
+        out[p + "attn.c_attn.weight"] = n(qkv_w)
+        out[p + "attn.c_attn.bias"] = n(qkv_b)
+        out[p + "attn.c_proj.weight"] = n(blk["attn"]["proj"]["w"])
+        out[p + "attn.c_proj.bias"] = n(blk["attn"]["proj"]["b"])
+        out[p + "ln_2.weight"] = n(blk["ln2"]["scale"])
+        out[p + "ln_2.bias"] = n(blk["ln2"]["bias"])
+        out[p + "mlp.c_fc.weight"] = n(blk["mlp"]["fc"]["w"])
+        out[p + "mlp.c_fc.bias"] = n(blk["mlp"]["fc"]["b"])
+        out[p + "mlp.c_proj.weight"] = n(blk["mlp"]["proj"]["w"])
+        out[p + "mlp.c_proj.bias"] = n(blk["mlp"]["proj"]["b"])
+    st.save_file(out, path, metadata={"format": "pt"})
+
+
+def _index_block(stacked, i: int):
+    import jax
+
+    return jax.tree.map(lambda x: x[i], stacked)

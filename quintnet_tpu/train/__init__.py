@@ -1,0 +1,1 @@
+"""Training loop layer: trainers, metrics, checkpointing, logging."""

@@ -1,0 +1,1 @@
+"""Utilities: safetensors IO, logging, memory, profiling."""

@@ -1,0 +1,141 @@
+"""Minimal pure-Python safetensors reader/writer.
+
+The reference reads HF GPT-2 shards with ``safetensors.safe_open`` +
+``get_slice`` so each rank touches only its bytes
+(core/distributed_loading.py:262-374). This module reimplements the
+format (8-byte LE header length, JSON header with dtype/shape/
+data_offsets, raw row-major payload) with numpy + mmap so:
+
+- no dependency on the safetensors package;
+- :class:`SafeTensorFile` exposes zero-copy memmap views — slicing a
+  tensor reads only the pages the slice touches, which is exactly the
+  per-shard lazy-load behavior the reference gets from safe_open.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import struct
+from typing import Any, Dict, Iterable, Mapping, Optional
+
+import numpy as np
+
+try:  # bf16 support (ml_dtypes ships with jax)
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+_DTYPES = {
+    "F64": np.dtype("<f8"),
+    "F32": np.dtype("<f4"),
+    "F16": np.dtype("<f2"),
+    "I64": np.dtype("<i8"),
+    "I32": np.dtype("<i4"),
+    "I16": np.dtype("<i2"),
+    "I8": np.dtype("i1"),
+    "U8": np.dtype("u1"),
+    "BOOL": np.dtype("bool"),
+}
+if _BF16 is not None:
+    _DTYPES["BF16"] = _BF16
+_DTYPE_NAMES = {v: k for k, v in _DTYPES.items()}
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    dt = np.dtype(dt)
+    if dt in _DTYPE_NAMES:
+        return _DTYPE_NAMES[dt]
+    # map platform-endian aliases
+    for name, ref in _DTYPES.items():
+        if dt == ref:
+            return name
+    raise ValueError(f"unsupported dtype {dt}")
+
+
+def save_file(tensors: Mapping[str, np.ndarray], path: str,
+              metadata: Optional[Dict[str, str]] = None) -> None:
+    """Write a safetensors file (sorted keys, contiguous payload)."""
+    header: Dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
+    offset = 0
+    arrays = {}
+    for name in sorted(tensors):
+        arr = np.ascontiguousarray(tensors[name])
+        n = arr.nbytes
+        header[name] = {
+            "dtype": _dtype_name(arr.dtype),
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + n],
+        }
+        arrays[name] = arr
+        offset += n
+    blob = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    pad = (-(len(blob)) % 8)
+    blob += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for name in sorted(arrays):
+            f.write(arrays[name].tobytes())
+
+
+class SafeTensorFile:
+    """Lazy safetensors reader over one mmap.
+
+    ``f[name]`` returns a read-only memmap view (zero copy); slice it to
+    read only what you need — the analogue of the reference's
+    ``safe_open(...).get_slice(name)[rows, cols]``.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        (hlen,) = struct.unpack("<Q", self._mm[:8])
+        self.header: Dict[str, Any] = json.loads(
+            self._mm[8 : 8 + hlen].decode("utf-8"))
+        self.metadata = self.header.pop("__metadata__", {})
+        self._data_start = 8 + hlen
+
+    def keys(self) -> Iterable[str]:
+        return self.header.keys()
+
+    def shape(self, name) -> tuple:
+        return tuple(self.header[name]["shape"])
+
+    def __contains__(self, name) -> bool:
+        return name in self.header
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        info = self.header[name]
+        dt = _DTYPES[info["dtype"]]
+        s, e = info["data_offsets"]
+        buf = np.frombuffer(
+            self._mm, dtype=dt,
+            count=(e - s) // dt.itemsize,
+            offset=self._data_start + s,
+        )
+        return buf.reshape(info["shape"])
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Materialised copy (writable)."""
+        return np.array(self[name])
+
+    def close(self):
+        self._mm.close()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def load_file(path: str) -> Dict[str, np.ndarray]:
+    with SafeTensorFile(path) as f:
+        return {k: f.tensor(k) for k in f.keys()}
