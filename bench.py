@@ -1,0 +1,127 @@
+"""Benchmark: GPT-2 124M training throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no throughput numbers anywhere (BASELINE.md:21),
+so vs_baseline is reported against a fixed reference point derived from
+the reference's own hardware story: its GPT-2 run config processes a
+512-sample global batch per step on 8xA100 (micro 32 x grad_acc 8 x dp2,
+examples/gpt2_config.yaml); lacking its samples/sec we normalise to 1.0
+and additionally report measured MFU in the JSON extras.
+
+Usage: python bench.py [--model gpt2|vit] [--steps 20] [--batch N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def flops_per_token_gpt2(cfg) -> float:
+    """Approximate training FLOPs/token: 6 * N params (fwd+bwd) plus
+    attention term 12 * n_layer * n_embd * seq."""
+    n_params = (
+        cfg.vocab_size * cfg.n_embd
+        + cfg.n_positions * cfg.n_embd
+        + cfg.n_layer * (12 * cfg.n_embd * cfg.n_embd + 13 * cfg.n_embd)
+    )
+    return 6.0 * n_params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2", choices=["gpt2", "vit"])
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    n_dev = len(jax.devices())
+    cfg = Config.from_dict({
+        "mesh_dim": [n_dev], "mesh_name": ["dp"],
+        "training": {"batch_size": args.batch * n_dev,
+                     "optimizer": "adamw", "grad_clip_norm": 1.0,
+                     "remat": True},
+    })
+    strat = get_strategy("auto" if n_dev > 1 else "dp", cfg)
+
+    if args.model == "gpt2":
+        from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
+
+        gcfg = GPT2Config.base()
+        model = gpt2_model_spec(gcfg, remat=True)
+        ids = np.random.default_rng(0).integers(
+            0, gcfg.vocab_size, size=(args.batch * n_dev, args.seq),
+            dtype=np.int32)
+        batch = (jnp.asarray(ids), jnp.asarray(ids))
+        flops_per_step = (flops_per_token_gpt2(gcfg)
+                          * args.batch * n_dev * args.seq)
+        metric = f"gpt2_124m_seq{args.seq}_train_samples_per_sec_per_chip"
+    else:
+        from quintnet_tpu.models.vit import ViTConfig, vit_model_spec
+
+        vcfg = ViTConfig(hidden_dim=64, depth=8, num_heads=4)
+        model = vit_model_spec(vcfg)
+        x = np.random.default_rng(0).normal(
+            size=(args.batch * n_dev, 28, 28, 1)).astype(np.float32)
+        y = np.random.default_rng(1).integers(0, 10, size=(args.batch * n_dev,))
+        batch = (jnp.asarray(x), jnp.asarray(y.astype(np.int32)))
+        n_params = 0
+        flops_per_step = 6.0 * 800_000 * args.batch * n_dev  # ~0.8M params
+        metric = "vit_mnist_train_samples_per_sec_per_chip"
+
+    opt = optax.adamw(1e-4)
+    params = strat.shard_params(model, model.init(jax.random.key(0)))
+    opt_state = strat.init_opt_state(model, opt, params)
+    b = strat.shard_batch(batch)
+    step = strat.make_train_step(model, opt)
+
+    # compile + warmup. NOTE: float(loss) (device->host copy) is the sync
+    # barrier — jax.block_until_ready returns early on the tunneled
+    # 'axon' TPU platform in this environment.
+    for _ in range(args.warmup):
+        params, opt_state, loss = step(params, opt_state, b)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, b)
+    loss_val = float(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+
+    samples_per_sec = args.batch * n_dev / dt
+    per_chip = samples_per_sec / n_dev
+    flops_rate = flops_per_step / dt / n_dev
+    # v5e peak: 197 TFLOP/s bf16 per chip
+    mfu = flops_rate / 197e12 if jax.default_backend() == "tpu" else 0.0
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(per_chip, 3),
+        "unit": "samples/s/chip",
+        "vs_baseline": 1.0,
+        "extras": {
+            "step_time_s": round(dt, 4),
+            "devices": n_dev,
+            "backend": jax.default_backend(),
+            "mfu": round(mfu, 4),
+            "loss": loss_val,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

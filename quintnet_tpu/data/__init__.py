@@ -1,0 +1,18 @@
+"""Datasets and loaders (host-side numpy; sharding happens at
+Strategy.shard_batch)."""
+
+from quintnet_tpu.data.datasets import (
+    ArrayDataset,
+    ByteTokenizer,
+    SummarizationDataset,
+    load_mnist,
+    make_batches,
+)
+
+__all__ = [
+    "ArrayDataset",
+    "ByteTokenizer",
+    "SummarizationDataset",
+    "load_mnist",
+    "make_batches",
+]

@@ -1,0 +1,206 @@
+"""Datasets: MNIST, CSV summarization, and synthetic fallbacks.
+
+Reference equivalents: utils/Dataloader.py (CustomDataset for HF arrow
+MNIST + mnist_transform :179-214; SummarizationDataset/Collator
+:216-319). This environment has no network egress and no HF datasets
+package, so loaders read local files when present and fall back to
+deterministic synthetic data otherwise (clearly flagged) — throughput
+benchmarks and schedule-equivalence tests do not depend on real pixels.
+
+Batching is plain host numpy; devices receive batches via
+``Strategy.shard_batch`` (the DistributedSampler role —
+examples/full_3d.py:129-155 — is subsumed by batch sharding over dp).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MNIST_FILES = {
+    "train_images": "train-images-idx3-ubyte.gz",
+    "train_labels": "train-labels-idx1-ubyte.gz",
+    "test_images": "t10k-images-idx3-ubyte.gz",
+    "test_labels": "t10k-labels-idx1-ubyte.gz",
+}
+
+
+def _read_idx(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def load_mnist(data_dir: Optional[str] = None, *, split: str = "train",
+               synthetic_ok: bool = True,
+               synthetic_size: int = 4096) -> Tuple[np.ndarray, np.ndarray]:
+    """(images [N,28,28,1] float32 normalised, labels [N] int32).
+
+    Looks for IDX(.gz) files or mnist.npz under ``data_dir`` (or
+    $QT_DATA_DIR, ./data); falls back to a deterministic synthetic set of
+    class-dependent patterns when allowed.
+    Normalisation matches the reference's transform (mean .1307/std .3081,
+    utils/Dataloader.py:179-214).
+    """
+    candidates = [d for d in (data_dir, os.environ.get("QT_DATA_DIR"),
+                              "data", os.path.expanduser("~/.cache/mnist"))
+                  if d]
+    for d in candidates:
+        npz = os.path.join(d, "mnist.npz")
+        if os.path.exists(npz):
+            z = np.load(npz)
+            x = z["x_train" if split == "train" else "x_test"]
+            y = z["y_train" if split == "train" else "y_test"]
+            return _norm(x), y.astype(np.int32)
+        img = os.path.join(
+            d, MNIST_FILES[f"{'train' if split == 'train' else 'test'}_images"])
+        lbl = os.path.join(
+            d, MNIST_FILES[f"{'train' if split == 'train' else 'test'}_labels"])
+        for im, lb in ((img, lbl), (img[:-3], lbl[:-3])):  # .gz / plain
+            if os.path.exists(im) and os.path.exists(lb):
+                return _norm(_read_idx(im)), _read_idx(lb).astype(np.int32)
+
+    if not synthetic_ok:
+        raise FileNotFoundError(
+            f"MNIST not found under {candidates}; place mnist.npz or IDX "
+            "files there, or allow synthetic_ok")
+    return synthetic_mnist(synthetic_size, seed=0 if split == "train" else 1)
+
+
+def _norm(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.float32) / 255.0
+    x = (x - 0.1307) / 0.3081
+    return x.reshape(x.shape[0], 28, 28, 1)
+
+
+def synthetic_mnist(n: int, *, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Learnable stand-in: each class is a fixed random 28x28 prototype
+    plus noise. A model that learns real MNIST structure will also drive
+    this loss down, so trainer/convergence plumbing stays testable."""
+    protos = np.random.default_rng(42).normal(
+        size=(10, 28, 28, 1)).astype(np.float32)  # shared across splits
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    noise = rng.normal(scale=0.8, size=(n, 28, 28, 1)).astype(np.float32)
+    return protos[labels] + noise, labels
+
+
+@dataclass
+class ArrayDataset:
+    """In-memory (x, y) pairs with shuffling epochs."""
+
+    x: np.ndarray
+    y: np.ndarray
+
+    def __len__(self):
+        return len(self.x)
+
+
+def make_batches(ds: ArrayDataset, batch_size: int, *, seed: int = 0,
+                 shuffle: bool = True,
+                 drop_last: bool = True) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Simple epoch iterator. Batches are GLOBAL; sharding over dp happens
+    on device via Strategy.shard_batch."""
+    idx = np.arange(len(ds))
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    end = len(idx) - (len(idx) % batch_size) if drop_last else len(idx)
+    for i in range(0, end, batch_size):
+        j = idx[i:i + batch_size]
+        yield ds.x[j], ds.y[j]
+
+
+class ByteTokenizer:
+    """Byte-level fallback tokenizer (no-network stand-in for HF
+    GPT2Tokenizer): ids 0-255 are bytes, 256=pad/eos."""
+
+    vocab_size = 257
+    pad_token_id = 256
+    eos_token_id = 256
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8",
+                                                            errors="replace")
+
+
+class SummarizationDataset:
+    """CSV (article, highlights) pairs -> CLM tensors with the reference's
+    prompt format: ``article + "\\n\\nTL;DR: " + summary`` and labels =
+    input_ids with prompt/pad masked to -100
+    (utils/Dataloader.py:263-319).
+    """
+
+    PROMPT = "\n\nTL;DR: "
+
+    def __init__(self, rows: Sequence[Tuple[str, str]], tokenizer,
+                 *, max_length: int = 512):
+        self.rows = list(rows)
+        self.tok = tokenizer
+        self.max_length = max_length
+
+    @staticmethod
+    def from_csv(path: str, tokenizer, *, max_length: int = 512,
+                 article_col: str = "article", summary_col: str = "highlights",
+                 limit: Optional[int] = None) -> "SummarizationDataset":
+        import csv
+
+        rows = []
+        with open(path, newline="", encoding="utf-8") as f:
+            for i, rec in enumerate(csv.DictReader(f)):
+                if limit is not None and i >= limit:
+                    break
+                rows.append((rec[article_col], rec[summary_col]))
+        return SummarizationDataset(rows, tokenizer, max_length=max_length)
+
+    @staticmethod
+    def synthetic(n: int, tokenizer, *, max_length: int = 128, seed: int = 0
+                  ) -> "SummarizationDataset":
+        rng = np.random.default_rng(seed)
+        words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
+                 "eta", "theta"]
+        rows = []
+        for _ in range(n):
+            k = rng.integers(8, 20)
+            art = " ".join(rng.choice(words, size=k))
+            summ = " ".join(art.split()[: max(2, k // 4)])
+            rows.append((art, summ))
+        return SummarizationDataset(rows, tokenizer, max_length=max_length)
+
+    def __len__(self):
+        return len(self.rows)
+
+    def encode_row(self, article: str, summary: str
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        pad = getattr(self.tok, "pad_token_id", 0) or 0
+        prompt_ids = self.tok.encode(article + self.PROMPT)
+        summ_ids = self.tok.encode(summary)
+        ids = (prompt_ids + summ_ids)[: self.max_length]
+        n_prompt = min(len(prompt_ids), self.max_length)
+        labels = [-100] * n_prompt + ids[n_prompt:]
+        padlen = self.max_length - len(ids)
+        ids = ids + [pad] * padlen
+        labels = labels + [-100] * padlen
+        return (np.asarray(ids, np.int32), np.asarray(labels, np.int32))
+
+    def batches(self, batch_size: int, *, seed: int = 0, shuffle: bool = True,
+                drop_last: bool = True
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        idx = np.arange(len(self.rows))
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        end = len(idx) - (len(idx) % batch_size) if drop_last else len(idx)
+        for i in range(0, end, batch_size):
+            enc = [self.encode_row(*self.rows[j]) for j in idx[i:i + batch_size]]
+            yield (np.stack([e[0] for e in enc]),
+                   np.stack([e[1] for e in enc]))

@@ -1,0 +1,2 @@
+"""Entry-point examples (reference: examples/*.py run via torchrun;
+here: plain python, optionally with --simulate N for a CPU mesh)."""

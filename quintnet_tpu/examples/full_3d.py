@@ -1,0 +1,12 @@
+"""Full 3D (DPxTPxPP) ViT-MNIST training (reference examples/full_3d.py).
+
+Run:  python -m quintnet_tpu.examples.full_3d [--simulate 8]
+"""
+
+from quintnet_tpu.examples.common import parse_args, run_vit
+import os
+
+if __name__ == "__main__":
+    here = os.path.dirname(__file__)
+    args = parse_args(os.path.join(here, "config.yaml"))
+    run_vit(args, "3d")

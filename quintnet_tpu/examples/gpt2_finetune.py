@@ -1,0 +1,117 @@
+"""GPT-2 summarization finetune over a 3D mesh
+(reference examples/gpt2_finetune.py:37-254).
+
+Run:  python -m quintnet_tpu.examples.gpt2_finetune \
+          [--simulate 8] [--checkpoint path/to/hf/model.safetensors] \
+          [--csv cnn_dailymail.csv]
+
+Without --checkpoint the model starts from random init (useful for
+schedule/throughput work); without --csv a synthetic summarization set
+stands in (no network egress in this environment). With a HF tokenizer
+directory (--tokenizer) it tokenises like the reference; otherwise a
+byte-level tokenizer is used.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    here = os.path.dirname(__file__)
+    ap.add_argument("--config", default=os.path.join(here, "gpt2_config.yaml"))
+    ap.add_argument("--simulate", type=int, default=0)
+    ap.add_argument("--checkpoint", default=None,
+                    help="HF gpt2 model.safetensors to start from")
+    ap.add_argument("--tokenizer", default=None,
+                    help="HF tokenizer dir (GPT2Tokenizer.from_pretrained)")
+    ap.add_argument("--csv", default=None, help="article/highlights CSV")
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--tiny", action="store_true",
+                    help="use a tiny GPT-2 (smoke/sim runs)")
+    args = ap.parse_args()
+
+    from quintnet_tpu.examples.common import setup_platform
+
+    setup_platform(args.simulate)
+
+    import jax
+
+    from quintnet_tpu.core.config import load_config
+    from quintnet_tpu.data import ByteTokenizer, SummarizationDataset
+    from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
+    from quintnet_tpu.models.gpt2_io import load_hf_gpt2
+    from quintnet_tpu.parallel.strategy import get_strategy
+    from quintnet_tpu.train.trainer import Trainer, make_optimizer
+
+    cfg = load_config(args.config)
+    if args.epochs:
+        cfg.training.epochs = args.epochs
+
+    if args.tokenizer:
+        from transformers import GPT2Tokenizer
+
+        tok = GPT2Tokenizer.from_pretrained(args.tokenizer)
+        tok.pad_token = tok.eos_token
+    else:
+        tok = ByteTokenizer()
+
+    if args.tiny:
+        # vocab must cover the tokenizer (OOB ids NaN-fill under jit);
+        # round up to a lane-friendly multiple of 8
+        v = -(-max(getattr(tok, "vocab_size", 257), 128) // 8) * 8
+        gcfg = GPT2Config.tiny(vocab_size=v)
+    else:
+        gcfg = GPT2Config.from_dict(
+            {**cfg.model.extra, **{k: v for k, v in vars(cfg.model).items()
+                                   if not isinstance(v, dict)}})
+
+    max_len = int(cfg.data.get("max_seq_length", 512))
+    if args.tiny:
+        max_len = min(max_len, gcfg.n_positions)
+    if args.csv:
+        train_ds = SummarizationDataset.from_csv(
+            args.csv, tok, max_length=max_len,
+            limit=cfg.data.get("train_samples"))
+        val_ds = SummarizationDataset.from_csv(
+            args.csv, tok, max_length=max_len,
+            limit=cfg.data.get("val_samples"))
+    else:
+        train_ds = SummarizationDataset.synthetic(
+            int(cfg.data.get("train_samples", 1024)), tok, max_length=max_len)
+        val_ds = SummarizationDataset.synthetic(
+            max(int(cfg.data.get("val_samples", 128)),
+                cfg.training.batch_size),  # >= one global batch
+            tok, max_length=max_len, seed=1)
+
+    model = gpt2_model_spec(gcfg, remat=cfg.training.remat)
+    strategy = get_strategy(cfg.strategy_name, cfg)
+    print(f"strategy={strategy.name} mesh={dict(strategy.mesh.shape)} "
+          f"gpt2 n_layer={gcfg.n_layer} n_embd={gcfg.n_embd}")
+
+    trainer = Trainer(cfg, model, strategy=strategy, task_type="clm",
+                      checkpoint_dir=args.checkpoint_dir)
+
+    if args.checkpoint:
+        host_params, _ = load_hf_gpt2(args.checkpoint, gcfg)
+        params = strategy.shard_params(model, host_params)
+        opt_state = strategy.init_opt_state(model, trainer.optimizer, params)
+    else:
+        params, opt_state = trainer.init_state()
+
+    bs = cfg.training.batch_size
+    hist = trainer.fit(
+        lambda ep: train_ds.batches(bs, seed=ep),
+        val_batches_fn=lambda ep: val_ds.batches(bs, shuffle=False),
+        params=params, opt_state=opt_state,
+    )
+    print(f"done in {hist.wall_time_s:.1f}s; "
+          f"train_loss {hist.train_loss[-1]:.4f}")
+    return hist
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""PP-only ViT-MNIST walkthrough (reference examples/simple_pp.py).
+
+Run:  python -m quintnet_tpu.examples.simple_pp [--simulate 8]
+"""
+
+from quintnet_tpu.examples.common import parse_args, run_vit
+import os
+
+if __name__ == "__main__":
+    here = os.path.dirname(__file__)
+    args = parse_args(os.path.join(here, "pp_config.yaml"))
+    run_vit(args, "pp")

@@ -1,0 +1,12 @@
+"""TP-only ViT-MNIST walkthrough (reference examples/simple_tp.py).
+
+Run:  python -m quintnet_tpu.examples.simple_tp [--simulate 8]
+"""
+
+from quintnet_tpu.examples.common import parse_args, run_vit
+import os
+
+if __name__ == "__main__":
+    here = os.path.dirname(__file__)
+    args = parse_args(os.path.join(here, "tp_config.yaml"))
+    run_vit(args, "tp")

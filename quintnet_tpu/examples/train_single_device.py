@@ -1,0 +1,17 @@
+"""Single-device ViT baseline (reference examples/train_on_single_gpu.py)."""
+
+from quintnet_tpu.examples.common import parse_args, run_vit
+import os
+
+if __name__ == "__main__":
+    here = os.path.dirname(__file__)
+    args = parse_args(os.path.join(here, "dp_config.yaml"))
+    # force a 1-device mesh regardless of the config's mesh_dim
+    from quintnet_tpu.core.config import load_config
+    import tempfile, yaml
+    cfg = yaml.safe_load(open(args.config))
+    cfg["mesh_dim"], cfg["mesh_name"] = [1], ["dp"]
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        yaml.safe_dump(cfg, f)
+        args.config = f.name
+    run_vit(args, "auto")

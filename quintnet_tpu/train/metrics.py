@@ -1,0 +1,138 @@
+"""Metrics: classification, perplexity, ROUGE/BLEU, greedy generation.
+
+Reference: utils/metrics.py (rouge_score + sacrebleu + a greedy
+generation loop, :12-206). Those packages are not in this image, so
+ROUGE-1/2/L and BLEU are implemented directly (same definitions:
+ROUGE f-measure on unigrams/bigrams/LCS; BLEU-4 with brevity penalty).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Callable, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def accuracy(logits, labels) -> jnp.ndarray:
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def perplexity(loss) -> jnp.ndarray:
+    """exp(loss) capped at loss 20 (reference overflow guard,
+    GPT2_Trainer.py:316-318)."""
+    return jnp.exp(jnp.minimum(loss, 20.0))
+
+
+# --------------------------------------------------------------------------
+# ROUGE / BLEU (pure python)
+
+def _ngrams(tokens: Sequence[str], n: int) -> Counter:
+    return Counter(tuple(tokens[i:i + n]) for i in range(len(tokens) - n + 1))
+
+
+def _f1(match: int, pred: int, ref: int) -> float:
+    if pred == 0 or ref == 0 or match == 0:
+        return 0.0
+    p, r = match / pred, match / ref
+    return 2 * p * r / (p + r)
+
+
+def _lcs_len(a: Sequence[str], b: Sequence[str]) -> int:
+    if not a or not b:
+        return 0
+    dp = [0] * (len(b) + 1)
+    for x in a:
+        prev = 0
+        for j, y in enumerate(b, 1):
+            cur = dp[j]
+            dp[j] = prev + 1 if x == y else max(dp[j], dp[j - 1])
+            prev = cur
+    return dp[-1]
+
+
+def rouge_scores(prediction: str, reference: str) -> Dict[str, float]:
+    """ROUGE-1/2/L f-measures (whitespace tokenisation, lowercased)."""
+    p = prediction.lower().split()
+    r = reference.lower().split()
+    out = {}
+    for n, key in ((1, "rouge1"), (2, "rouge2")):
+        pn, rn = _ngrams(p, n), _ngrams(r, n)
+        match = sum((pn & rn).values())
+        out[key] = _f1(match, max(len(p) - n + 1, 0), max(len(r) - n + 1, 0))
+    lcs = _lcs_len(p, r)
+    out["rougeL"] = _f1(lcs, len(p), len(r))
+    return out
+
+
+def bleu_score(prediction: str, references: Sequence[str],
+               max_n: int = 4) -> float:
+    """Corpus-style BLEU-4 for a single sentence (sacrebleu definition:
+    geometric mean of clipped n-gram precisions x brevity penalty)."""
+    p = prediction.lower().split()
+    refs = [r.lower().split() for r in references]
+    if not p:
+        return 0.0
+    log_prec = 0.0
+    for n in range(1, max_n + 1):
+        pn = _ngrams(p, n)
+        if not pn:
+            return 0.0
+        best = Counter()
+        for r in refs:
+            rn = _ngrams(r, n)
+            for g in pn:
+                best[g] = max(best[g], rn.get(g, 0))
+        match = sum(min(c, best[g]) for g, c in pn.items())
+        # smoothed (add-eps) to avoid log 0, as sacrebleu's exp smoothing
+        prec = max(match, 0.1) / sum(pn.values()) if match == 0 else \
+            match / sum(pn.values())
+        log_prec += math.log(prec)
+    ref_len = min((abs(len(r) - len(p)), len(r)) for r in refs)[1]
+    bp = 1.0 if len(p) >= ref_len else math.exp(1 - ref_len / len(p))
+    return bp * math.exp(log_prec / max_n)
+
+
+def compute_rouge_bleu(predictions: Sequence[str],
+                       references: Sequence[str]) -> Dict[str, float]:
+    """Mean ROUGE-1/2/L + BLEU over pairs (reference
+    utils/metrics.py:12-71)."""
+    agg = {"rouge1": 0.0, "rouge2": 0.0, "rougeL": 0.0, "bleu": 0.0}
+    n = max(len(predictions), 1)
+    for pred, ref in zip(predictions, references):
+        r = rouge_scores(pred, ref)
+        for k in ("rouge1", "rouge2", "rougeL"):
+            agg[k] += r[k] / n
+        agg["bleu"] += bleu_score(pred, [ref]) / n
+    return agg
+
+
+# --------------------------------------------------------------------------
+# Greedy generation (reference utils/metrics.py:74-149)
+
+def greedy_generate(apply_fn: Callable, params, input_ids: np.ndarray,
+                    *, max_new_tokens: int, eos_token_id: int | None = None
+                    ) -> np.ndarray:
+    """Greedy decode with a full-forward per step (KV cache is a planned
+    ops/ upgrade; the reference's loop is also full-forward,
+    metrics.py:74-149). input_ids: [B, T0] -> [B, T0 + max_new]."""
+    ids = jnp.asarray(input_ids)
+
+    @jax.jit
+    def next_token(p, cur):
+        logits = apply_fn(p, cur)
+        return jnp.argmax(logits[:, -1, :], axis=-1)
+
+    done = np.zeros((ids.shape[0],), bool)
+    for _ in range(max_new_tokens):
+        nxt = np.asarray(next_token(params, ids))
+        if eos_token_id is not None:
+            nxt = np.where(done, eos_token_id, nxt)
+            done |= nxt == eos_token_id
+        ids = jnp.concatenate([ids, jnp.asarray(nxt)[:, None]], axis=1)
+        if eos_token_id is not None and done.all():
+            break
+    return np.asarray(ids)

@@ -1,0 +1,226 @@
+"""Trainers: config-driven epoch loops over strategy-built train steps.
+
+Reference: ``Trainer`` (ViT classification, trainer.py:57-363) and
+``GPT2Trainer`` (CLM/summarization, GPT2_Trainer.py:56-555). One class
+covers both here (task_type switches metrics), because all parallelism
+differences live in the Strategy — the loop does not care whether the
+step underneath is single-device, DP, or a 3D 1F1B pipeline.
+
+Differences from the reference worth knowing:
+- metrics come back from the step already reduced (no MAX-allreduce
+  metric propagation dance, trainer.py:168-187 — and no silent
+  assumption that metrics are non-negative);
+- checkpoints save sharded via train/checkpoint.py and RESUME works
+  (the reference is save-only);
+- a single process drives the whole mesh (SPMD), so "rank 0 only"
+  logging guards are unnecessary.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from quintnet_tpu.core.config import Config
+from quintnet_tpu.parallel.strategy import ModelSpec, Strategy, get_strategy
+from quintnet_tpu.train import metrics as M
+
+
+def make_optimizer(cfg: Config) -> optax.GradientTransformation:
+    """Optimizer from config (reference: Adam in Trainer vs AdamW in
+    GPT2Trainer — trainer.py:89 vs GPT2_Trainer.py:100; here one factory).
+    zero1_* names shard the state over dp (parallel/zero.py)."""
+    t = cfg.training
+    name = t.optimizer.lower()
+    if name.startswith("zero1_"):
+        name = name[len("zero1_"):]
+    if name == "adam":
+        return optax.adam(t.learning_rate)
+    if name == "adamw":
+        return optax.adamw(t.learning_rate, weight_decay=t.weight_decay or 0.01)
+    if name == "sgd":
+        return optax.sgd(t.learning_rate)
+    raise ValueError(f"unknown optimizer {t.optimizer!r}")
+
+
+@dataclass
+class History:
+    train_loss: List[float] = field(default_factory=list)
+    val_loss: List[float] = field(default_factory=list)
+    train_metric: List[float] = field(default_factory=list)
+    val_metric: List[float] = field(default_factory=list)
+    wall_time_s: float = 0.0
+
+
+class Trainer:
+    """fit() over (x, y) batch iterables.
+
+    ``task_type``: 'classification' (metric: accuracy, pp=1 only) or
+    'clm' (metric: perplexity).
+    """
+
+    def __init__(self, config: Config, model: ModelSpec,
+                 *, strategy: Optional[Strategy] = None,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 task_type: str = "classification",
+                 checkpoint_dir: Optional[str] = None,
+                 eval_logits_fn: Optional[Callable] = None,
+                 log_fn: Callable[[str], None] = print):
+        self.config = config
+        self.model = model
+        self.strategy = strategy or get_strategy(config.strategy_name, config)
+        self.optimizer = optimizer or make_optimizer(config)
+        self.task_type = task_type
+        self.checkpoint_dir = checkpoint_dir
+        self.log = log_fn
+        self.eval_logits_fn = eval_logits_fn
+
+        self.step_fn = self.strategy.make_train_step(self.model, self.optimizer)
+        self._eval_fn = None
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, seed: Optional[int] = None):
+        seed = self.config.training.seed if seed is None else seed
+        host_params = self.model.init(jax.random.key(seed))
+        params = self.strategy.shard_params(self.model, host_params)
+        opt_state = self.strategy.init_opt_state(self.model, self.optimizer,
+                                                 params)
+        return params, opt_state
+
+    def resume_or_init(self, seed: Optional[int] = None):
+        """Restore the latest checkpoint if one exists (absent from the
+        reference), else fresh init. Returns (params, opt_state, start_epoch)."""
+        params, opt_state = self.init_state(seed)
+        if self.checkpoint_dir:
+            from quintnet_tpu.train.checkpoint import CheckpointManager
+
+            mgr = CheckpointManager(self.checkpoint_dir)
+            if mgr.latest_step() is not None:
+                restored = mgr.restore({"params": params, "opt": opt_state,
+                                        "epoch": 0})
+                self.log(f"resumed from epoch {int(restored['epoch'])}")
+                return (restored["params"], restored["opt"],
+                        int(restored["epoch"]) + 1)
+        return params, opt_state, 0
+
+    def save(self, epoch: int, params, opt_state):
+        if not self.checkpoint_dir:
+            return
+        from quintnet_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(self.checkpoint_dir)
+        mgr.save(epoch, {"params": params, "opt": opt_state, "epoch": epoch})
+
+    # -- evaluation --------------------------------------------------------
+    def _build_eval(self):
+        if self._eval_fn is not None:
+            return self._eval_fn
+        from jax.sharding import PartitionSpec as P
+
+        from quintnet_tpu.core import collectives as cc
+
+        strat = self.strategy
+        specs = strat.param_specs(self.model)
+        tp_axis = strat.axis_or_none("tp")
+        sp_axis = strat.axis_or_none("sp")
+
+        if strat.uses_pp:
+            from quintnet_tpu.parallel.pp import (PipelineSpec,
+                                                  make_afab_loss_fn)
+
+            embed_fn, stage_fn, head_loss_fn = self.model.pipeline_fns(
+                tp_axis=tp_axis, sp_axis=sp_axis)
+            loss_fn = make_afab_loss_fn(
+                embed_fn, stage_fn, head_loss_fn,
+                PipelineSpec(
+                    n_micro=self.config.training.gradient_accumulation_steps))
+        else:
+            def loss_fn(p, b):
+                return self.model.loss_fn(p, b, tp_axis=tp_axis,
+                                          sp_axis=sp_axis)
+
+        def local_eval(p, b):
+            loss = loss_fn(p, b)
+            if strat.batch_axes:
+                loss = jax.lax.pmean(loss, strat.batch_axes)
+            return loss
+
+        batch_spec = P(strat.batch_axes if strat.batch_axes else None)
+        self._eval_fn = jax.jit(cc.shard_map_fn(
+            local_eval, strat.mesh,
+            in_specs=(specs, batch_spec), out_specs=P()))
+        return self._eval_fn
+
+    def evaluate(self, params, batches: Iterable) -> Dict[str, float]:
+        eval_fn = self._build_eval()
+        losses = []
+        accs = []
+        for xb, yb in batches:
+            b = self.strategy.shard_batch((jnp.asarray(xb), jnp.asarray(yb)))
+            losses.append(float(eval_fn(params, b)))
+            if (self.task_type == "classification"
+                    and not self.strategy.uses_pp
+                    and self.eval_logits_fn is not None):
+                logits = self.eval_logits_fn(params, b[0])
+                accs.append(float(M.accuracy(logits, b[1])))
+        out = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        if self.task_type == "clm":
+            out["perplexity"] = float(np.exp(min(out["loss"], 20.0)))
+        elif accs:
+            out["accuracy"] = float(np.mean(accs))
+        return out
+
+    # -- training ----------------------------------------------------------
+    def fit(self, train_batches_fn: Callable[[int], Iterable],
+            *, epochs: Optional[int] = None,
+            val_batches_fn: Optional[Callable[[int], Iterable]] = None,
+            params=None, opt_state=None) -> History:
+        """``train_batches_fn(epoch) -> iterable of (x, y)`` host batches
+        (global batch size; sharding happens here)."""
+        epochs = epochs or self.config.training.epochs
+        if params is None:
+            params, opt_state, start = self.resume_or_init()
+        else:
+            start = 0
+        hist = History()
+        t0 = time.time()
+        log_every = self.config.training.log_every
+
+        for epoch in range(start, epochs):
+            losses = []
+            for i, (xb, yb) in enumerate(train_batches_fn(epoch)):
+                batch = self.strategy.shard_batch(
+                    (jnp.asarray(xb), jnp.asarray(yb)))
+                params, opt_state, loss = self.step_fn(params, opt_state,
+                                                       batch)
+                losses.append(float(loss))
+                if log_every and (i + 1) % log_every == 0:
+                    self.log(f"epoch {epoch} step {i + 1}: "
+                             f"loss {np.mean(losses[-log_every:]):.4f}")
+            train_loss = float(np.mean(losses)) if losses else float("nan")
+            hist.train_loss.append(train_loss)
+            msg = f"epoch {epoch}: train_loss {train_loss:.4f}"
+            if self.task_type == "clm":
+                ppl = float(np.exp(min(train_loss, 20.0)))
+                hist.train_metric.append(ppl)
+                msg += f" ppl {ppl:.2f}"
+            if val_batches_fn is not None:
+                ev = self.evaluate(params, val_batches_fn(epoch))
+                hist.val_loss.append(ev["loss"])
+                msg += f" | val_loss {ev['loss']:.4f}"
+                for k in ("perplexity", "accuracy"):
+                    if k in ev:
+                        hist.val_metric.append(ev[k])
+                        msg += f" val_{k} {ev[k]:.4f}"
+            self.log(msg)
+            self.save(epoch, params, opt_state)
+
+        hist.wall_time_s = time.time() - t0
+        self._final_state = (params, opt_state)
+        return hist
