@@ -108,18 +108,25 @@ def gpt2_init(key, cfg: GPT2Config, *, dtype=jnp.float32):
     }
 
 
-def gpt2_embed(params, input_ids):
-    """[B, T] ids -> [B, T, D] (reference GPT2Embedding, replicated across
-    TP — gpt2_embeddings.py:16-103)."""
+def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None):
+    """[B, T_local] ids -> [B, T_local, D] (reference GPT2Embedding,
+    replicated across TP — gpt2_embeddings.py:16-103).
+
+    With ``sp_axis`` the sequence dim is sharded: this rank's position
+    embeddings start at axis_index * T_local."""
     emb = params["embedding"]
     T = input_ids.shape[-1]
     tok = jnp.take(emb["wte"], input_ids, axis=0)
-    pos = emb["wpe"][:T]
+    start = 0
+    if sp_axis is not None:
+        start = jax.lax.axis_index(sp_axis) * T
+    pos = jax.lax.dynamic_slice_in_dim(emb["wpe"], start, T, axis=0)
     return tok + pos[None, :, :]
 
 
 def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
-                tp_axis: Optional[str] = None, remat: bool = False,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None, remat: bool = False,
                 use_flash: bool = False):
     tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
     return stacked_blocks_apply(
@@ -128,6 +135,7 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
         causal=True,
         act=gelu,
         tp_axis=tp_axis,
+        sp_axis=sp_axis,
         remat=remat,
         use_flash=use_flash,
     )
@@ -142,11 +150,12 @@ def gpt2_logits(params, h, cfg: GPT2Config):
 
 
 def gpt2_apply(params, input_ids, cfg: GPT2Config, *,
-               tp_axis: Optional[str] = None, remat: bool = False,
+               tp_axis: Optional[str] = None,
+               sp_axis: Optional[str] = None, remat: bool = False,
                use_flash: bool = False):
-    h = gpt2_embed(params, input_ids)
-    h = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis, remat=remat,
-                    use_flash=use_flash)
+    h = gpt2_embed(params, input_ids, sp_axis=sp_axis)
+    h = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
+                    sp_axis=sp_axis, remat=remat, use_flash=use_flash)
     return gpt2_logits(params, h, cfg)
 
 
@@ -163,6 +172,36 @@ def clm_loss(logits, labels):
     nll = jnp.where(valid, nll, 0.0)
     count = jnp.maximum(jnp.sum(valid), 1)
     return jnp.sum(nll) / count
+
+
+def clm_loss_sp(logits, labels, *, sp_axis: str):
+    """CLM loss when the sequence dim is sharded over ``sp_axis``.
+
+    The next-token shift crosses chunk boundaries: each rank's last
+    position targets the FIRST label of the next rank's chunk (one
+    ppermute); the final rank's last position is invalid. Token-count
+    normalisation is global (psum of sums / psum of counts), so the
+    result equals :func:`clm_loss` on the gathered sequence exactly.
+    """
+    sp = jax.lax.axis_size(sp_axis)
+    idx = jax.lax.axis_index(sp_axis)
+    # rank i+1 sends its first label column to rank i
+    perm = [(i + 1, i) for i in range(sp - 1)]
+    first_next = jax.lax.ppermute(labels[:, :1], sp_axis, perm)
+    targets = jnp.concatenate([labels[:, 1:], first_next], axis=1)
+    # invalidate the global-final position (last rank's last column)
+    col = jnp.arange(targets.shape[1])
+    boundary = (idx == sp - 1) & (col == targets.shape[1] - 1)
+    targets = jnp.where(boundary[None, :], IGNORE_INDEX, targets)
+
+    valid = targets != IGNORE_INDEX
+    safe = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    total = jax.lax.psum(jnp.sum(nll), sp_axis)
+    count = jax.lax.psum(jnp.sum(valid), sp_axis)
+    return total / jnp.maximum(count, 1)
 
 
 def perplexity(loss):
@@ -201,35 +240,49 @@ def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
 
 
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
+                      sp_axis: Optional[str] = None,
                       remat: bool = False, use_flash: bool = False):
     """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py."""
 
     def embed_fn(params, input_ids):
-        return gpt2_embed(params, input_ids)
+        return gpt2_embed(params, input_ids, sp_axis=sp_axis)
 
     def stage_fn(blocks_local, h):
         return gpt2_blocks(blocks_local, h, cfg, tp_axis=tp_axis,
-                           remat=remat, use_flash=use_flash)
+                           sp_axis=sp_axis, remat=remat, use_flash=use_flash)
 
     def head_loss_fn(params, h, labels):
-        return clm_loss(gpt2_logits(params, h, cfg), labels)
+        logits = gpt2_logits(params, h, cfg)
+        if sp_axis is not None:
+            return clm_loss_sp(logits, labels, sp_axis=sp_axis)
+        return clm_loss(logits, labels)
 
     return embed_fn, stage_fn, head_loss_fn
 
 
 def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
                     use_flash: bool = False):
+    from jax.sharding import PartitionSpec as P
+
     from quintnet_tpu.parallel.strategy import ModelSpec
 
     def loss_fn(params, batch, tp_axis=None, sp_axis=None):
         input_ids, labels = batch
         logits = gpt2_apply(params, input_ids, cfg, tp_axis=tp_axis,
-                            remat=remat, use_flash=use_flash)
+                            sp_axis=sp_axis, remat=remat,
+                            use_flash=use_flash)
+        if sp_axis is not None:
+            return clm_loss_sp(logits, labels, sp_axis=sp_axis)
         return clm_loss(logits, labels)
 
     def pipeline_fns(tp_axis=None, sp_axis=None):
-        return gpt2_pipeline_fns(cfg, tp_axis=tp_axis, remat=remat,
-                                 use_flash=use_flash)
+        return gpt2_pipeline_fns(cfg, tp_axis=tp_axis, sp_axis=sp_axis,
+                                 remat=remat, use_flash=use_flash)
+
+    def batch_specs(batch_axes, sp_axis=None):
+        # (input_ids, labels): batch dim over dp, sequence dim over sp
+        spec = P(tuple(batch_axes) if batch_axes else None, sp_axis)
+        return (spec, spec)
 
     return ModelSpec(
         init=lambda key: gpt2_init(key, cfg),
@@ -239,4 +292,5 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
         pipeline_fns=pipeline_fns,
         to_tp_layout=lambda p, tp: gpt2_to_tp_layout(p, cfg, tp),
         depth=cfg.n_layer,
+        batch_specs=batch_specs,
     )

@@ -58,12 +58,16 @@ def mha_apply(
     num_heads: int,
     causal: bool = False,
     tp_axis: Optional[str] = None,
+    sp_axis: Optional[str] = None,
     use_flash: bool = False,
 ):
-    """x: [B, S, D] -> [B, S, D].
+    """x: [B, S_local, D] -> [B, S_local, D].
 
     ``num_heads`` is the number of LOCAL heads (global heads / tp_size when
     sharded — head-sharding exactly as gpt2_attention.py:89-95).
+    With ``sp_axis`` the sequence dim is sharded and the inner attention
+    runs the ring algorithm (ops/ring_attention.py) — long-context
+    support the reference does not have.
     """
     qkv = linear_apply(p["qkv"], x)  # [B, S, 3*D_local]
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -71,7 +75,11 @@ def mha_apply(
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
 
-    if use_flash:
+    if sp_axis is not None:
+        from quintnet_tpu.ops.ring_attention import ring_attention
+
+        o = ring_attention(q, k, v, axis=sp_axis, causal=causal)
+    elif use_flash:
         from quintnet_tpu.ops.flash_attention import flash_attention
 
         o = flash_attention(q, k, v, causal=causal)

@@ -47,6 +47,7 @@ def block_apply(
     causal: bool = False,
     act: Callable = gelu,
     tp_axis: Optional[str] = None,
+    sp_axis: Optional[str] = None,
     use_flash: bool = False,
 ):
     x = x + mha_apply(
@@ -55,6 +56,7 @@ def block_apply(
         num_heads=num_heads,
         causal=causal,
         tp_axis=tp_axis,
+        sp_axis=sp_axis,
         use_flash=use_flash,
     )
     x = x + mlp_apply(p["mlp"], layer_norm_apply(p["ln2"], x), act=act, tp_axis=tp_axis)
@@ -69,6 +71,7 @@ def stacked_blocks_apply(
     causal: bool = False,
     act: Callable = gelu,
     tp_axis: Optional[str] = None,
+    sp_axis: Optional[str] = None,
     use_flash: bool = False,
     remat: bool = False,
 ):
@@ -85,6 +88,7 @@ def stacked_blocks_apply(
         causal=causal,
         act=act,
         tp_axis=tp_axis,
+        sp_axis=sp_axis,
         use_flash=use_flash,
     )
     if remat:

@@ -1,0 +1,102 @@
+"""Ring attention: exact attention over a sequence-sharded ('sp') axis.
+
+Long-context support is a first-class capability upgrade over the
+reference, which has NO sequence/context parallelism of any kind
+(SURVEY §5.7: max context 1024, single local SDPA call per TP rank).
+
+Algorithm (Liu et al., Ring Attention; blockwise online softmax): the
+sequence dim of Q/K/V is sharded over ``sp``. Each device keeps running
+(max, denom, numerator) accumulators for its local queries while K/V
+chunks rotate around the ring via ``ppermute``; after sp steps every
+query has attended every key exactly once. Peak memory is O(S/sp) per
+device and the K/V transfer overlaps the local blockwise compute.
+
+Causal masking is at chunk granularity: a K/V chunk from sequence
+position c is fully visible to local queries at position q_c > c,
+diagonal-masked at q_c == c, and contributes nothing at q_c < c (the
+masked compute is still executed to keep the SPMD program uniform; the
+zigzag load-balancing variant is a follow-up optimisation).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk_attention(q, k, v, *, mode, scale):
+    """One (local-Q x incoming-KV-chunk) blockwise step.
+
+    q: [B, H, Sq, D]; k/v: [B, H, Sk, D];
+    mode: 0=full, 1=causal-diagonal, 2=none (masked out).
+    Returns (scores_max [B,H,Sq], probs-sum [B,H,Sq], weighted-V
+    [B,H,Sq,D]) in f32.
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    sq, sk = scores.shape[-2], scores.shape[-1]
+    diag = jnp.tril(jnp.ones((sq, sk), bool))
+    mask = jnp.where(mode == 0, True, jnp.where(mode == 1, diag, False))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m_raw = jnp.max(scores, axis=-1)  # -inf where the row is fully masked
+    m_safe = jnp.where(jnp.isfinite(m_raw), m_raw, 0.0)
+    p = jnp.where(mask, jnp.exp(scores - m_safe[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_raw, l, o
+
+
+def ring_attention(q, k, v, *, axis: str, causal: bool = False):
+    """[B, H, S_local, Dh] sharded attention over ``axis``.
+
+    Exactly equals full-sequence attention on the gathered sequence
+    (tests/test_ring.py golden checks).
+    """
+    sp = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    def body(carry, step):
+        m, l, acc, k_cur, v_cur = carry
+        # k_cur currently holds the chunk originating at rank (idx - step)
+        src = jnp.mod(idx - step, sp)
+        if causal:
+            mode = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+        else:
+            mode = jnp.zeros((), jnp.int32)
+        m_new, l_new, o_new = _chunk_attention(
+            q, k_cur, v_cur, mode=mode, scale=scale)
+        # carry max stays -inf until a row sees its first unmasked key;
+        # rescale factors use a finite-ized base so exp never sees inf-inf
+        m_tot = jnp.maximum(m, m_new)
+        m_base = jnp.where(jnp.isfinite(m_tot), m_tot, 0.0)
+        c_old = jnp.exp(jnp.where(jnp.isfinite(m), m - m_base, -jnp.inf))
+        c_old = jnp.where(jnp.isfinite(c_old), c_old, 0.0)
+        c_new = jnp.exp(jnp.where(jnp.isfinite(m_new), m_new - m_base,
+                                  -jnp.inf))
+        c_new = jnp.where(jnp.isfinite(c_new), c_new, 0.0)
+        l = l * c_old + l_new * c_new
+        acc = acc * c_old[..., None] + o_new * c_new[..., None]
+        # rotate K/V: rank i sends to i+1 so next step we hold chunk
+        # (idx - step - 1)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return (m_tot, l, acc, k_nxt, v_nxt), None
+
+    init = (
+        jnp.full((b, h, s), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, s), jnp.float32),
+        jnp.zeros((b, h, s, d), jnp.float32),
+        k,
+        v,
+    )
+    (m, l, acc, _, _), _ = lax.scan(body, init, jnp.arange(sp))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)

@@ -74,6 +74,10 @@ class ModelSpec:
     pipeline_fns: Callable
     to_tp_layout: Callable
     depth: int
+    # optional: fn(batch_axes, sp_axis) -> PartitionSpec pytree for the
+    # batch (e.g. GPT-2 shards the sequence dim over sp). Default: batch
+    # dim over the data axes, everything else replicated.
+    batch_specs: Optional[Callable] = None
 
 
 @dataclass
@@ -105,10 +109,19 @@ class Strategy:
         params = model.to_tp_layout(params, tp)
         return shard_pytree(self.mesh, params, self.param_specs(model))
 
-    def shard_batch(self, batch):
-        spec = P(self.batch_axes if self.batch_axes else None)
+    def batch_partition_specs(self, model: Optional[ModelSpec] = None):
+        if model is not None and model.batch_specs is not None:
+            return model.batch_specs(self.batch_axes,
+                                     sp_axis=self.axis_or_none("sp"))
+        return P(self.batch_axes if self.batch_axes else None)
+
+    def shard_batch(self, batch, model: Optional[ModelSpec] = None):
+        specs = self.batch_partition_specs(model)
+        if isinstance(specs, P):
+            specs = jax.tree.map(lambda _: specs, batch)
         return jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            batch, specs,
         )
 
     @property
@@ -155,6 +168,7 @@ class Strategy:
                     grad_clip_norm=cfg.training.grad_clip_norm,
                     grad_fn=grad_fn,
                     zero1_axis=self.zero1_axis,
+                    batch_specs=self.batch_partition_specs(model),
                 )
             loss = make_afab_loss_fn(embed_fn, stage_fn, head_loss_fn, pspec)
             return make_parallel_train_step(
@@ -164,6 +178,7 @@ class Strategy:
                 partial_axes=self.partial_axes,
                 grad_clip_norm=cfg.training.grad_clip_norm,
                 zero1_axis=self.zero1_axis,
+                batch_specs=self.batch_partition_specs(model),
             )
 
         def loss(params, batch):
@@ -178,6 +193,7 @@ class Strategy:
             grad_accum_steps=cfg.training.gradient_accumulation_steps,
             grad_clip_norm=cfg.training.grad_clip_norm,
             zero1_axis=self.zero1_axis,
+            batch_specs=self.batch_partition_specs(model),
         )
 
 

@@ -160,6 +160,7 @@ def make_parallel_train_step(
     donate: bool = True,
     grad_fn: Optional[Callable] = None,
     zero1_axis: Optional[str] = None,
+    batch_specs=None,
 ):
     """Build a jitted train step over an arbitrary (dp, tp, pp[, sp]) mesh.
 
@@ -217,7 +218,8 @@ def make_parallel_train_step(
                                            axis=zero1_axis)
             else:
                 o_specs = opt_state_specs(optimizer, params, param_specs)
-            batch_spec = P(data_axes if data_axes else None)
+            batch_spec = (batch_specs if batch_specs is not None
+                          else P(data_axes if data_axes else None))
             smapped = cc.shard_map_fn(
                 local_step,
                 mesh,

@@ -151,7 +151,7 @@ class Trainer:
                 loss = jax.lax.pmean(loss, strat.batch_axes)
             return loss
 
-        batch_spec = P(strat.batch_axes if strat.batch_axes else None)
+        batch_spec = strat.batch_partition_specs(self.model)
         self._eval_fn = jax.jit(cc.shard_map_fn(
             local_eval, strat.mesh,
             in_specs=(specs, batch_spec), out_specs=P()))
@@ -162,7 +162,8 @@ class Trainer:
         losses = []
         accs = []
         for xb, yb in batches:
-            b = self.strategy.shard_batch((jnp.asarray(xb), jnp.asarray(yb)))
+            b = self.strategy.shard_batch((jnp.asarray(xb), jnp.asarray(yb)),
+                                          self.model)
             losses.append(float(eval_fn(params, b)))
             if (self.task_type == "classification"
                     and not self.strategy.uses_pp
@@ -196,7 +197,7 @@ class Trainer:
             losses = []
             for i, (xb, yb) in enumerate(train_batches_fn(epoch)):
                 batch = self.strategy.shard_batch(
-                    (jnp.asarray(xb), jnp.asarray(yb)))
+                    (jnp.asarray(xb), jnp.asarray(yb)), self.model)
                 params, opt_state, loss = self.step_fn(params, opt_state,
                                                        batch)
                 losses.append(float(loss))
