@@ -1,0 +1,149 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Tiles Q/K/V through VMEM with online-softmax accumulators in scratch so
+the [S, S] score matrix never reaches HBM (the reference relies on
+cuDNN's fused SDPA — gpt2_attention.py:156-161; this is the TPU-native
+equivalent, written against jax.experimental.pallas).
+
+Grid: (batch*heads, q_blocks, k_blocks), k innermost — scratch
+accumulators persist across the k dimension and the output block is
+finalised at the last k step. Causal masking is applied in-kernel;
+k-blocks entirely above the diagonal still run (masked) in this v1 —
+grid pruning is a follow-up.
+
+Backward: custom_vjp recomputing through the exact jnp blockwise
+implementation (ops/flash_attention.py) — activation-checkpoint style,
+O(S) memory; a hand-tiled bwd kernel is a follow-up optimisation.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some hosts; dispatcher guards
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAVE_PLTPU = False
+
+NEG_INF = -1e30  # avoid literal -inf inside the kernel (exp/max safety)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale: float, causal: bool, block_q: int, block_k: int):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)          # [bq, d]
+    k = k_ref[0].astype(jnp.float32)          # [bk, d]
+    v = v_ref[0].astype(jnp.float32)          # [bk, d]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+    if causal:
+        qi = pl.program_id(1)
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]                      # [bq, 1]
+    l_prev = l_scr[:, :1]                      # [bq, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                     # NEG_INF rows -> exp(~-1e30)=0
+    l_cur = jnp.sum(p, axis=1, keepdims=True)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + l_cur
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    assert s % bq == 0 and s % bk == 0, (s, bq, bk)
+
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    grid = (b * h, s // bq, s // bk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ] if _HAVE_PLTPU else None,
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def pallas_flash_attention(q, k, v, causal: bool = False,
+                           block_q: int = 128, block_k: int = 128,
+                           interpret: bool = False):
+    """[B, H, S, D] fused attention via the Pallas TPU kernel.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
+    testing). S must divide by the block sizes (the dispatcher in
+    ops/flash_attention.py falls back to jnp otherwise).
+    """
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    from quintnet_tpu.ops.flash_attention import blockwise_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, block_q=block_q, block_k=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+pallas_flash_attention.defvjp(_fa_fwd, _fa_bwd)
