@@ -1,0 +1,1 @@
+"""Offline CLIs: checkpoint export/merge, single-device verification."""

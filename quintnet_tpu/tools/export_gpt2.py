@@ -1,0 +1,56 @@
+"""Export a trained GPT-2 checkpoint to HF-layout safetensors.
+
+Reference: merge_checkpoints.py — an offline CLI that re-assembles
+per-(pp,tp)-shard .pt files (TP concat by dim, PP layer renumber, Conv1D
+transposes) into a HF GPT2LMHeadModel state dict. Orbax checkpoints are
+already logically whole (sharding lives in metadata, restore gathers),
+so this "merge" is a restore + layout conversion:
+
+  python -m quintnet_tpu.tools.export_gpt2 \
+      --checkpoint-dir ckpts/ --out gpt2_merged.safetensors \
+      [--step N] [--tp-layout TP]
+
+--tp-layout: pass the tp size the model was trained with so fused-QKV
+columns are unpermuted from the tp-blocked layout back to HF's [q|k|v].
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--step", type=int, default=None)
+    ap.add_argument("--tp-layout", type=int, default=1)
+    ap.add_argument("--n-layer", type=int, default=12)
+    ap.add_argument("--n-embd", type=int, default=768)
+    ap.add_argument("--n-head", type=int, default=12)
+    ap.add_argument("--vocab-size", type=int, default=50257)
+    ap.add_argument("--n-positions", type=int, default=1024)
+    args = ap.parse_args()
+
+    import jax
+
+    from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+    from quintnet_tpu.models.gpt2_io import save_hf_gpt2
+    from quintnet_tpu.train.checkpoint import CheckpointManager
+
+    cfg = GPT2Config(vocab_size=args.vocab_size,
+                     n_positions=args.n_positions, n_embd=args.n_embd,
+                     n_layer=args.n_layer, n_head=args.n_head)
+    template = jax.eval_shape(lambda: gpt2_init(jax.random.key(0), cfg))
+    template = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), template)
+
+    mgr = CheckpointManager(args.checkpoint_dir)
+    state = mgr.restore({"params": template, "opt": None, "epoch": 0},
+                        step=args.step)
+    save_hf_gpt2(state["params"], cfg, args.out, tp_layout=args.tp_layout)
+    print(f"wrote {args.out} (step {args.step or mgr.latest_step()})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Single-device ground-truth verifier for distributed GPT-2 training.
+
+Reference: test.py:28-113 — load the merged checkpoint into HF
+GPT2LMHeadModel on ONE device with no distributed code and recompute
+loss/perplexity; metric parity with the distributed run is the
+acceptance criterion. Here both paths run from the same process:
+
+  python -m quintnet_tpu.tools.verify_gpt2 --hf-file merged.safetensors
+
+Computes (a) framework single-device loss, (b) torch/transformers loss
+on the same batch, and reports the delta.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hf-file", required=True)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--n-head", type=int, default=12)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from quintnet_tpu.models.gpt2 import clm_loss, gpt2_apply
+    from quintnet_tpu.models.gpt2_io import load_hf_gpt2
+
+    params, cfg = load_hf_gpt2(args.hf_file)
+    if cfg.n_head != args.n_head:
+        from dataclasses import replace
+
+        cfg = replace(cfg, n_head=args.n_head)
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (args.batch, args.seq), dtype=np.int32)
+
+    logits = gpt2_apply(params, jnp.asarray(ids), cfg)
+    loss_jax = float(clm_loss(logits, jnp.asarray(ids)))
+    print(f"quintnet_tpu single-device loss: {loss_jax:.6f} "
+          f"ppl {np.exp(min(loss_jax, 20)):.2f}")
+
+    try:
+        import torch
+        import transformers
+
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=cfg.vocab_size, n_positions=cfg.n_positions,
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer, n_head=cfg.n_head)
+        model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+        sd = model.state_dict()
+        from quintnet_tpu.utils.safetensors_io import SafeTensorFile
+
+        with SafeTensorFile(args.hf_file) as f:
+            loaded = {k: torch.tensor(np.array(f.tensor(k)))
+                      for k in f.keys()}
+        # file may or may not carry the transformer. prefix
+        fixed = {}
+        for k, v in loaded.items():
+            kk = k if k.startswith("transformer.") else "transformer." + k
+            fixed[kk] = v
+        fixed["lm_head.weight"] = fixed["transformer.wte.weight"]
+        missing, unexpected = model.load_state_dict(fixed, strict=False)
+        t_ids = torch.tensor(ids, dtype=torch.long)
+        with torch.no_grad():
+            out = model(t_ids, labels=t_ids)
+        loss_t = float(out.loss)
+        print(f"transformers reference loss:   {loss_t:.6f} "
+              f"ppl {np.exp(min(loss_t, 20)):.2f}")
+        print(f"abs diff: {abs(loss_jax - loss_t):.2e}")
+    except ImportError:
+        print("torch/transformers unavailable; skipped cross-check")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Logging: stdout + file tee (reference utils/logger.py:5-42 writes
+logs/rank_{r}.log per process; SPMD drives the mesh from one process so
+there is one log, optionally annotated with the mesh shape)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+
+def setup_logging(log_dir: Optional[str] = None, *, name: str = "quintnet",
+                  level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    logger.handlers.clear()
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s",
+                            "%H:%M:%S")
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        fh = logging.FileHandler(os.path.join(log_dir, f"{name}.log"))
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    return logger
+
+
+def log_once(logger: logging.Logger, msg: str, *, _seen=set()):  # noqa: B006
+    """Log a message at most once per process (dedups warnings emitted
+    from inside retraced functions)."""
+    if msg not in _seen:
+        _seen.add(msg)
+        logger.info(msg)
