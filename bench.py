@@ -62,7 +62,9 @@ def main():
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
 
         gcfg = GPT2Config.base()
-        model = gpt2_model_spec(gcfg, remat=True)
+        compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
+        model = gpt2_model_spec(gcfg, remat=True,
+                                compute_dtype=compute_dtype)
         ids = np.random.default_rng(0).integers(
             0, gcfg.vocab_size, size=(args.batch * n_dev, args.seq),
             dtype=np.int32)

@@ -87,7 +87,12 @@ def main():
                 cfg.training.batch_size),  # >= one global batch
             tok, max_length=max_len, seed=1)
 
-    model = gpt2_model_spec(gcfg, remat=cfg.training.remat)
+    import jax.numpy as jnp
+
+    compute_dtype = (jnp.bfloat16 if cfg.training.dtype == "bfloat16"
+                     else None)
+    model = gpt2_model_spec(gcfg, remat=cfg.training.remat,
+                            compute_dtype=compute_dtype)
     strategy = get_strategy(cfg.strategy_name, cfg)
     print(f"strategy={strategy.name} mesh={dict(strategy.mesh.shape)} "
           f"gpt2 n_layer={gcfg.n_layer} n_embd={gcfg.n_embd}")

@@ -239,20 +239,36 @@ def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
     return out
 
 
+def _cast_tree(tree, dtype):
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None,
-                      remat: bool = False, use_flash: bool = False):
-    """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py."""
+                      remat: bool = False, use_flash: bool = False,
+                      compute_dtype=None):
+    """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py.
+
+    ``compute_dtype=jnp.bfloat16``: params are cast at use (storage stays
+    f32 master copies; the cast's transpose accumulates grads back in
+    f32) — the TPU mixed-precision default. Softmax/LN/loss stay f32.
+    """
 
     def embed_fn(params, input_ids):
-        return gpt2_embed(params, input_ids, sp_axis=sp_axis)
+        return gpt2_embed(_cast_tree(params, compute_dtype), input_ids,
+                          sp_axis=sp_axis)
 
     def stage_fn(blocks_local, h):
-        return gpt2_blocks(blocks_local, h, cfg, tp_axis=tp_axis,
-                           sp_axis=sp_axis, remat=remat, use_flash=use_flash)
+        return gpt2_blocks(_cast_tree(blocks_local, compute_dtype), h, cfg,
+                           tp_axis=tp_axis, sp_axis=sp_axis, remat=remat,
+                           use_flash=use_flash)
 
     def head_loss_fn(params, h, labels):
-        logits = gpt2_logits(params, h, cfg)
+        logits = gpt2_logits(_cast_tree(params, compute_dtype), h, cfg)
         if sp_axis is not None:
             return clm_loss_sp(logits, labels, sp_axis=sp_axis)
         return clm_loss(logits, labels)
@@ -261,23 +277,24 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
 
 
 def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
-                    use_flash: bool = False):
+                    use_flash: bool = False, compute_dtype=None):
     from jax.sharding import PartitionSpec as P
 
     from quintnet_tpu.parallel.strategy import ModelSpec
 
     def loss_fn(params, batch, tp_axis=None, sp_axis=None):
         input_ids, labels = batch
-        logits = gpt2_apply(params, input_ids, cfg, tp_axis=tp_axis,
-                            sp_axis=sp_axis, remat=remat,
-                            use_flash=use_flash)
+        logits = gpt2_apply(_cast_tree(params, compute_dtype), input_ids,
+                            cfg, tp_axis=tp_axis, sp_axis=sp_axis,
+                            remat=remat, use_flash=use_flash)
         if sp_axis is not None:
             return clm_loss_sp(logits, labels, sp_axis=sp_axis)
         return clm_loss(logits, labels)
 
     def pipeline_fns(tp_axis=None, sp_axis=None):
         return gpt2_pipeline_fns(cfg, tp_axis=tp_axis, sp_axis=sp_axis,
-                                 remat=remat, use_flash=use_flash)
+                                 remat=remat, use_flash=use_flash,
+                                 compute_dtype=compute_dtype)
 
     def batch_specs(batch_axes, sp_axis=None):
         # (input_ids, labels): batch dim over dp, sequence dim over sp

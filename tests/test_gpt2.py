@@ -168,3 +168,19 @@ def test_gpt2_3d_training_matches_single_device(mesh_dim, mesh_name, schedule):
         np.testing.assert_allclose(
             np.asarray(jax.device_get(leaf)), np.asarray(ref[path]),
             rtol=2e-4, atol=1e-5, err_msg=f"{path}")
+
+
+def test_bf16_compute_keeps_f32_master_params():
+    """Mixed precision: bf16 compute, f32 param storage + grads."""
+    model = gpt2_model_spec(TINY, compute_dtype=jnp.bfloat16)
+    params = model.init(jax.random.key(0))
+    ids, labels = _data(4, 16)
+
+    loss_bf16 = model.loss_fn(params, (ids, labels))
+    loss_f32 = gpt2_model_spec(TINY).loss_fn(params, (ids, labels))
+    # same math at bf16 precision
+    np.testing.assert_allclose(float(loss_bf16), float(loss_f32),
+                               rtol=2e-2)
+    g = jax.grad(lambda p: model.loss_fn(p, (ids, labels)))(params)
+    for leaf in jax.tree.leaves(g):
+        assert leaf.dtype == jnp.float32
