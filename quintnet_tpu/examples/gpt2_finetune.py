@@ -89,6 +89,10 @@ def main():
 
     import jax.numpy as jnp
 
+    if cfg.training.dtype not in ("bfloat16", "float32"):
+        raise ValueError(
+            f"training.dtype must be 'bfloat16' or 'float32', "
+            f"got {cfg.training.dtype!r}")
     compute_dtype = (jnp.bfloat16 if cfg.training.dtype == "bfloat16"
                      else None)
     model = gpt2_model_spec(gcfg, remat=cfg.training.remat,

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from quintnet_tpu.core.pytree import tree_stack
 from quintnet_tpu.nn.layers import (
+    cast_floating as _cast_tree,
     embedding_init,
     gelu,
     layer_norm_apply,
@@ -237,14 +238,6 @@ def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
     if "b" in qkv:
         qkv["b"] = qkv_blocked_from_standard(qkv["b"], cfg.n_head, tp)
     return out
-
-
-def _cast_tree(tree, dtype):
-    if dtype is None:
-        return tree
-    return jax.tree.map(
-        lambda x: x.astype(dtype)
-        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
 
 
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,

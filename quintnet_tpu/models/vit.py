@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from quintnet_tpu.core.config import ModelConfig
 from quintnet_tpu.core.pytree import tree_stack
 from quintnet_tpu.nn.layers import (
+    cast_floating,
     layer_norm_apply,
     layer_norm_init,
     linear_apply,
@@ -127,7 +128,7 @@ def vit_apply(
         images = images.transpose(0, 2, 3, 1)  # NCHW (torch layout) -> NHWC
     if compute_dtype is not None:
         images = images.astype(compute_dtype)
-        params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+        params = cast_floating(params, compute_dtype)
 
     tp = 1
     if tp_axis is not None:

@@ -23,6 +23,20 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def cast_floating(tree, dtype):
+    """Cast floating-point leaves to ``dtype`` (None -> no-op).
+
+    The mixed-precision cast-at-use policy: storage stays f32 master
+    copies; astype's transpose accumulates grads back in f32. Integer
+    leaves (e.g. token ids living inside a batch pytree) pass through.
+    """
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
 def _uniform_init(key, shape, scale, dtype):
     return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
 
