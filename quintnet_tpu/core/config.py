@@ -112,6 +112,7 @@ class TrainingConfig:
     grad_clip_norm: Optional[float] = 1.0
     seed: int = 0
     schedule: str = "1f1b"  # 1f1b | afab (reference: schedule.py:39-516)
+    sp_mode: str = "ring"  # ring | ulysses (sequence-parallel attention)
     dtype: str = "float32"
     param_dtype: str = "float32"
     remat: bool = False

@@ -185,6 +185,14 @@ class SummarizationDataset:
         pad = getattr(self.tok, "pad_token_id", 0) or 0
         prompt_ids = self.tok.encode(article + self.PROMPT)
         summ_ids = self.tok.encode(summary)
+        # Keep the training signal: when prompt+summary overflow, drop
+        # article tokens from the LEFT (the "\n\nTL;DR: " marker at the
+        # prompt's tail survives). Plain right-truncation can leave a row
+        # with every label masked — at small max_length whole batches
+        # become no-ops and the loss is silently 0.
+        max_prompt = max(self.max_length - len(summ_ids), 0)
+        if len(prompt_ids) > max_prompt:
+            prompt_ids = prompt_ids[len(prompt_ids) - max_prompt:]
         ids = (prompt_ids + summ_ids)[: self.max_length]
         n_prompt = min(len(prompt_ids), self.max_length)
         labels = [-100] * n_prompt + ids[n_prompt:]

@@ -96,6 +96,7 @@ def main():
     compute_dtype = (jnp.bfloat16 if cfg.training.dtype == "bfloat16"
                      else None)
     model = gpt2_model_spec(gcfg, remat=cfg.training.remat,
+                            sp_mode=cfg.training.sp_mode,
                             compute_dtype=compute_dtype)
     strategy = get_strategy(cfg.strategy_name, cfg)
     print(f"strategy={strategy.name} mesh={dict(strategy.mesh.shape)} "

@@ -127,8 +127,8 @@ def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None):
 
 def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
                 tp_axis: Optional[str] = None,
-                sp_axis: Optional[str] = None, remat: bool = False,
-                use_flash: bool = False):
+                sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                remat: bool = False, use_flash: bool = False):
     tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
     return stacked_blocks_apply(
         params_blocks, h,
@@ -137,6 +137,7 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
         act=gelu,
         tp_axis=tp_axis,
         sp_axis=sp_axis,
+        sp_mode=sp_mode,
         remat=remat,
         use_flash=use_flash,
     )
@@ -152,11 +153,12 @@ def gpt2_logits(params, h, cfg: GPT2Config):
 
 def gpt2_apply(params, input_ids, cfg: GPT2Config, *,
                tp_axis: Optional[str] = None,
-               sp_axis: Optional[str] = None, remat: bool = False,
-               use_flash: bool = False):
+               sp_axis: Optional[str] = None, sp_mode: str = "ring",
+               remat: bool = False, use_flash: bool = False):
     h = gpt2_embed(params, input_ids, sp_axis=sp_axis)
     h = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
-                    sp_axis=sp_axis, remat=remat, use_flash=use_flash)
+                    sp_axis=sp_axis, sp_mode=sp_mode, remat=remat,
+                    use_flash=use_flash)
     return gpt2_logits(params, h, cfg)
 
 
@@ -241,7 +243,7 @@ def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
 
 
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
-                      sp_axis: Optional[str] = None,
+                      sp_axis: Optional[str] = None, sp_mode: str = "ring",
                       remat: bool = False, use_flash: bool = False,
                       compute_dtype=None):
     """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py.
@@ -257,8 +259,8 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
 
     def stage_fn(blocks_local, h):
         return gpt2_blocks(_cast_tree(blocks_local, compute_dtype), h, cfg,
-                           tp_axis=tp_axis, sp_axis=sp_axis, remat=remat,
-                           use_flash=use_flash)
+                           tp_axis=tp_axis, sp_axis=sp_axis, sp_mode=sp_mode,
+                           remat=remat, use_flash=use_flash)
 
     def head_loss_fn(params, h, labels):
         logits = gpt2_logits(_cast_tree(params, compute_dtype), h, cfg)
@@ -270,7 +272,8 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
 
 
 def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
-                    use_flash: bool = False, compute_dtype=None):
+                    use_flash: bool = False, sp_mode: str = "ring",
+                    compute_dtype=None):
     from jax.sharding import PartitionSpec as P
 
     from quintnet_tpu.parallel.strategy import ModelSpec
@@ -279,14 +282,16 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
         input_ids, labels = batch
         logits = gpt2_apply(_cast_tree(params, compute_dtype), input_ids,
                             cfg, tp_axis=tp_axis, sp_axis=sp_axis,
-                            remat=remat, use_flash=use_flash)
+                            sp_mode=sp_mode, remat=remat,
+                            use_flash=use_flash)
         if sp_axis is not None:
             return clm_loss_sp(logits, labels, sp_axis=sp_axis)
         return clm_loss(logits, labels)
 
     def pipeline_fns(tp_axis=None, sp_axis=None):
         return gpt2_pipeline_fns(cfg, tp_axis=tp_axis, sp_axis=sp_axis,
-                                 remat=remat, use_flash=use_flash,
+                                 sp_mode=sp_mode, remat=remat,
+                                 use_flash=use_flash,
                                  compute_dtype=compute_dtype)
 
     def batch_specs(batch_axes, sp_axis=None):

@@ -59,6 +59,7 @@ def mha_apply(
     causal: bool = False,
     tp_axis: Optional[str] = None,
     sp_axis: Optional[str] = None,
+    sp_mode: str = "ring",
     use_flash: bool = False,
 ):
     """x: [B, S_local, D] -> [B, S_local, D].
@@ -66,8 +67,10 @@ def mha_apply(
     ``num_heads`` is the number of LOCAL heads (global heads / tp_size when
     sharded — head-sharding exactly as gpt2_attention.py:89-95).
     With ``sp_axis`` the sequence dim is sharded and the inner attention
-    runs the ring algorithm (ops/ring_attention.py) — long-context
-    support the reference does not have.
+    runs sequence-parallel — long-context support the reference does not
+    have. ``sp_mode`` picks the algorithm: 'ring' (K/V rotation via
+    ppermute, ops/ring_attention.py) or 'ulysses' (head-scatter
+    all-to-all, ops/ulysses_attention.py; composes with flash).
     """
     qkv = linear_apply(p["qkv"], x)  # [B, S, 3*D_local]
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -75,7 +78,15 @@ def mha_apply(
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
 
-    if sp_axis is not None:
+    if sp_axis is not None and sp_mode == "ulysses":
+        from quintnet_tpu.ops.ulysses_attention import ulysses_attention
+
+        o = ulysses_attention(q, k, v, axis=sp_axis, causal=causal,
+                              use_flash=use_flash)
+    elif sp_axis is not None:
+        if sp_mode != "ring":
+            raise ValueError(
+                f"unknown sp_mode {sp_mode!r}; expected 'ring' or 'ulysses'")
         from quintnet_tpu.ops.ring_attention import ring_attention
 
         o = ring_attention(q, k, v, axis=sp_axis, causal=causal)

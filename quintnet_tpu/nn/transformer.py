@@ -48,6 +48,7 @@ def block_apply(
     act: Callable = gelu,
     tp_axis: Optional[str] = None,
     sp_axis: Optional[str] = None,
+    sp_mode: str = "ring",
     use_flash: bool = False,
 ):
     x = x + mha_apply(
@@ -57,6 +58,7 @@ def block_apply(
         causal=causal,
         tp_axis=tp_axis,
         sp_axis=sp_axis,
+        sp_mode=sp_mode,
         use_flash=use_flash,
     )
     x = x + mlp_apply(p["mlp"], layer_norm_apply(p["ln2"], x), act=act, tp_axis=tp_axis)
@@ -72,6 +74,7 @@ def stacked_blocks_apply(
     act: Callable = gelu,
     tp_axis: Optional[str] = None,
     sp_axis: Optional[str] = None,
+    sp_mode: str = "ring",
     use_flash: bool = False,
     remat: bool = False,
 ):
@@ -89,6 +92,7 @@ def stacked_blocks_apply(
         act=act,
         tp_axis=tp_axis,
         sp_axis=sp_axis,
+        sp_mode=sp_mode,
         use_flash=use_flash,
     )
     if remat:

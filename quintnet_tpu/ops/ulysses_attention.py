@@ -1,0 +1,66 @@
+"""Ulysses attention: all-to-all head-scatter over a sequence axis.
+
+Second long-context mode alongside ring attention (the reference has NO
+sequence/context parallelism — SURVEY §5.7). Where ring attention keeps
+queries resident and rotates K/V chunks around the ``sp`` ring in sp
+steps, Ulysses (DeepSpeed-Ulysses, Jacobs et al. 2023) pays exactly two
+all-to-alls: one to exchange the head dim for the sequence dim (each
+device ends up with H_local/sp heads but the FULL sequence), one to swap
+back after attention. In between, attention is an ordinary local call —
+so it composes with the Pallas flash kernel, which the ring formulation
+cannot use across chunks.
+
+Trade-off (scaling-book mental model): ring moves O(S·D) K/V bytes per
+step for sp steps but overlaps them with compute; Ulysses moves
+O(S·D·3/sp) once per direction on the fast ICI all-to-all and needs
+``local_heads % sp == 0``. For head-rich models at moderate sp, Ulysses
+is usually faster; ring scales to sp > n_heads.
+
+TPU mapping: ``lax.all_to_all(tiled=True)`` lowers to a single XLA
+AllToAll riding ICI; both collectives are differentiable by
+construction (the transpose of an all-to-all is the reverse
+all-to-all), so this file contains no custom VJP.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from quintnet_tpu.nn import attention as _attn
+
+
+def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
+                      use_flash: bool = False):
+    """Attention over sequence-sharded inputs via two all-to-alls.
+
+    q/k/v: [B, H_local, S_local, Dh] with the sequence dim sharded over
+    mesh axis ``axis``. Requires H_local divisible by the axis size.
+    Returns [B, H_local, S_local, Dh], numerically equal to full-sequence
+    attention on the gathered sequence (tests/test_sp.py golden checks).
+    """
+    sp = lax.axis_size(axis)
+    h_local = q.shape[1]
+    if h_local % sp != 0:
+        raise ValueError(
+            f"ulysses attention needs local heads ({h_local}) divisible by "
+            f"sp axis size ({sp}); use ring attention (sp_mode='ring') for "
+            "sp larger than the head count")
+
+    # scatter heads, gather sequence: [B, H/sp, S_full, Dh]. Source-rank
+    # order == sequence-chunk order, so the concat reassembles the
+    # sequence correctly. q/k/v ride ONE collective (stacked on a leading
+    # axis) so the whole layer costs two all-to-all dispatches, fwd+bwd.
+    qkv = jnp.stack([q, k, v])  # [3, B, H_local, S_local, Dh]
+    qkv = lax.all_to_all(qkv, axis, split_axis=2, concat_axis=3, tiled=True)
+    qf, kf, vf = qkv[0], qkv[1], qkv[2]
+
+    if use_flash:
+        from quintnet_tpu.ops.flash_attention import flash_attention
+
+        of = flash_attention(qf, kf, vf, causal=causal)
+    else:
+        of = _attn.sdpa(qf, kf, vf, causal=causal)
+
+    # gather heads back, re-scatter sequence: [B, H_local, S_local, Dh]
+    return lax.all_to_all(of, axis, split_axis=2, concat_axis=1, tiled=True)

@@ -102,13 +102,102 @@ def test_gpt2_sp_forward_matches_single_device(mesh_sp):
                                rtol=2e-3, atol=2e-4)
 
 
-@pytest.mark.parametrize("mesh_dim,mesh_name,schedule,grad_acc", [
-    ([4], ["sp"], "afab", 1),
-    ([2, 2], ["dp", "sp"], "afab", 1),
-    ([2, 2, 2], ["tp", "pp", "sp"], "1f1b", 2),
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_sdpa(mesh_sp, causal):
+    from quintnet_tpu.ops.ulysses_attention import ulysses_attention
+
+    b, h, s, d = 2, 4, 32, 8
+    q = jax.random.normal(jax.random.key(0), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(1), (b, h, s, d))
+    v = jax.random.normal(jax.random.key(2), (b, h, s, d))
+
+    ref = sdpa(q, k, v, causal=causal)
+
+    out = cc.shard_map_fn(
+        lambda q_, k_, v_: ulysses_attention(q_, k_, v_, axis="sp",
+                                             causal=causal),
+        mesh_sp,
+        in_specs=(P(None, None, "sp"), P(None, None, "sp"),
+                  P(None, None, "sp")),
+        out_specs=P(None, None, "sp"),
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_attention_grads_match(mesh_sp):
+    from quintnet_tpu.ops.ulysses_attention import ulysses_attention
+
+    b, h, s, d = 1, 4, 16, 4
+    q = jax.random.normal(jax.random.key(0), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(1), (b, h, s, d))
+    v = jax.random.normal(jax.random.key(2), (b, h, s, d))
+    w = jax.random.normal(jax.random.key(3), (b, h, s, d))
+
+    def ref_loss(q_, k_, v_):
+        return jnp.sum(sdpa(q_, k_, v_, causal=True) * w)
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    def local(q_, k_, v_, w_):
+        def loss(a, b_, c):
+            out = ulysses_attention(a, b_, c, axis="sp", causal=True)
+            return jnp.sum(out * w_)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+
+    sp_spec = P(None, None, "sp")
+    g = cc.shard_map_fn(
+        local, mesh_sp,
+        in_specs=(sp_spec,) * 4,
+        out_specs=(sp_spec,) * 3,
+    )(q, k, v, w)
+    for a, b_ in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(mesh_sp):
+    from quintnet_tpu.ops.ulysses_attention import ulysses_attention
+
+    b, h, s, d = 1, 2, 16, 4  # 2 local heads, sp=4 -> invalid
+    q = jax.random.normal(jax.random.key(0), (b, h, s, d))
+    with pytest.raises(ValueError, match="divisible"):
+        cc.shard_map_fn(
+            lambda q_: ulysses_attention(q_, q_, q_, axis="sp"),
+            mesh_sp,
+            in_specs=(P(None, None, "sp"),),
+            out_specs=P(None, None, "sp"),
+        )(q)
+
+
+def test_gpt2_sp_ulysses_forward_matches_single_device(mesh_sp):
+    params = gpt2_init(jax.random.key(0), TINY)
+    ids = jax.random.randint(jax.random.key(1), (2, 32), 0, TINY.vocab_size)
+
+    ref = gpt2_apply(params, ids, TINY)
+
+    out = cc.shard_map_fn(
+        lambda p, i: gpt2_apply(p, i, TINY, sp_axis="sp",
+                                sp_mode="ulysses"),
+        mesh_sp,
+        in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("mesh_dim,mesh_name,schedule,grad_acc,sp_mode", [
+    ([4], ["sp"], "afab", 1, "ring"),
+    ([4], ["sp"], "afab", 1, "ulysses"),
+    ([2, 2], ["dp", "sp"], "afab", 1, "ring"),
+    ([2, 2, 2], ["tp", "pp", "sp"], "1f1b", 2, "ring"),
+    ([2, 2, 2], ["tp", "pp", "sp"], "1f1b", 2, "ulysses"),
 ])
 def test_gpt2_sp_train_step_matches_single_device(mesh_dim, mesh_name,
-                                                  schedule, grad_acc):
+                                                  schedule, grad_acc,
+                                                  sp_mode):
     cfg = Config.from_dict({
         "mesh_dim": mesh_dim, "mesh_name": mesh_name,
         "training": {"batch_size": 4, "gradient_accumulation_steps": grad_acc,
@@ -127,7 +216,7 @@ def test_gpt2_sp_train_step_matches_single_device(mesh_dim, mesh_name,
                                                    params)[0])
 
     strat = get_strategy("auto", cfg)
-    model = gpt2_model_spec(TINY)
+    model = gpt2_model_spec(TINY, sp_mode=sp_mode)
     p = strat.shard_params(model, params)
     s = strat.init_opt_state(model, opt, p)
     b = strat.shard_batch(batch, model)

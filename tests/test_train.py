@@ -51,6 +51,21 @@ def test_summarization_encoding_masks_prompt():
     assert (labels[n_prompt + 2:] == -100).all()  # padding masked
 
 
+def test_summarization_encoding_keeps_summary_on_overflow():
+    """Long articles must left-truncate so the summary labels survive
+    (right-truncation silently masks every label -> zero loss)."""
+    tok = ByteTokenizer()
+    long_article = "x" * 100
+    ds = SummarizationDataset([(long_article, "hi")], tok, max_length=32)
+    ids, labels = ds.encode_row(long_article, "hi")
+    assert ids.shape == (32,)
+    n_valid = int((labels != -100).sum())
+    assert n_valid == len(tok.encode("hi"))
+    # the TL;DR marker at the prompt tail survives the left-truncation
+    marker = tok.encode(ds.PROMPT)
+    assert list(ids[32 - n_valid - len(marker):32 - n_valid]) == list(marker)
+
+
 def test_rouge_bleu():
     r = M.rouge_scores("the cat sat", "the cat sat")
     assert r["rouge1"] == r["rouge2"] == r["rougeL"] == 1.0
