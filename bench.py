@@ -21,19 +21,31 @@ import time
 
 
 def flops_per_token_gpt2(cfg) -> float:
-    """Approximate training FLOPs/token: 6 * N params (fwd+bwd) plus
-    attention term 12 * n_layer * n_embd * seq."""
+    """Approximate training FLOPs/token: 6 * N_active params (fwd+bwd).
+
+    For MoE configs the FFN term counts the executed capacity rows —
+    top_k * capacity_factor per token (the [E, C, D] expert einsums run
+    over padding rows too) — plus the router matmul."""
+    d = cfg.n_embd
+    attn_params = 4 * d * d
+    ffn_params = 8 * d * d
+    if getattr(cfg, "n_experts", 0) > 0:
+        ffn_params = (cfg.expert_top_k * cfg.capacity_factor * 8 * d * d
+                      + d * cfg.n_experts)
     n_params = (
-        cfg.vocab_size * cfg.n_embd
-        + cfg.n_positions * cfg.n_embd
-        + cfg.n_layer * (12 * cfg.n_embd * cfg.n_embd + 13 * cfg.n_embd)
+        cfg.vocab_size * d
+        + cfg.n_positions * d
+        + cfg.n_layer * (attn_params + ffn_params + 13 * d)
     )
     return 6.0 * n_params
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="gpt2", choices=["gpt2", "vit"])
+    ap.add_argument("--model", default="gpt2",
+                    choices=["gpt2", "gpt2-moe", "vit"])
+    ap.add_argument("--experts", type=int, default=8,
+                    help="expert count for --model gpt2-moe")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--batch", type=int, default=8)
@@ -59,10 +71,14 @@ def main():
     })
     strat = get_strategy("auto" if n_dev > 1 else "dp", cfg)
 
-    if args.model == "gpt2":
+    if args.model in ("gpt2", "gpt2-moe"):
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
 
-        gcfg = GPT2Config.base()
+        if args.model == "gpt2-moe":
+            gcfg = GPT2Config(n_experts=args.experts,
+                              expert_top_k=2)
+        else:
+            gcfg = GPT2Config.base()
         compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
         model = gpt2_model_spec(gcfg, remat=True,
                                 compute_dtype=compute_dtype)
@@ -72,7 +88,9 @@ def main():
         batch = (jnp.asarray(ids), jnp.asarray(ids))
         flops_per_step = (flops_per_token_gpt2(gcfg)
                           * args.batch * n_dev * args.seq)
-        metric = f"gpt2_124m_seq{args.seq}_train_samples_per_sec_per_chip"
+        name = "gpt2_124m" if args.model == "gpt2" else \
+            f"gpt2_moe{args.experts}"
+        metric = f"{name}_seq{args.seq}_train_samples_per_sec_per_chip"
     else:
         from quintnet_tpu.models.vit import ViTConfig, vit_model_spec
 

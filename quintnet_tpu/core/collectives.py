@@ -60,6 +60,17 @@ def reduce_scatter(x, axis: AxisName, *, scatter_dim: int = -1):
                             tiled=True)
 
 
+def all_to_all(x, axis: AxisName, *, split_dim: int, concat_dim: int):
+    """Transpose data across ``axis``: split ``split_dim`` into one chunk
+    per member, exchange, concatenate received chunks along ``concat_dim``
+    (source-rank order). No reference analogue — torch.distributed
+    all_to_all is never used there; here it powers Ulysses sequence
+    parallelism (ops/ulysses_attention.py) and MoE expert dispatch
+    (nn/moe.py)."""
+    return lax.all_to_all(x, axis, _canon(split_dim, x.ndim),
+                          _canon(concat_dim, x.ndim), tiled=True)
+
+
 def _canon(dim: int, ndim: int) -> int:
     return dim % ndim
 

@@ -196,17 +196,22 @@ class Config:
     def sp_size(self) -> int:
         return self.mesh.size("sp")
 
+    @property
+    def ep_size(self) -> int:
+        return self.mesh.size("ep")
+
     def micro_batch_size_resolved(self) -> int:
-        """micro = batch // (grad_acc * dp), the reference's formula
-        (trainer.py:99-146)."""
+        """micro = batch // (grad_acc * dp * ep), the reference's formula
+        (trainer.py:99-146) extended to ep, which also shards the batch
+        dim (parallel/strategy.py)."""
         t = self.training
         if t.micro_batch_size is not None:
             return t.micro_batch_size
-        denom = t.gradient_accumulation_steps * self.dp_size
+        denom = t.gradient_accumulation_steps * self.dp_size * self.ep_size
         if self.training.batch_size % denom != 0:
             raise ValueError(
                 f"batch_size {t.batch_size} not divisible by "
-                f"grad_acc*dp = {denom}"
+                f"grad_acc*dp*ep = {denom}"
             )
         return t.batch_size // denom
 

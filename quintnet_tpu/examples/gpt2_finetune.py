@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--tiny", action="store_true",
                     help="use a tiny GPT-2 (smoke/sim runs)")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="n_experts: turn the model into a GPT-2-MoE "
+                         "(shard them with an 'ep' mesh axis)")
     args = ap.parse_args()
 
     from quintnet_tpu.examples.common import setup_platform
@@ -68,6 +71,10 @@ def main():
         gcfg = GPT2Config.from_dict(
             {**cfg.model.extra, **{k: v for k, v in vars(cfg.model).items()
                                    if not isinstance(v, dict)}})
+    if args.experts:
+        import dataclasses as _dc
+
+        gcfg = _dc.replace(gcfg, n_experts=args.experts)
 
     max_len = int(cfg.data.get("max_seq_length", 512))
     if args.tiny:
@@ -107,6 +114,11 @@ def main():
 
     if args.checkpoint:
         host_params, _ = load_hf_gpt2(args.checkpoint, gcfg)
+        if gcfg.n_experts > 0:
+            # HF checkpoints are dense; sparse-upcycle into the MoE
+            from quintnet_tpu.models.gpt2 import gpt2_upcycle_to_moe
+
+            host_params = gpt2_upcycle_to_moe(host_params, gcfg)
         params = strategy.shard_params(model, host_params)
         opt_state = strategy.init_opt_state(model, trainer.optimizer, params)
     else:

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 
 from quintnet_tpu.core.pytree import tree_stack
 from quintnet_tpu.nn.layers import (
-    cast_floating as _cast_tree,
+    cast_floating,
+    keep_router_f32,
     embedding_init,
     gelu,
     layer_norm_apply,
@@ -41,6 +42,12 @@ from quintnet_tpu.nn.layers import (
 from quintnet_tpu.nn.transformer import block_init, stacked_blocks_apply
 
 IGNORE_INDEX = -100  # reference: CE ignore_index=-100 (GPT2_Trainer.py:109)
+
+
+def _cast_tree(tree, dtype):
+    """Mixed-precision cast keeping the MoE router at f32 (its gate
+    ordering is bf16-sensitive — nn/moe.py, nn/layers.py)."""
+    return cast_floating(tree, dtype, exclude=keep_router_f32)
 
 
 @dataclass(frozen=True)
@@ -54,10 +61,35 @@ class GPT2Config:
     n_head: int = 12
     layer_norm_epsilon: float = 1e-5
     dropout: float = 0.0
+    # --- MoE (0 experts = dense; the reference has no MoE/EP at all,
+    # SURVEY.md §2.2 "EP — Absent"). Every block's MLP becomes a top-k
+    # routed MoE FFN (nn/moe.py), expert-shardable over the ``ep`` axis.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    expert_capacity: Optional[int] = None
+    aux_loss_weight: float = 1e-2
+    router_z_weight: float = 0.0
 
     @property
     def mlp_hidden(self) -> int:
         return 4 * self.n_embd
+
+    @property
+    def moe_args(self):
+        """nn/moe.py MoEArgs for this config, or None when dense."""
+        if self.n_experts <= 0:
+            return None
+        from quintnet_tpu.nn.moe import MoEArgs
+
+        return MoEArgs(
+            n_experts=self.n_experts,
+            top_k=self.expert_top_k,
+            capacity_factor=self.capacity_factor,
+            capacity=self.expert_capacity,
+            aux_weight=self.aux_loss_weight,
+            z_weight=self.router_z_weight,
+        )
 
     @staticmethod
     def base() -> "GPT2Config":
@@ -94,7 +126,8 @@ def gpt2_init(key, cfg: GPT2Config, *, dtype=jnp.float32):
     k_wte, k_wpe, k_blocks = jax.random.split(key, 3)
     block_keys = jax.random.split(k_blocks, cfg.n_layer)
     blocks = tree_stack(
-        [block_init(bk, cfg.n_embd, mlp_hidden=cfg.mlp_hidden, dtype=dtype)
+        [block_init(bk, cfg.n_embd, mlp_hidden=cfg.mlp_hidden, dtype=dtype,
+                    moe=cfg.moe_args)
          for bk in block_keys]
     )
     return {
@@ -107,6 +140,37 @@ def gpt2_init(key, cfg: GPT2Config, *, dtype=jnp.float32):
         "blocks": blocks,
         "head": {"ln_f": layer_norm_init(cfg.n_embd, dtype)},
     }
+
+
+def gpt2_upcycle_to_moe(params, cfg: GPT2Config, key=None):
+    """Sparse upcycling: dense GPT-2 params -> MoE params for a config
+    with ``n_experts > 0``. Every expert starts as a copy of the dense
+    MLP; routers start near-zero so initial routing is ~uniform and the
+    upcycled model's function approximates the dense one. Used by the
+    finetune entry point when --experts is combined with --checkpoint
+    (there is no reference analogue — the reference has no MoE)."""
+    if cfg.n_experts <= 0:
+        return params
+    if "moe" in params["blocks"]:
+        return params  # already MoE
+    key = key if key is not None else jax.random.key(0)
+    E = cfg.n_experts
+    blocks = dict(params["blocks"])
+    mlp = blocks.pop("mlp")
+    L = mlp["fc"]["w"].shape[0]
+
+    def per_expert(x):  # [L, ...] -> [L, E, ...]
+        return jnp.repeat(x[:, None], E, axis=1)
+
+    blocks["moe"] = {
+        "router": {"w": 1e-2 * jax.random.normal(
+            key, (L, cfg.n_embd, E), jnp.float32)},
+        "w1": per_expert(mlp["fc"]["w"]),
+        "b1": per_expert(mlp["fc"]["b"]),
+        "w2": per_expert(mlp["proj"]["w"]),
+        "b2": per_expert(mlp["proj"]["b"]),
+    }
+    return {**params, "blocks": blocks}
 
 
 def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None):
@@ -128,7 +192,10 @@ def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None):
 def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                ep_axis: Optional[str] = None,
                 remat: bool = False, use_flash: bool = False):
+    """Returns ``h`` for dense configs, ``(h, moe_aux)`` when
+    ``cfg.n_experts > 0``."""
     tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
     return stacked_blocks_apply(
         params_blocks, h,
@@ -140,6 +207,8 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
         sp_mode=sp_mode,
         remat=remat,
         use_flash=use_flash,
+        moe_args=cfg.moe_args,
+        ep_axis=ep_axis,
     )
 
 
@@ -151,15 +220,30 @@ def gpt2_logits(params, h, cfg: GPT2Config):
     return jnp.dot(h, params["embedding"]["wte"].T).astype(jnp.float32)
 
 
+def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
+                 tp_axis: Optional[str] = None,
+                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                 ep_axis: Optional[str] = None,
+                 remat: bool = False, use_flash: bool = False):
+    """-> (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs."""
+    h = gpt2_embed(params, input_ids, sp_axis=sp_axis)
+    out = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
+                      sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
+                      remat=remat, use_flash=use_flash)
+    h, aux = out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
+    return gpt2_logits(params, h, cfg), aux
+
+
 def gpt2_apply(params, input_ids, cfg: GPT2Config, *,
                tp_axis: Optional[str] = None,
                sp_axis: Optional[str] = None, sp_mode: str = "ring",
+               ep_axis: Optional[str] = None,
                remat: bool = False, use_flash: bool = False):
-    h = gpt2_embed(params, input_ids, sp_axis=sp_axis)
-    h = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
-                    sp_axis=sp_axis, sp_mode=sp_mode, remat=remat,
-                    use_flash=use_flash)
-    return gpt2_logits(params, h, cfg)
+    logits, _ = gpt2_forward(params, input_ids, cfg, tp_axis=tp_axis,
+                             sp_axis=sp_axis, sp_mode=sp_mode,
+                             ep_axis=ep_axis, remat=remat,
+                             use_flash=use_flash)
+    return logits
 
 
 def clm_loss(logits, labels):
@@ -215,14 +299,22 @@ def perplexity(loss):
 
 def gpt2_partition_specs(cfg: Optional[GPT2Config] = None, *,
                          tp_axis: Optional[str] = "tp",
-                         pp_axis: Optional[str] = None):
+                         pp_axis: Optional[str] = None,
+                         ep_axis: Optional[str] = None):
     from jax.sharding import PartitionSpec as P
 
     from quintnet_tpu.parallel.tp import block_specs
 
+    bspecs = block_specs(tp_axis=tp_axis, stacked=True, pp_axis=pp_axis)
+    if cfg is not None and cfg.n_experts > 0:
+        from quintnet_tpu.nn.moe import moe_specs
+
+        del bspecs["mlp"]
+        bspecs["moe"] = moe_specs(ep_axis=ep_axis, tp_axis=tp_axis,
+                                  stacked=True, pp_axis=pp_axis)
     return {
         "embedding": {"wte": P(), "wpe": P()},
-        "blocks": block_specs(tp_axis=tp_axis, stacked=True, pp_axis=pp_axis),
+        "blocks": bspecs,
         "head": {"ln_f": {"scale": P(), "bias": P()}},
     }
 
@@ -244,6 +336,7 @@ def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
 
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                      ep_axis: Optional[str] = None,
                       remat: bool = False, use_flash: bool = False,
                       compute_dtype=None):
     """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py.
@@ -251,6 +344,9 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
     ``compute_dtype=jnp.bfloat16``: params are cast at use (storage stays
     f32 master copies; the cast's transpose accumulates grads back in
     f32) — the TPU mixed-precision default. Softmax/LN/loss stay f32.
+
+    MoE configs make ``stage_fn`` return ``(h, aux)`` — the schedules in
+    parallel/pp.py accumulate each stage's aux into the loss.
     """
 
     def embed_fn(params, input_ids):
@@ -260,7 +356,7 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
     def stage_fn(blocks_local, h):
         return gpt2_blocks(_cast_tree(blocks_local, compute_dtype), h, cfg,
                            tp_axis=tp_axis, sp_axis=sp_axis, sp_mode=sp_mode,
-                           remat=remat, use_flash=use_flash)
+                           ep_axis=ep_axis, remat=remat, use_flash=use_flash)
 
     def head_loss_fn(params, h, labels):
         logits = gpt2_logits(_cast_tree(params, compute_dtype), h, cfg)
@@ -278,32 +374,34 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
 
     from quintnet_tpu.parallel.strategy import ModelSpec
 
-    def loss_fn(params, batch, tp_axis=None, sp_axis=None):
+    def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None):
         input_ids, labels = batch
-        logits = gpt2_apply(_cast_tree(params, compute_dtype), input_ids,
-                            cfg, tp_axis=tp_axis, sp_axis=sp_axis,
-                            sp_mode=sp_mode, remat=remat,
-                            use_flash=use_flash)
+        logits, aux = gpt2_forward(_cast_tree(params, compute_dtype),
+                                   input_ids, cfg, tp_axis=tp_axis,
+                                   sp_axis=sp_axis, sp_mode=sp_mode,
+                                   ep_axis=ep_axis, remat=remat,
+                                   use_flash=use_flash)
         if sp_axis is not None:
-            return clm_loss_sp(logits, labels, sp_axis=sp_axis)
-        return clm_loss(logits, labels)
+            return clm_loss_sp(logits, labels, sp_axis=sp_axis) + aux
+        return clm_loss(logits, labels) + aux
 
-    def pipeline_fns(tp_axis=None, sp_axis=None):
+    def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
         return gpt2_pipeline_fns(cfg, tp_axis=tp_axis, sp_axis=sp_axis,
-                                 sp_mode=sp_mode, remat=remat,
-                                 use_flash=use_flash,
+                                 sp_mode=sp_mode, ep_axis=ep_axis,
+                                 remat=remat, use_flash=use_flash,
                                  compute_dtype=compute_dtype)
 
     def batch_specs(batch_axes, sp_axis=None):
-        # (input_ids, labels): batch dim over dp, sequence dim over sp
+        # (input_ids, labels): batch dim over dp (+ep), sequence dim over sp
         spec = P(tuple(batch_axes) if batch_axes else None, sp_axis)
         return (spec, spec)
 
     return ModelSpec(
         init=lambda key: gpt2_init(key, cfg),
         loss_fn=loss_fn,
-        partition_specs=lambda tp_axis=None, pp_axis=None:
-            gpt2_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis),
+        partition_specs=lambda tp_axis=None, pp_axis=None, ep_axis=None:
+            gpt2_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
+                                 ep_axis=ep_axis),
         pipeline_fns=pipeline_fns,
         to_tp_layout=lambda p, tp: gpt2_to_tp_layout(p, cfg, tp),
         depth=cfg.n_layer,

@@ -237,15 +237,15 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
     (parallel/strategy.py)."""
     from quintnet_tpu.parallel.strategy import ModelSpec
 
-    def loss_fn(params, batch, tp_axis=None, sp_axis=None):
+    def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None):
         x, y = batch
         return cross_entropy_loss(
             vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat), y)
 
-    def pipeline_fns(tp_axis=None, sp_axis=None):
+    def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
         return vit_pipeline_fns(cfg, tp_axis=tp_axis, remat=remat)
 
-    def partition_specs(tp_axis=None, pp_axis=None):
+    def partition_specs(tp_axis=None, pp_axis=None, ep_axis=None):
         return vit_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
 
     def to_tp_layout(params, tp):

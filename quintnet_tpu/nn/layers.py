@@ -23,18 +23,38 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def cast_floating(tree, dtype):
+def cast_floating(tree, dtype, *, exclude=None):
     """Cast floating-point leaves to ``dtype`` (None -> no-op).
 
     The mixed-precision cast-at-use policy: storage stays f32 master
     copies; astype's transpose accumulates grads back in f32. Integer
     leaves (e.g. token ids living inside a batch pytree) pass through.
+
+    ``exclude(path) -> bool`` keeps matching leaves at their stored
+    dtype — used to pin precision-critical leaves (the MoE router, whose
+    gate ORDERING changes under bf16 rounding — nn/moe.py) at f32.
     """
     if dtype is None:
         return tree
-    return jax.tree.map(
-        lambda x: x.astype(dtype)
-        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast(x):
+        return (x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+    if exclude is None:
+        return jax.tree.map(cast, tree)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: x if exclude(path) else cast(x), tree)
+
+
+def _path_has_key(path, name: str) -> bool:
+    """True if any pytree path element is a dict key == name."""
+    return any(getattr(p, "key", None) == name for p in path)
+
+
+def keep_router_f32(path) -> bool:
+    """cast_floating exclude-predicate pinning MoE router weights to f32."""
+    return _path_has_key(path, "router")
 
 
 def _uniform_init(key, shape, scale, dtype):

@@ -1,0 +1,186 @@
+"""Mixture-of-Experts layer with expert parallelism over the ``ep`` axis.
+
+The reference lists EP/MoE as absent (SURVEY.md §2.2: "EP / expert
+parallel (MoE) — Absent"; the package docstring's "Towards 5D
+Parallelism", reference __init__.py:2, never materialises). Here expert
+parallelism is a first-class mesh axis, built the TPU way:
+
+- **Routing** is dense math on the MXU: top-k gate over a [S, E] router
+  matmul, capacity-bounded dispatch with static shapes (XLA-friendly: no
+  dynamic shapes, drops are masked writes to a dump row, not ragged
+  tensors).
+- **Dispatch/combine** are scatter-adds into a [E*C, D] buffer (O(S*k*D)
+  work) rather than the O(S^2)-memory one-hot dispatch einsum.
+- **Expert exchange** is one ``lax.all_to_all`` over ``ep`` each way —
+  the same collective family as Ulysses (ops/ulysses_attention.py), so
+  it rides ICI on a TPU slice. Each device owns E/ep experts and
+  processes ep*C rows per expert per step.
+- **TP composes**: expert FFN weights may additionally be column/row
+  sharded over ``tp`` (w1 on hidden-out, w2 on hidden-in, one psum).
+
+Gradient semantics (parallel/train_step.py): ``ep`` acts as a *data*
+axis — tokens are sharded over it — while expert weights are *sharded*
+over it. The all_to_all transpose delivers each expert's grad already
+summed over every source rank, so reduce_grads divides ep-sharded leaves
+by ep instead of pmeaning them.
+
+Load-balance auxiliary loss follows the Switch-Transformer form
+(E * sum_e f_e * P_e over the k assignments) computed on the device-local
+token batch, plus an optional router z-loss.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from quintnet_tpu.core import collectives as cc
+from quintnet_tpu.nn.layers import gelu
+
+
+class MoEArgs(NamedTuple):
+    """Static MoE hyperparameters (trace-time constants)."""
+
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    capacity: Optional[int] = None  # explicit per-rank per-expert override
+    aux_weight: float = 1e-2
+    z_weight: float = 0.0
+    normalize_gates: bool = True
+
+
+def moe_init(key, dim: int, hidden: int, n_experts: int, *,
+             dtype=jnp.float32):
+    """Router + per-expert FFN params with GLOBAL expert dim E leading.
+
+    Expert weights follow the same fan-in uniform init as
+    nn/layers.py:linear_init so a 1-expert MoE matches a dense MLP's
+    statistics."""
+    kr, kw1, kb1, kw2, kb2 = jax.random.split(key, 5)
+    s1 = 1.0 / math.sqrt(dim)
+    s2 = 1.0 / math.sqrt(hidden)
+
+    def u(k, shape, s):
+        return jax.random.uniform(k, shape, dtype, minval=-s, maxval=s)
+
+    return {
+        # router kept/computed in f32: tiny, and gate ordering is
+        # precision-sensitive (cast_floating exempts it — layers.py)
+        "router": {"w": u(kr, (dim, n_experts), s1).astype(jnp.float32)},
+        "w1": u(kw1, (n_experts, dim, hidden), s1),
+        "b1": u(kb1, (n_experts, hidden), s1),
+        "w2": u(kw2, (n_experts, hidden, dim), s2),
+        "b2": u(kb2, (n_experts, dim), s2),
+    }
+
+
+def moe_specs(*, ep_axis: Optional[str] = "ep",
+              tp_axis: Optional[str] = None,
+              stacked: bool = False, pp_axis: Optional[str] = None):
+    """PartitionSpecs: experts sharded over ``ep``; inside each expert the
+    FFN is column/row sharded over ``tp`` (parallel/tp.py convention);
+    router replicated."""
+
+    def lead(*tail):
+        return P(pp_axis, *tail) if stacked else P(*tail)
+
+    return {
+        "router": {"w": lead(None, None)},
+        "w1": lead(ep_axis, None, tp_axis),
+        "b1": lead(ep_axis, tp_axis),
+        "w2": lead(ep_axis, tp_axis, None),
+        "b2": lead(ep_axis, None),
+    }
+
+
+def _capacity(s_local: int, args: MoEArgs) -> int:
+    if args.capacity is not None:
+        return int(args.capacity)
+    c = math.ceil(s_local * args.top_k / args.n_experts
+                  * args.capacity_factor)
+    return max(int(c), 1)
+
+
+def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
+              tp_axis: Optional[str] = None, act=gelu):
+    """x: [B, T_local, D] -> (y, aux_loss).
+
+    All shapes static: S = B*T local tokens, E experts, per-rank
+    per-expert capacity C. Tokens routed beyond capacity are dropped
+    (identity residual path in the transformer block keeps them alive).
+    """
+    B, T, D = x.shape
+    S = B * T
+    E = args.n_experts
+    k = args.top_k
+    if not 1 <= k <= E:
+        raise ValueError(
+            f"top_k={k} must be in [1, n_experts={E}]")
+    ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
+    if E % ep != 0:
+        raise ValueError(f"n_experts={E} must divide by ep={ep}")
+    C = _capacity(S, args)
+
+    xt = x.reshape(S, D)
+
+    # ---- routing (f32) ---------------------------------------------------
+    logits = jnp.dot(xt.astype(jnp.float32), p["router"]["w"])  # [S, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_v, gate_i = lax.top_k(probs, k)  # [S, k]
+    if args.normalize_gates:
+        gate_v = gate_v / jnp.sum(gate_v, axis=-1, keepdims=True)
+
+    # k-major priority flatten: every token's 1st choice outranks any 2nd
+    idx_f = gate_i.T.reshape(-1)                     # [k*S]
+    val_f = gate_v.T.reshape(-1)
+    s_of = jnp.tile(jnp.arange(S), k)
+
+    oh = jax.nn.one_hot(idx_f, E, dtype=jnp.int32)   # [k*S, E]
+    pos_in_e = jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=-1)
+    keep = pos_in_e < C
+    slot = jnp.where(keep, idx_f * C + pos_in_e, E * C)  # E*C = dump row
+
+    # ---- dispatch: scatter into [E, C, D] --------------------------------
+    buf = jnp.zeros((E * C + 1, D), x.dtype).at[slot].add(xt[s_of])
+    xe = buf[: E * C].reshape(E, C, D)
+
+    if ep_axis is not None:
+        # send expert block e to its owner; receive my experts' rows from
+        # every source rank: [E, C, D] -> [E/ep, ep*C, D]
+        xe = cc.all_to_all(xe, ep_axis, split_dim=0, concat_dim=1)
+
+    # ---- expert FFN (batched einsum -> MXU) ------------------------------
+    w1, b1 = p["w1"], p["b1"]
+    w2, b2 = p["w2"], p["b2"]
+    h = jnp.einsum("ecd,edh->ech", xe, w1.astype(xe.dtype))
+    h = act(h + b1.astype(h.dtype)[:, None, :])
+    y = jnp.einsum("ech,ehd->ecd", h, w2.astype(h.dtype))
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    y = y + b2.astype(y.dtype)[:, None, :]
+
+    if ep_axis is not None:
+        # route outputs back to the token-owning ranks
+        y = cc.all_to_all(y, ep_axis, split_dim=1, concat_dim=0)  # [E, C, D]
+
+    # ---- combine: gather + gate-weight + scatter back to tokens ----------
+    ybuf = jnp.concatenate(
+        [y.reshape(E * C, D), jnp.zeros((1, D), y.dtype)], axis=0)
+    yc = ybuf[slot] * val_f.astype(y.dtype)[:, None]
+    yt = jnp.zeros((S, D), y.dtype).at[s_of].add(yc)
+
+    # ---- aux losses (device-local stats, f32) ----------------------------
+    f_e = jnp.sum(oh, axis=0).astype(jnp.float32) / (S * k)   # [E]
+    p_e = jnp.mean(probs, axis=0)                             # [E]
+    aux = args.aux_weight * E * jnp.sum(f_e * p_e)
+    if args.z_weight:
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        aux = aux + args.z_weight * jnp.mean(jnp.square(z))
+
+    return yt.reshape(B, T, D), aux

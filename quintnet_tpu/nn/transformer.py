@@ -27,16 +27,24 @@ from quintnet_tpu.nn.layers import (
     mlp_apply,
     mlp_init,
 )
+from quintnet_tpu.nn.moe import MoEArgs, moe_apply, moe_init
 
 
-def block_init(key, dim: int, *, mlp_hidden: int, dtype=jnp.float32):
+def block_init(key, dim: int, *, mlp_hidden: int, dtype=jnp.float32,
+               moe: Optional[MoEArgs] = None):
+    """``moe``: replace the dense MLP with a Mixture-of-Experts FFN
+    (every block — Switch-Transformer style; nn/moe.py)."""
     k1, k2 = jax.random.split(key)
-    return {
+    p = {
         "ln1": layer_norm_init(dim, dtype),
         "attn": mha_init(k1, dim, dtype=dtype),
         "ln2": layer_norm_init(dim, dtype),
-        "mlp": mlp_init(k2, dim, mlp_hidden, dtype=dtype),
     }
+    if moe is not None:
+        p["moe"] = moe_init(k2, dim, mlp_hidden, moe.n_experts, dtype=dtype)
+    else:
+        p["mlp"] = mlp_init(k2, dim, mlp_hidden, dtype=dtype)
+    return p
 
 
 def block_apply(
@@ -50,7 +58,11 @@ def block_apply(
     sp_axis: Optional[str] = None,
     sp_mode: str = "ring",
     use_flash: bool = False,
+    moe_args: Optional[MoEArgs] = None,
+    ep_axis: Optional[str] = None,
 ):
+    """Returns ``x`` for dense blocks, ``(x, aux_loss)`` when
+    ``moe_args`` is given (the MoE load-balance term, device-local)."""
     x = x + mha_apply(
         p["attn"],
         layer_norm_apply(p["ln1"], x),
@@ -61,8 +73,12 @@ def block_apply(
         sp_mode=sp_mode,
         use_flash=use_flash,
     )
-    x = x + mlp_apply(p["mlp"], layer_norm_apply(p["ln2"], x), act=act, tp_axis=tp_axis)
-    return x
+    h = layer_norm_apply(p["ln2"], x)
+    if moe_args is not None:
+        y, aux = moe_apply(p["moe"], h, moe_args, ep_axis=ep_axis,
+                           tp_axis=tp_axis, act=act)
+        return x + y, aux
+    return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis)
 
 
 def stacked_blocks_apply(
@@ -77,6 +93,8 @@ def stacked_blocks_apply(
     sp_mode: str = "ring",
     use_flash: bool = False,
     remat: bool = False,
+    moe_args: Optional[MoEArgs] = None,
+    ep_axis: Optional[str] = None,
 ):
     """Run a [depth, ...]-stacked block pytree with lax.scan.
 
@@ -84,6 +102,11 @@ def stacked_blocks_apply(
     (utils/model.py:325-380) — one traced block body, depth iterations,
     constant compile time in depth. ``remat=True`` rematerialises each
     block in backward (jax.checkpoint), trading FLOPs for HBM.
+
+    With ``moe_args`` every block's MLP is a MoE FFN and the return is
+    ``(out, aux_total)`` — the summed load-balance loss across layers
+    (pmeaned over ``sp_axis`` so its value is sequence-replication
+    consistent with the main loss).
     """
     body = partial(
         block_apply,
@@ -94,9 +117,22 @@ def stacked_blocks_apply(
         sp_axis=sp_axis,
         sp_mode=sp_mode,
         use_flash=use_flash,
+        moe_args=moe_args,
+        ep_axis=ep_axis,
     )
     if remat:
         body = jax.checkpoint(body)
+
+    if moe_args is not None:
+        def scan_moe(h, blk_p):
+            h, aux = body(blk_p, h)
+            return h, aux
+
+        out, auxes = jax.lax.scan(scan_moe, x, stacked_params)
+        aux = jnp.sum(auxes)
+        if sp_axis is not None:
+            aux = jax.lax.pmean(aux, sp_axis)
+        return out, aux
 
     def scan_fn(h, blk_p):
         return body(blk_p, h), None

@@ -27,6 +27,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from quintnet_tpu.core import collectives as cc
 from quintnet_tpu.nn import attention as _attn
 
 
@@ -52,7 +53,7 @@ def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
     # sequence correctly. q/k/v ride ONE collective (stacked on a leading
     # axis) so the whole layer costs two all-to-all dispatches, fwd+bwd.
     qkv = jnp.stack([q, k, v])  # [3, B, H_local, S_local, Dh]
-    qkv = lax.all_to_all(qkv, axis, split_axis=2, concat_axis=3, tiled=True)
+    qkv = cc.all_to_all(qkv, axis, split_dim=2, concat_dim=3)
     qf, kf, vf = qkv[0], qkv[1], qkv[2]
 
     if use_flash:
@@ -63,4 +64,4 @@ def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
         of = _attn.sdpa(qf, kf, vf, causal=causal)
 
     # gather heads back, re-scatter sequence: [B, H_local, S_local, Dh]
-    return lax.all_to_all(of, axis, split_axis=2, concat_axis=1, tiled=True)
+    return cc.all_to_all(of, axis, split_dim=2, concat_dim=1)

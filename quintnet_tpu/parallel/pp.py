@@ -81,6 +81,16 @@ def _split_micro(batch, n_micro: int):
     return jax.tree.map(r, batch)
 
 
+def _stage_out(out):
+    """Normalise a stage_fn result: plain activation for dense stacks,
+    (activation, aux_loss) for MoE stacks (models/gpt2.py
+    gpt2_pipeline_fns). Static structure — resolved at trace time."""
+    if isinstance(out, tuple):
+        h, aux = out
+        return h, aux.astype(jnp.float32)
+    return out, jnp.zeros((), jnp.float32)
+
+
 def make_afab_loss_fn(
     embed_fn: Callable,
     stage_fn: Callable,
@@ -117,12 +127,15 @@ def make_afab_loss_fn(
                 v, m_f, keepdims=False), x_mb)
             emb = embed_fn(params, x_t)
             h_in = jnp.where(is_first, emb, h_recv)
-            h_out = stage_fn(params["blocks"], h_in)
+            h_out, aux = _stage_out(stage_fn(params["blocks"], h_in))
             y_t = jax.tree.map(lambda v: lax.dynamic_index_in_dim(
                 v, m_f, keepdims=False), y_mb)
             loss_m = head_loss_fn(params, h_out, y_t)
-            valid = is_last & (t - s >= 0) & (t - s < M)
-            loss_t = jnp.where(valid, loss_m, 0.0) / M
+            active = (t - s >= 0) & (t - s < M)
+            valid = is_last & active
+            # every ACTIVE stage contributes its local blocks' MoE aux
+            loss_t = (jnp.where(valid, loss_m, 0.0)
+                      + jnp.where(active, aux, 0.0)) / M
             return h_out, loss_t
 
         _, losses = lax.scan(tick, h0, jnp.arange(T))
@@ -167,12 +180,14 @@ def make_1f1b_grad_fn(
         def mb_fn(p, x_t, y_t, h_recv):
             """Complete per-device microbatch computation; vjp of this
             yields all local grads (embedding cotangent is blocked by the
-            jnp.where on non-first stages, head's by the loss seed)."""
+            jnp.where on non-first stages, head's by the loss seed; MoE
+            aux is seeded on EVERY stage — each stage owns its blocks'
+            load-balance term)."""
             emb = embed_fn(p, x_t)
             h_in = jnp.where(is_first, emb, h_recv)
-            h_out = stage_fn(p["blocks"], h_in)
+            h_out, aux = _stage_out(stage_fn(p["blocks"], h_in))
             loss_m = head_loss_fn(p, h_out, y_t) / M
-            return h_out, loss_m
+            return h_out, (loss_m, aux / M)
 
         def pick(mb_tree, m):
             return jax.tree.map(
@@ -194,13 +209,15 @@ def make_1f1b_grad_fn(
             fwd_active = (m_f >= 0) & (m_f < M)
             x_f = pick(x_mb, m_f)
             y_f = pick(y_mb, m_f)
-            h_out, loss_f = mb_fn(params, x_f, y_f, h_recv)
+            h_out, (loss_f, aux_f) = mb_fn(params, x_f, y_f, h_recv)
             # save this microbatch's INPUT for the vjp recompute
             slot_f = jnp.mod(m_f, CAP)
             old = lax.dynamic_index_in_dim(in_buf, slot_f, keepdims=False)
             in_buf = lax.dynamic_update_index_in_dim(
                 in_buf, jnp.where(fwd_active, h_recv, old), slot_f, 0)
-            loss_acc = loss_acc + jnp.where(is_last & fwd_active, loss_f, 0.0)
+            loss_acc = (loss_acc
+                        + jnp.where(is_last & fwd_active, loss_f, 0.0)
+                        + jnp.where(fwd_active, aux_f, 0.0))
 
             # ---- backward sub-step: stage s backwards microbatch
             #      t - 2(P-1) + s (aligned so g_send from stage s at tick
@@ -217,7 +234,8 @@ def make_1f1b_grad_fn(
             act = bwd_active.astype(h0.dtype)
             seed_h = jnp.where(is_last, jnp.zeros_like(g_recv), g_recv) * act
             seed_loss = jnp.where(is_last & bwd_active, 1.0, 0.0)
-            g_params, g_h = vjp((seed_h, seed_loss))
+            seed_aux = jnp.where(bwd_active, 1.0, 0.0)  # every stage's aux
+            g_params, g_h = vjp((seed_h, (seed_loss, seed_aux)))
             g_acc = jax.tree.map(jnp.add, g_acc, g_params)
 
             return (h_out, g_h, in_buf, g_acc, loss_acc), None
@@ -225,9 +243,10 @@ def make_1f1b_grad_fn(
         carry0 = (h0, h0, in_buf0, g_acc0, jnp.zeros((), jnp.float32))
         (_, _, _, grads, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T))
-        # loss lives on the last stage; make it uniform across pp.
-        # plain (non-differentiated) value -> broadcast is safe
-        loss = cc.broadcast_from(loss_acc, ax, src=P_static - 1)
+        # main loss lives on the last stage, each stage holds its own MoE
+        # aux partial; one psum makes the total uniform across pp (plain
+        # value, not differentiated — grads already flowed via the seeds)
+        loss = cc.all_reduce(loss_acc, ax)
         return loss, grads
 
     return grad_fn

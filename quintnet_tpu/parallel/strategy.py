@@ -47,12 +47,18 @@ STRATEGY_AXES = {
     "tp": ("tp",),
     "pp": ("pp",),
     "sp": ("sp",),
+    "ep": ("ep",),
     "dp_tp": ("dp", "tp"),
     "dp_pp": ("dp", "pp"),
     "tp_pp": ("tp", "pp"),
     "dp_sp": ("dp", "sp"),
+    "dp_ep": ("dp", "ep"),
+    "ep_tp": ("ep", "tp"),
+    "ep_pp": ("ep", "pp"),
     "3d": ("dp", "tp", "pp"),
+    "3d_ep": ("dp", "tp", "pp", "ep"),
     "4d": ("dp", "tp", "pp", "sp"),
+    "5d": ("dp", "tp", "pp", "sp", "ep"),
 }
 
 
@@ -101,10 +107,17 @@ class Strategy:
         return model.partition_specs(
             tp_axis=self.axis_or_none("tp"),
             pp_axis=self.axis_or_none("pp"),
+            ep_axis=self.axis_or_none("ep"),
         )
 
     def shard_params(self, model: ModelSpec, params):
-        """Host/global params -> mesh-placed params (incl. tp layout fix)."""
+        """Host/global params -> mesh-placed params (incl. tp layout fix).
+
+        NOTE: ``jax.device_put`` may alias the input's buffers when a
+        shard can reuse them in place; since ``make_train_step`` donates
+        its params, the INPUT tree must be treated as consumed — copy
+        first (``jax.tree.map(jnp.copy, ...)``) if you need it again.
+        """
         tp = self.mesh.shape.get("tp", 1)
         params = model.to_tp_layout(params, tp)
         return shard_pytree(self.mesh, params, self.param_specs(model))
@@ -149,13 +162,14 @@ class Strategy:
         cfg = self.config
         tp_axis = self.axis_or_none("tp")
         sp_axis = self.axis_or_none("sp")
+        ep_axis = self.axis_or_none("ep")
         specs = self.param_specs(model)
 
         if self.uses_pp:
             validate_pp(model.depth, self.mesh.shape["pp"])
             n_micro = cfg.training.gradient_accumulation_steps
             embed_fn, stage_fn, head_loss_fn = model.pipeline_fns(
-                tp_axis=tp_axis, sp_axis=sp_axis)
+                tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
             pspec = PipelineSpec(n_micro=n_micro, pp_axis="pp")
             if cfg.training.schedule.lower() in ("1f1b", "one_f_one_b"):
                 grad_fn = make_1f1b_grad_fn(
@@ -183,7 +197,7 @@ class Strategy:
 
         def loss(params, batch):
             return model.loss_fn(params, batch, tp_axis=tp_axis,
-                                 sp_axis=sp_axis)
+                                 sp_axis=sp_axis, ep_axis=ep_axis)
 
         return make_parallel_train_step(
             self.mesh, loss, optimizer, specs,
@@ -232,7 +246,9 @@ def get_strategy(name: Optional[str] = None, config: Optional[Config] = None,
     spec = MeshSpec.from_config(config.mesh)
     mesh = build_mesh(spec, devices)
 
-    batch_axes = tuple(a for a in ("dp",) if a in sizes)
+    # ep is a DATA axis: tokens are sharded over it (experts live on it);
+    # see reduce_grads' sharded-over-data-axis rule in train_step.py
+    batch_axes = tuple(a for a in ("dp", "ep") if a in sizes)
     model_axes = tuple(a for a in ("tp", "sp") if sizes.get(a, 1) > 1)
     partial_axes = tuple(a for a in ("pp",) if sizes.get(a, 1) > 1)
 

@@ -57,7 +57,11 @@ def reduce_grads(grads, param_specs, *, data_axes: Tuple[str, ...],
     head on the last stage) are rank-partial — psum, no redundancy
     division.
 
-    Finally data axes take the DP mean.
+    Finally data axes take the DP mean — EXCEPT leaves sharded over a
+    data axis (MoE expert weights over ``ep``, nn/moe.py): the all_to_all
+    transpose already delivered their grads summed over every
+    token-source rank, so they are divided by the axis size instead of
+    pmeaned (a pmean would blend different experts' grads).
     """
     redundancy = 1
     for a in model_axes:
@@ -71,8 +75,12 @@ def reduce_grads(grads, param_specs, *, data_axes: Tuple[str, ...],
             g = lax.psum(g, psum_axes)
         if redundancy != 1:
             g = g / redundancy
-        if data_axes:
-            g = lax.pmean(g, data_axes)
+        mean_axes = tuple(a for a in data_axes if a not in present)
+        if mean_axes:
+            g = lax.pmean(g, mean_axes)
+        for a in data_axes:
+            if a in present:
+                g = g / lax.axis_size(a)
         return g
 
     return jax.tree.map(red, grads, param_specs)
@@ -190,9 +198,11 @@ def make_parallel_train_step(
         if data_axes:
             out = jax.tree.map(lambda x: lax.pmean(x, data_axes), out)
         if grad_clip_norm is not None:
-            # pp-sharded leaves are partial across pp too: include paxes
+            # pp-sharded leaves are partial across pp too, and MoE expert
+            # leaves are sharded over a data axis (ep): include both so
+            # the global norm sums every shard exactly once
             grads, _ = clip_sharded_grads(grads, param_specs, grad_clip_norm,
-                                          model_axes=maxes + paxes)
+                                          model_axes=maxes + paxes + data_axes)
         if zero1_axis is not None:
             from quintnet_tpu.parallel import zero
 

@@ -129,13 +129,14 @@ class Trainer:
         specs = strat.param_specs(self.model)
         tp_axis = strat.axis_or_none("tp")
         sp_axis = strat.axis_or_none("sp")
+        ep_axis = strat.axis_or_none("ep")
 
         if strat.uses_pp:
             from quintnet_tpu.parallel.pp import (PipelineSpec,
                                                   make_afab_loss_fn)
 
             embed_fn, stage_fn, head_loss_fn = self.model.pipeline_fns(
-                tp_axis=tp_axis, sp_axis=sp_axis)
+                tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
             loss_fn = make_afab_loss_fn(
                 embed_fn, stage_fn, head_loss_fn,
                 PipelineSpec(
@@ -143,7 +144,7 @@ class Trainer:
         else:
             def loss_fn(p, b):
                 return self.model.loss_fn(p, b, tp_axis=tp_axis,
-                                          sp_axis=sp_axis)
+                                          sp_axis=sp_axis, ep_axis=ep_axis)
 
         def local_eval(p, b):
             loss = loss_fn(p, b)
