@@ -35,6 +35,10 @@ def main():
     ap.add_argument("--experts", type=int, default=0,
                     help="n_experts: turn the model into a GPT-2-MoE "
                          "(shard them with an 'ep' mesh axis)")
+    ap.add_argument("--gen-eval", type=int, default=0, metavar="N",
+                    help="after training, greedy-generate summaries for "
+                         "N val samples (KV-cache decoder) and report "
+                         "ROUGE-1/2/L + BLEU")
     args = ap.parse_args()
 
     from quintnet_tpu.examples.common import setup_platform
@@ -132,6 +136,25 @@ def main():
     )
     print(f"done in {hist.wall_time_s:.1f}s; "
           f"train_loss {hist.train_loss[-1]:.4f}")
+
+    if args.gen_eval:
+        # single-device generation eval on the trained weights
+        # (reference: optional ROUGE/BLEU pass, GPT2_Trainer.py:509-555,
+        # skipped under PP there; here any mesh works — params are
+        # gathered to host and de-TP-layouted first)
+        from quintnet_tpu.models.gpt2 import gpt2_from_tp_layout
+        from quintnet_tpu.train.metrics import evaluate_generation
+
+        host = jax.device_get(trainer.final_state[0])
+        host = gpt2_from_tp_layout(host, gcfg, cfg.tp_size)
+        prompts = val_ds.eval_prompts(
+            max_prompt_len=max(max_len // 2, 8), limit=args.gen_eval)
+        scores = evaluate_generation(
+            host, gcfg, prompts, tok,
+            max_new_tokens=min(64, gcfg.n_positions - max_len // 2),
+            eos_token_id=getattr(tok, "eos_token_id", None))
+        print("generation eval:",
+              {k: round(v, 4) for k, v in scores.items()})
     return hist
 
 

@@ -334,6 +334,22 @@ def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
     return out
 
 
+def gpt2_from_tp_layout(params, cfg: GPT2Config, tp: int):
+    """Inverse of :func:`gpt2_to_tp_layout` — back to the standard
+    [q|k|v] fused-QKV column order (for export and for single-device
+    generation on trained tp-sharded params)."""
+    from quintnet_tpu.parallel.tp import qkv_standard_from_blocked
+
+    if tp == 1:
+        return params
+    out = jax.tree.map(lambda x: x, params)
+    qkv = out["blocks"]["attn"]["qkv"]
+    qkv["w"] = qkv_standard_from_blocked(qkv["w"], cfg.n_head, tp)
+    if "b" in qkv:
+        qkv["b"] = qkv_standard_from_blocked(qkv["b"], cfg.n_head, tp)
+    return out
+
+
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None, sp_mode: str = "ring",
                       ep_axis: Optional[str] = None,

@@ -1,0 +1,129 @@
+"""KV-cache autoregressive generation for GPT-2 (dense and MoE).
+
+The reference generates by re-running the FULL prefix through the model
+for every new token (greedy loop in utils/metrics.py:74-149) — O(T^2)
+attention work per token and a fresh compile-sized dispatch each step.
+Here decoding is TPU-shaped:
+
+- **prefill**: one causal forward over the prompt that also emits every
+  layer's (k, v) into a [L, B, H, T_max, Dh] cache (nn/transformer.py
+  block_prefill);
+- **decode**: a single jitted ``lax.scan`` over new-token steps, each
+  step one cached block pass per layer (nn/attention.py mha_decode) —
+  O(T) per token, static shapes throughout, one compilation total;
+- **EOS** handling inside the scan: finished rows keep emitting
+  ``eos_token_id`` (same observable behavior as the reference's early
+  exit, without dynamic shapes).
+
+Greedy by default; ``temperature > 0`` switches to sampling.
+Generation runs single-device (the reference's generation eval is also
+single-device and skipped under PP — GPT2_Trainer.py:509-555).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_logits
+from quintnet_tpu.nn.layers import gelu
+from quintnet_tpu.nn.transformer import block_decode, block_prefill
+
+
+def gpt2_prefill(params, input_ids, cfg: GPT2Config, *, cache_len: int):
+    """[B, T0] prompt -> (last-position logits [B, V],
+    (k_cache, v_cache) each [L, B, H, cache_len, Dh])."""
+    B, T0 = input_ids.shape
+    emb = params["embedding"]
+    h = (jnp.take(emb["wte"], input_ids, axis=0)
+         + emb["wpe"][None, :T0, :])
+
+    def body(x, blk):
+        x, (k, v) = block_prefill(blk, x, num_heads=cfg.n_head, act=gelu,
+                                  moe_args=cfg.moe_args)
+        return x, (k, v)
+
+    h, (ks, vs) = lax.scan(body, h, params["blocks"])
+    pad = [(0, 0), (0, 0), (0, 0), (0, cache_len - T0), (0, 0)]
+    return (gpt2_logits(params, h[:, -1:, :], cfg)[:, 0, :],
+            (jnp.pad(ks, pad), jnp.pad(vs, pad)))
+
+
+def gpt2_decode_step(params, tok, pos, caches, cfg: GPT2Config):
+    """One cached decode step: tok [B] int32, pos scalar, caches
+    [L, B, H, T, Dh] -> (logits [B, V], updated caches)."""
+    emb = params["embedding"]
+    x = (jnp.take(emb["wte"], tok[:, None], axis=0)
+         + lax.dynamic_slice_in_dim(emb["wpe"], pos, 1, axis=0)[None])
+
+    ks, vs = caches
+
+    def body(h, layer):
+        blk, kc, vc = layer
+        h, kc, vc = block_decode(blk, h, kc, vc, pos,
+                                 num_heads=cfg.n_head, act=gelu,
+                                 moe_args=cfg.moe_args)
+        return h, (kc, vc)
+
+    h, (ks, vs) = lax.scan(body, x, (params["blocks"], ks, vs))
+    return gpt2_logits(params, h, cfg)[:, 0, :], (ks, vs)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "eos_token_id",
+                                   "temperature"))
+def _generate_jit(params, input_ids, key, cfg: GPT2Config,
+                  max_new_tokens: int, eos_token_id: Optional[int],
+                  temperature: float):
+    B, T0 = input_ids.shape
+    cache_len = T0 + max_new_tokens
+    logits0, caches = gpt2_prefill(params, input_ids, cfg,
+                                   cache_len=cache_len)
+
+    def pick(logits, k):
+        if temperature > 0.0:
+            return jax.random.categorical(k, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, _):
+        tok, pos, caches, done, k = carry
+        k, sub = jax.random.split(k)
+        logits, caches = gpt2_decode_step(params, tok, pos, caches, cfg)
+        nxt = pick(logits, sub).astype(jnp.int32)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, eos_token_id, nxt)
+            done = done | (nxt == eos_token_id)
+        return (nxt, pos + 1, caches, done, k), nxt
+
+    key0, sub0 = jax.random.split(key)
+    first = pick(logits0, sub0).astype(jnp.int32)
+    done0 = jnp.zeros((B,), bool)
+    if eos_token_id is not None:
+        done0 = first == eos_token_id
+    (_, _, _, _, _), rest = lax.scan(
+        step, (first, jnp.int32(T0), caches, done0, key0),
+        None, length=max_new_tokens - 1)
+    return jnp.concatenate(
+        [input_ids, first[:, None], rest.T.astype(jnp.int32)], axis=1)
+
+
+def gpt2_generate(params, input_ids, cfg: GPT2Config, *,
+                  max_new_tokens: int, eos_token_id: Optional[int] = None,
+                  temperature: float = 0.0, key=None) -> np.ndarray:
+    """input_ids [B, T0] -> [B, T0 + max_new_tokens] (greedy when
+    ``temperature == 0``). One jitted program: prefill + scan decode."""
+    if max_new_tokens < 1:
+        return np.asarray(input_ids)
+    if input_ids.shape[1] + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt {input_ids.shape[1]} + max_new {max_new_tokens} "
+            f"exceeds n_positions={cfg.n_positions}")
+    key = key if key is not None else jax.random.key(0)
+    out = _generate_jit(params, jnp.asarray(input_ids, jnp.int32), key,
+                        cfg, int(max_new_tokens), eos_token_id,
+                        float(temperature))
+    return np.asarray(out)

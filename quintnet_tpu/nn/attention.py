@@ -61,6 +61,7 @@ def mha_apply(
     sp_axis: Optional[str] = None,
     sp_mode: str = "ring",
     use_flash: bool = False,
+    return_kv: bool = False,
 ):
     """x: [B, S_local, D] -> [B, S_local, D].
 
@@ -71,6 +72,10 @@ def mha_apply(
     have. ``sp_mode`` picks the algorithm: 'ring' (K/V rotation via
     ppermute, ops/ring_attention.py) or 'ulysses' (head-scatter
     all-to-all, ops/ulysses_attention.py; composes with flash).
+
+    ``return_kv=True`` additionally returns the per-head (k, v)
+    projections [B, H, S, Dh] — the prefill half of KV-cache decoding
+    (models/gpt2_generate.py).
     """
     qkv = linear_apply(p["qkv"], x)  # [B, S, 3*D_local]
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -104,4 +109,39 @@ def mha_apply(
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
+    if return_kv:
+        return y, (k, v)
     return y
+
+
+def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int):
+    """Single-token cached attention: x [B, 1, D], caches [B, H, T, Dh],
+    ``pos`` the (dynamic) write position. Returns (y, k_cache, v_cache).
+
+    The reference's generation loop re-runs the full prefix every step
+    (utils/metrics.py:74-149, O(T^2) per token); here one token attends
+    against the cache — O(T) per token, fully jittable (static shapes,
+    dynamic_update_slice for the cache write, masked softmax over the
+    not-yet-written tail)."""
+    qkv = linear_apply(p["qkv"], x)  # [B, 1, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
+    k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
+    v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_cache).astype(jnp.float32)
+    scores = scores / math.sqrt(dh)
+    valid = jnp.arange(k_cache.shape[2]) <= pos  # [T]
+    scores = jnp.where(valid[None, None, None, :], scores,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_cache)
+
+    o = rearrange(o, "b h s d -> b s (h d)")
+    y = jnp.dot(o, p["proj"]["w"])
+    if "b" in p["proj"]:
+        y = y + p["proj"]["b"]
+    return y, k_cache, v_cache

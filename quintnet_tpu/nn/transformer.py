@@ -19,7 +19,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from quintnet_tpu.nn.attention import mha_apply, mha_init
+from quintnet_tpu.nn.attention import mha_apply, mha_decode, mha_init
 from quintnet_tpu.nn.layers import (
     gelu,
     layer_norm_apply,
@@ -139,3 +139,36 @@ def stacked_blocks_apply(
 
     out, _ = jax.lax.scan(scan_fn, x, stacked_params)
     return out
+
+
+def _block_mlp(p, x, *, act, moe_args, ep_axis, tp_axis):
+    """The MLP half of a block (dense or MoE, aux discarded)."""
+    h = layer_norm_apply(p["ln2"], x)
+    if moe_args is not None:
+        y, _aux = moe_apply(p["moe"], h, moe_args, ep_axis=ep_axis,
+                            tp_axis=tp_axis, act=act)
+        return x + y
+    return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis)
+
+
+def block_prefill(p, x, *, num_heads: int, act: Callable = gelu,
+                  moe_args: Optional[MoEArgs] = None):
+    """Causal block forward that also returns this layer's (k, v)
+    [B, H, S, Dh] — the prefill half of KV-cache generation."""
+    a, (k, v) = mha_apply(p["attn"], layer_norm_apply(p["ln1"], x),
+                          num_heads=num_heads, causal=True, return_kv=True)
+    x = x + a
+    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                      tp_axis=None), (k, v)
+
+
+def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
+                 act: Callable = gelu,
+                 moe_args: Optional[MoEArgs] = None):
+    """Single-token cached block step (nn/attention.py mha_decode)."""
+    a, k_cache, v_cache = mha_decode(
+        p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache, pos,
+        num_heads=num_heads)
+    x = x + a
+    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                      tp_axis=None), k_cache, v_cache

@@ -136,3 +136,47 @@ def greedy_generate(apply_fn: Callable, params, input_ids: np.ndarray,
         if eos_token_id is not None and done.all():
             break
     return np.asarray(ids)
+
+
+def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
+                        max_new_tokens: int = 64,
+                        eos_token_id: int | None = None,
+                        batch_size: int = 8) -> Dict[str, float]:
+    """Generate continuations with the KV-cache decoder and score
+    ROUGE-1/2/L + BLEU against references (reference evaluate_generation:
+    utils/metrics.py:152-206, which re-runs the full prefix per token and
+    scores with rouge_score/sacrebleu).
+
+    ``prompts``: (prompt token ids, reference text) pairs, e.g. from
+    SummarizationDataset.eval_prompts. Prompts are grouped by length so
+    each distinct shape compiles once, then generated in batches.
+    """
+    from quintnet_tpu.models.gpt2_generate import gpt2_generate
+
+    by_len: Dict[int, List[int]] = {}
+    for i, (ids, _ref) in enumerate(prompts):
+        by_len.setdefault(len(ids), []).append(i)
+
+    preds: List[str] = [""] * len(prompts)
+    for n, idxs in sorted(by_len.items()):
+        for j in range(0, len(idxs), batch_size):
+            grp = idxs[j:j + batch_size]
+            batch = np.asarray([prompts[i][0] for i in grp], np.int32)
+            if len(grp) < batch_size and len(idxs) > batch_size:
+                # pad the trailing partial batch to the compiled batch
+                # shape (extra rows discarded) — a second XLA compile of
+                # prefill+decode costs far more than the wasted rows
+                pad = np.repeat(batch[-1:], batch_size - len(grp), axis=0)
+                batch = np.concatenate([batch, pad], axis=0)
+            out = gpt2_generate(params, batch, cfg,
+                                max_new_tokens=max_new_tokens,
+                                eos_token_id=eos_token_id)
+            for row, i in zip(out, grp):
+                new = row[n:]
+                if eos_token_id is not None:
+                    stop = np.where(new == eos_token_id)[0]
+                    if stop.size:
+                        new = new[: stop[0]]
+                preds[i] = tokenizer.decode([int(t) for t in new])
+
+    return compute_rouge_bleu(preds, [ref for _ids, ref in prompts])

@@ -226,3 +226,8 @@ class Trainer:
         hist.wall_time_s = time.time() - t0
         self._final_state = (params, opt_state)
         return hist
+
+    @property
+    def final_state(self):
+        """(params, opt_state) after the last fit() epoch."""
+        return getattr(self, "_final_state", None)
