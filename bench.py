@@ -52,6 +52,9 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
+    ap.add_argument("--remat", default=1, type=int,
+                    help="rematerialise blocks in backward (1) or keep "
+                         "activations (0); 0 is faster when HBM allows")
     args = ap.parse_args()
 
     import jax
@@ -67,7 +70,7 @@ def main():
         "mesh_dim": [n_dev], "mesh_name": ["dp"],
         "training": {"batch_size": args.batch * n_dev,
                      "optimizer": "adamw", "grad_clip_norm": 1.0,
-                     "remat": True},
+                     "remat": bool(args.remat)},
     })
     strat = get_strategy("auto" if n_dev > 1 else "dp", cfg)
 
@@ -80,7 +83,7 @@ def main():
         else:
             gcfg = GPT2Config.base()
         compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
-        model = gpt2_model_spec(gcfg, remat=True,
+        model = gpt2_model_spec(gcfg, remat=bool(args.remat),
                                 compute_dtype=compute_dtype)
         ids = np.random.default_rng(0).integers(
             0, gcfg.vocab_size, size=(args.batch * n_dev, args.seq),

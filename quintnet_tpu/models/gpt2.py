@@ -61,6 +61,11 @@ class GPT2Config:
     n_head: int = 12
     layer_norm_epsilon: float = 1e-5
     dropout: float = 0.0
+    # per-site rates (reference gpt2_config.yaml:31-33 attn_pdrop /
+    # embd_pdrop / resid_pdrop); None falls back to ``dropout``
+    embd_pdrop: Optional[float] = None
+    attn_pdrop: Optional[float] = None
+    resid_pdrop: Optional[float] = None
     # --- MoE (0 experts = dense; the reference has no MoE/EP at all,
     # SURVEY.md §2.2 "EP — Absent"). Every block's MLP becomes a top-k
     # routed MoE FFN (nn/moe.py), expert-shardable over the ``ep`` axis.
@@ -74,6 +79,18 @@ class GPT2Config:
     @property
     def mlp_hidden(self) -> int:
         return 4 * self.n_embd
+
+    @property
+    def pdrops(self):
+        """(embd, attn, resid) dropout rates with ``dropout`` fallback."""
+        d = self.dropout
+        return (d if self.embd_pdrop is None else self.embd_pdrop,
+                d if self.attn_pdrop is None else self.attn_pdrop,
+                d if self.resid_pdrop is None else self.resid_pdrop)
+
+    @property
+    def needs_dropout(self) -> bool:
+        return any(p > 0.0 for p in self.pdrops)
 
     @property
     def moe_args(self):
@@ -173,9 +190,11 @@ def gpt2_upcycle_to_moe(params, cfg: GPT2Config, key=None):
     return {**params, "blocks": blocks}
 
 
-def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None):
+def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None,
+               embd_pdrop: float = 0.0, key=None):
     """[B, T_local] ids -> [B, T_local, D] (reference GPT2Embedding,
-    replicated across TP — gpt2_embeddings.py:16-103).
+    replicated across TP — gpt2_embeddings.py:16-103, including its
+    post-sum embedding dropout :100-101 when ``key`` is given).
 
     With ``sp_axis`` the sequence dim is sharded: this rank's position
     embeddings start at axis_index * T_local."""
@@ -186,17 +205,23 @@ def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None):
     if sp_axis is not None:
         start = jax.lax.axis_index(sp_axis) * T
     pos = jax.lax.dynamic_slice_in_dim(emb["wpe"], start, T, axis=0)
-    return tok + pos[None, :, :]
+    h = tok + pos[None, :, :]
+    if key is not None and embd_pdrop > 0.0:
+        from quintnet_tpu.nn.layers import dropout
+
+        h = dropout(key, h, embd_pdrop, deterministic=False)
+    return h
 
 
 def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
                 ep_axis: Optional[str] = None,
-                remat: bool = False, use_flash: bool = False):
+                remat: bool = False, use_flash: bool = False, key=None):
     """Returns ``h`` for dense configs, ``(h, moe_aux)`` when
-    ``cfg.n_experts > 0``."""
+    ``cfg.n_experts > 0``. ``key`` enables training dropout."""
     tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
+    _, attn_p, resid_p = cfg.pdrops
     return stacked_blocks_apply(
         params_blocks, h,
         num_heads=cfg.n_head // tp,
@@ -209,6 +234,9 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
         use_flash=use_flash,
         moe_args=cfg.moe_args,
         ep_axis=ep_axis,
+        attn_pdrop=attn_p,
+        resid_pdrop=resid_p,
+        key=key,
     )
 
 
@@ -224,12 +252,17 @@ def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
                  tp_axis: Optional[str] = None,
                  sp_axis: Optional[str] = None, sp_mode: str = "ring",
                  ep_axis: Optional[str] = None,
-                 remat: bool = False, use_flash: bool = False):
-    """-> (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs."""
-    h = gpt2_embed(params, input_ids, sp_axis=sp_axis)
+                 remat: bool = False, use_flash: bool = False, key=None):
+    """-> (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs.
+    ``key``: training-dropout key (None -> deterministic/eval)."""
+    k_embd = k_blocks = None
+    if key is not None and cfg.needs_dropout:
+        k_embd, k_blocks = jax.random.split(key)
+    h = gpt2_embed(params, input_ids, sp_axis=sp_axis,
+                   embd_pdrop=cfg.pdrops[0], key=k_embd)
     out = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
                       sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
-                      remat=remat, use_flash=use_flash)
+                      remat=remat, use_flash=use_flash, key=k_blocks)
     h, aux = out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
     return gpt2_logits(params, h, cfg), aux
 
@@ -363,16 +396,22 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
 
     MoE configs make ``stage_fn`` return ``(h, aux)`` — the schedules in
     parallel/pp.py accumulate each stage's aux into the loss.
+
+    ``key`` kwargs on embed/stage enable training dropout; the schedules
+    pass per-(microbatch, stage) keys (parallel/pp.py) so the 1F1B
+    vjp-recompute reproduces the forward masks exactly.
     """
 
-    def embed_fn(params, input_ids):
+    def embed_fn(params, input_ids, key=None):
         return gpt2_embed(_cast_tree(params, compute_dtype), input_ids,
-                          sp_axis=sp_axis)
+                          sp_axis=sp_axis, embd_pdrop=cfg.pdrops[0],
+                          key=key)
 
-    def stage_fn(blocks_local, h):
+    def stage_fn(blocks_local, h, key=None):
         return gpt2_blocks(_cast_tree(blocks_local, compute_dtype), h, cfg,
                            tp_axis=tp_axis, sp_axis=sp_axis, sp_mode=sp_mode,
-                           ep_axis=ep_axis, remat=remat, use_flash=use_flash)
+                           ep_axis=ep_axis, remat=remat, use_flash=use_flash,
+                           key=key)
 
     def head_loss_fn(params, h, labels):
         logits = gpt2_logits(_cast_tree(params, compute_dtype), h, cfg)
@@ -390,13 +429,14 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
 
     from quintnet_tpu.parallel.strategy import ModelSpec
 
-    def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None):
+    def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
+                key=None):
         input_ids, labels = batch
         logits, aux = gpt2_forward(_cast_tree(params, compute_dtype),
                                    input_ids, cfg, tp_axis=tp_axis,
                                    sp_axis=sp_axis, sp_mode=sp_mode,
                                    ep_axis=ep_axis, remat=remat,
-                                   use_flash=use_flash)
+                                   use_flash=use_flash, key=key)
         if sp_axis is not None:
             return clm_loss_sp(logits, labels, sp_axis=sp_axis) + aux
         return clm_loss(logits, labels) + aux
@@ -422,4 +462,5 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
         to_tp_layout=lambda p, tp: gpt2_to_tp_layout(p, cfg, tp),
         depth=cfg.n_layer,
         batch_specs=batch_specs,
+        needs_rng=cfg.needs_dropout,
     )

@@ -201,13 +201,13 @@ def vit_pipeline_fns(cfg: ViTConfig, *, tp_axis: Optional[str] = None,
     stage, blocks split in between).
     """
 
-    def embed_fn(params, x):
+    def embed_fn(params, x, key=None):
         if x.ndim == 4 and x.shape[1] == cfg.in_channels \
                 and x.shape[-1] != cfg.in_channels:
             x = x.transpose(0, 2, 3, 1)
         return vit_embed(params["embedding"], x, cfg.patch_size)
 
-    def stage_fn(blocks_local, h):
+    def stage_fn(blocks_local, h, key=None):
         tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
         return stacked_blocks_apply(
             blocks_local, h,
@@ -237,7 +237,8 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
     (parallel/strategy.py)."""
     from quintnet_tpu.parallel.strategy import ModelSpec
 
-    def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None):
+    def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
+                key=None):
         x, y = batch
         return cross_entropy_loss(
             vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat), y)

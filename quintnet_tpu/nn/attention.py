@@ -34,11 +34,14 @@ def mha_init(key, dim: int, *, qkv_bias: bool = True, dtype=jnp.float32):
     }
 
 
-def sdpa(q, k, v, *, causal: bool, softmax_dtype=jnp.float32):
+def sdpa(q, k, v, *, causal: bool, softmax_dtype=jnp.float32,
+         pdrop: float = 0.0, key=None):
     """Plain scaled-dot-product attention: [B, H, S, Dh] -> [B, H, S, Dh].
 
     Matches the reference's F.scaled_dot_product_attention call
-    (gpt2_attention.py:156-161). Softmax in f32 regardless of input dtype.
+    (gpt2_attention.py:156-161), including its ``dropout_p`` on the
+    attention probabilities when ``key`` is given. Softmax in f32
+    regardless of input dtype.
     """
     dh = q.shape[-1]
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(softmax_dtype)
@@ -48,6 +51,10 @@ def sdpa(q, k, v, *, causal: bool, softmax_dtype=jnp.float32):
         mask = jnp.tril(jnp.ones((s, t), dtype=bool))
         scores = jnp.where(mask, scores, jnp.finfo(softmax_dtype).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if key is not None and pdrop > 0.0:
+        from quintnet_tpu.nn.layers import dropout
+
+        probs = dropout(key, probs, pdrop, deterministic=False)
     return jnp.einsum("bhst,bhtd->bhsd", probs, v)
 
 
@@ -62,6 +69,9 @@ def mha_apply(
     sp_mode: str = "ring",
     use_flash: bool = False,
     return_kv: bool = False,
+    attn_pdrop: float = 0.0,
+    resid_pdrop: float = 0.0,
+    key=None,
 ):
     """x: [B, S_local, D] -> [B, S_local, D].
 
@@ -76,7 +86,19 @@ def mha_apply(
     ``return_kv=True`` additionally returns the per-head (k, v)
     projections [B, H, S, Dh] — the prefill half of KV-cache decoding
     (models/gpt2_generate.py).
+
+    Dropout (training only — pass ``key``): ``attn_pdrop`` on the
+    attention probabilities (plain sdpa path only; the flash/ring/
+    ulysses kernels skip it — the reference has neither sp nor flash),
+    ``resid_pdrop`` after the output projection, applied post-psum so
+    the mask agrees across tp ranks (reference gpt2_attention.py:156-180).
+    Under tp the SAME prob-dropout mask pattern is reused on each rank's
+    head block — head-group correlation, accepted for mask/key locality.
     """
+    k_attn = k_resid = None
+    if key is not None:
+        k_attn, k_resid = jax.random.split(key)
+
     qkv = linear_apply(p["qkv"], x)  # [B, S, 3*D_local]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
@@ -100,7 +122,7 @@ def mha_apply(
 
         o = flash_attention(q, k, v, causal=causal)
     else:
-        o = sdpa(q, k, v, causal=causal)
+        o = sdpa(q, k, v, causal=causal, pdrop=attn_pdrop, key=k_attn)
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
@@ -109,6 +131,10 @@ def mha_apply(
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
+    if k_resid is not None and resid_pdrop > 0.0:
+        from quintnet_tpu.nn.layers import dropout
+
+        y = dropout(k_resid, y, resid_pdrop, deterministic=False)
     if return_kv:
         return y, (k, v)
     return y

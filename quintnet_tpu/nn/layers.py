@@ -142,10 +142,16 @@ def mlp_init(key, dim: int, hidden: int, *, dtype=jnp.float32):
     }
 
 
-def mlp_apply(p, x, *, act=gelu, tp_axis: Optional[str] = None):
+def mlp_apply(p, x, *, act=gelu, tp_axis: Optional[str] = None,
+              pdrop: float = 0.0, key=None):
     """With ``tp_axis``: fc weight is column-sharded [D, hidden/tp] and proj
     row-sharded [hidden/tp, D]; the single psum after proj reproduces the
-    reference's ColumnParallel->RowParallel pair (gpt2_mlp.py:98-125)."""
+    reference's ColumnParallel->RowParallel pair (gpt2_mlp.py:98-125).
+
+    ``pdrop``/``key``: output dropout after the projection — the
+    reference's post-c_proj Dropout (gpt2_mlp.py:124-160). Applied after
+    the psum so the mask is identical on every tp rank (required: the
+    output is replicated)."""
     # fc bias is sharded with the columns, so it adds locally (no collective)
     h = act(linear_apply(p["fc"], x))
     y = jnp.dot(h, p["proj"]["w"])
@@ -153,4 +159,6 @@ def mlp_apply(p, x, *, act=gelu, tp_axis: Optional[str] = None):
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
+    if key is not None and pdrop > 0.0:
+        y = dropout(key, y, pdrop, deterministic=False)
     return y

@@ -60,9 +60,18 @@ def block_apply(
     use_flash: bool = False,
     moe_args: Optional[MoEArgs] = None,
     ep_axis: Optional[str] = None,
+    attn_pdrop: float = 0.0,
+    resid_pdrop: float = 0.0,
+    key=None,
 ):
     """Returns ``x`` for dense blocks, ``(x, aux_loss)`` when
-    ``moe_args`` is given (the MoE load-balance term, device-local)."""
+    ``moe_args`` is given (the MoE load-balance term, device-local).
+
+    ``key``: per-layer dropout key (training); None disables dropout
+    (eval / the deterministic default)."""
+    k_attn = k_mlp = None
+    if key is not None:
+        k_attn, k_mlp = jax.random.split(key)
     x = x + mha_apply(
         p["attn"],
         layer_norm_apply(p["ln1"], x),
@@ -72,13 +81,17 @@ def block_apply(
         sp_axis=sp_axis,
         sp_mode=sp_mode,
         use_flash=use_flash,
+        attn_pdrop=attn_pdrop,
+        resid_pdrop=resid_pdrop,
+        key=k_attn,
     )
     h = layer_norm_apply(p["ln2"], x)
     if moe_args is not None:
         y, aux = moe_apply(p["moe"], h, moe_args, ep_axis=ep_axis,
                            tp_axis=tp_axis, act=act)
         return x + y, aux
-    return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis)
+    return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis,
+                         pdrop=resid_pdrop, key=k_mlp)
 
 
 def stacked_blocks_apply(
@@ -95,6 +108,9 @@ def stacked_blocks_apply(
     remat: bool = False,
     moe_args: Optional[MoEArgs] = None,
     ep_axis: Optional[str] = None,
+    attn_pdrop: float = 0.0,
+    resid_pdrop: float = 0.0,
+    key=None,
 ):
     """Run a [depth, ...]-stacked block pytree with lax.scan.
 
@@ -107,7 +123,11 @@ def stacked_blocks_apply(
     ``(out, aux_total)`` — the summed load-balance loss across layers
     (pmeaned over ``sp_axis`` so its value is sequence-replication
     consistent with the main loss).
+
+    ``key``: dropout base key; split into one key per layer (rides the
+    scan alongside the params). None -> deterministic.
     """
+    depth = jax.tree.leaves(stacked_params)[0].shape[0]
     body = partial(
         block_apply,
         num_heads=num_heads,
@@ -119,25 +139,33 @@ def stacked_blocks_apply(
         use_flash=use_flash,
         moe_args=moe_args,
         ep_axis=ep_axis,
+        attn_pdrop=attn_pdrop,
+        resid_pdrop=resid_pdrop,
     )
     if remat:
         body = jax.checkpoint(body)
 
+    layer_keys = (jax.random.split(key, depth)
+                  if key is not None else jnp.zeros((depth, 2), jnp.uint32))
+    use_key = key is not None
+
     if moe_args is not None:
-        def scan_moe(h, blk_p):
-            h, aux = body(blk_p, h)
+        def scan_moe(h, xs):
+            blk_p, lk = xs
+            h, aux = body(blk_p, h, key=lk if use_key else None)
             return h, aux
 
-        out, auxes = jax.lax.scan(scan_moe, x, stacked_params)
+        out, auxes = jax.lax.scan(scan_moe, x, (stacked_params, layer_keys))
         aux = jnp.sum(auxes)
         if sp_axis is not None:
             aux = jax.lax.pmean(aux, sp_axis)
         return out, aux
 
-    def scan_fn(h, blk_p):
-        return body(blk_p, h), None
+    def scan_fn(h, xs):
+        blk_p, lk = xs
+        return body(blk_p, h, key=lk if use_key else None), None
 
-    out, _ = jax.lax.scan(scan_fn, x, stacked_params)
+    out, _ = jax.lax.scan(scan_fn, x, (stacked_params, layer_keys))
     return out
 
 

@@ -30,21 +30,34 @@ from quintnet_tpu.core.pytree import clip_by_global_norm
 
 
 def accumulate_grads(loss_fn: Callable, params, batch, n_micro: int,
-                     has_aux: bool = False):
+                     has_aux: bool = False, key=None):
     """Average value_and_grad over ``n_micro`` equal micro-batch slices of a
     [global_batch, ...] batch pytree, via lax.scan (static shapes, one
-    traced body)."""
-    vg = jax.value_and_grad(loss_fn, has_aux=has_aux)
+    traced body).
+
+    ``key``: dropout base key — folded with the microbatch index so each
+    slice gets independent masks; loss_fn must then accept a trailing
+    ``key`` argument."""
+    if key is None:
+        vg = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        call = lambda p, mb, _m: vg(p, mb)  # noqa: E731
+    else:
+        vg = jax.value_and_grad(
+            lambda p, mb, k: loss_fn(p, mb, k), has_aux=has_aux)
+        call = lambda p, mb, m: vg(p, mb, jax.random.fold_in(key, m))  # noqa: E731
 
     if n_micro == 1:
-        return vg(params, batch)
+        # no split -> no microbatch fold (keeps the key identical to the
+        # grad_fn path, e.g. AFAB-vs-1F1B mask parity in parallel/pp.py)
+        return vg(params, batch) if key is None else vg(params, batch, key)
 
     micro = jax.tree.map(
         lambda x: x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:]), batch
     )
 
-    def step(carry, mb):
-        out, g = vg(params, mb)
+    def step(carry, inp):
+        m, mb = inp
+        out, g = call(params, mb, m)
         acc_out, acc_g = carry
         acc_g = jax.tree.map(jnp.add, acc_g, g)
         if has_aux:
@@ -57,11 +70,14 @@ def accumulate_grads(loss_fn: Callable, params, batch, n_micro: int,
 
     zero_g = jax.tree.map(jnp.zeros_like, params)
     if has_aux:
-        out_shape = jax.eval_shape(vg, params, jax.tree.map(lambda x: x[0], micro))
+        out_shape = jax.eval_shape(
+            lambda p, mb: call(p, mb, 0), params,
+            jax.tree.map(lambda x: x[0], micro))
         zero_out = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape[0])
     else:
         zero_out = jnp.zeros(())
-    (out, g), _ = jax.lax.scan(step, (zero_out, zero_g), micro)
+    (out, g), _ = jax.lax.scan(step, (zero_out, zero_g),
+                               (jnp.arange(n_micro), micro))
     inv = 1.0 / n_micro
     g = jax.tree.map(lambda x: x * inv, g)
     out = jax.tree.map(lambda x: x * inv, out)

@@ -91,6 +91,25 @@ def _stage_out(out):
     return out, jnp.zeros((), jnp.float32)
 
 
+def _mb_keys(key, m, s):
+    """Per-(microbatch, stage) dropout keys for (embed, stage) — or
+    (None, None) without rng. The same (m, s) always derives the same
+    keys, which is what makes the 1F1B vjp-recompute reproduce the
+    forward's dropout masks exactly (train_step.py seed discipline)."""
+    if key is None:
+        return None, None
+    k = jax.random.fold_in(jax.random.fold_in(key, m), s)
+    return jax.random.fold_in(k, 0), jax.random.fold_in(k, 1)
+
+
+def _call_embed(embed_fn, params, x, k):
+    return embed_fn(params, x) if k is None else embed_fn(params, x, key=k)
+
+
+def _call_stage(stage_fn, blocks, h, k):
+    return stage_fn(blocks, h) if k is None else stage_fn(blocks, h, key=k)
+
+
 def make_afab_loss_fn(
     embed_fn: Callable,
     stage_fn: Callable,
@@ -103,7 +122,7 @@ def make_afab_loss_fn(
     M = spec.n_micro
     ax = spec.pp_axis
 
-    def pipeline_loss(params, batch):
+    def pipeline_loss(params, batch, key=None):
         x, y = batch
         x_mb = _split_micro(x, M)
         y_mb = _split_micro(y, M)
@@ -125,9 +144,11 @@ def make_afab_loss_fn(
             m_f = jnp.clip(t - s, 0, M - 1)
             x_t = jax.tree.map(lambda v: lax.dynamic_index_in_dim(
                 v, m_f, keepdims=False), x_mb)
-            emb = embed_fn(params, x_t)
+            k_e, k_s = _mb_keys(key, m_f, s)
+            emb = _call_embed(embed_fn, params, x_t, k_e)
             h_in = jnp.where(is_first, emb, h_recv)
-            h_out, aux = _stage_out(stage_fn(params["blocks"], h_in))
+            h_out, aux = _stage_out(
+                _call_stage(stage_fn, params["blocks"], h_in, k_s))
             y_t = jax.tree.map(lambda v: lax.dynamic_index_in_dim(
                 v, m_f, keepdims=False), y_mb)
             loss_m = head_loss_fn(params, h_out, y_t)
@@ -165,7 +186,7 @@ def make_1f1b_grad_fn(
     M = spec.n_micro
     ax = spec.pp_axis
 
-    def grad_fn(params, batch):
+    def grad_fn(params, batch, key=None):
         x, y = batch
         x_mb = _split_micro(x, M)
         y_mb = _split_micro(y, M)
@@ -177,15 +198,18 @@ def make_1f1b_grad_fn(
         T = M + 2 * (P_static - 1)
         CAP = 2 * P_static - 1  # max in-flight microbatch inputs per device
 
-        def mb_fn(p, x_t, y_t, h_recv):
+        def mb_fn(p, x_t, y_t, h_recv, m):
             """Complete per-device microbatch computation; vjp of this
             yields all local grads (embedding cotangent is blocked by the
             jnp.where on non-first stages, head's by the loss seed; MoE
             aux is seeded on EVERY stage — each stage owns its blocks'
-            load-balance term)."""
-            emb = embed_fn(p, x_t)
+            load-balance term). Dropout keys derive from (m, s), so the
+            backward-substep recompute reproduces the forward masks."""
+            k_e, k_s = _mb_keys(key, m, s)
+            emb = _call_embed(embed_fn, p, x_t, k_e)
             h_in = jnp.where(is_first, emb, h_recv)
-            h_out, aux = _stage_out(stage_fn(p["blocks"], h_in))
+            h_out, aux = _stage_out(
+                _call_stage(stage_fn, p["blocks"], h_in, k_s))
             loss_m = head_loss_fn(p, h_out, y_t) / M
             return h_out, (loss_m, aux / M)
 
@@ -209,7 +233,7 @@ def make_1f1b_grad_fn(
             fwd_active = (m_f >= 0) & (m_f < M)
             x_f = pick(x_mb, m_f)
             y_f = pick(y_mb, m_f)
-            h_out, (loss_f, aux_f) = mb_fn(params, x_f, y_f, h_recv)
+            h_out, (loss_f, aux_f) = mb_fn(params, x_f, y_f, h_recv, m_f)
             # save this microbatch's INPUT for the vjp recompute
             slot_f = jnp.mod(m_f, CAP)
             old = lax.dynamic_index_in_dim(in_buf, slot_f, keepdims=False)
@@ -229,7 +253,7 @@ def make_1f1b_grad_fn(
             y_b = pick(y_mb, m_b)
             slot_b = jnp.mod(m_b, CAP)
             h_saved = lax.dynamic_index_in_dim(in_buf, slot_b, keepdims=False)
-            _, vjp = jax.vjp(lambda p, hr: mb_fn(p, x_b, y_b, hr),
+            _, vjp = jax.vjp(lambda p, hr: mb_fn(p, x_b, y_b, hr, m_b),
                              params, h_saved)
             act = bwd_active.astype(h0.dtype)
             seed_h = jnp.where(is_last, jnp.zeros_like(g_recv), g_recv) * act

@@ -84,6 +84,10 @@ class ModelSpec:
     # batch (e.g. GPT-2 shards the sequence dim over sp). Default: batch
     # dim over the data axes, everything else replicated.
     batch_specs: Optional[Callable] = None
+    # True when loss_fn/pipeline fns take a dropout ``key`` kwarg that
+    # must vary per step (the train step then derives per-device keys
+    # from its ``seed`` argument — parallel/train_step.py).
+    needs_rng: bool = False
 
 
 @dataclass
@@ -183,6 +187,7 @@ class Strategy:
                     grad_fn=grad_fn,
                     zero1_axis=self.zero1_axis,
                     batch_specs=self.batch_partition_specs(model),
+                    needs_rng=model.needs_rng,
                 )
             loss = make_afab_loss_fn(embed_fn, stage_fn, head_loss_fn, pspec)
             return make_parallel_train_step(
@@ -193,11 +198,12 @@ class Strategy:
                 grad_clip_norm=cfg.training.grad_clip_norm,
                 zero1_axis=self.zero1_axis,
                 batch_specs=self.batch_partition_specs(model),
+                needs_rng=model.needs_rng,
             )
 
-        def loss(params, batch):
+        def loss(params, batch, key=None):
             return model.loss_fn(params, batch, tp_axis=tp_axis,
-                                 sp_axis=sp_axis, ep_axis=ep_axis)
+                                 sp_axis=sp_axis, ep_axis=ep_axis, key=key)
 
         return make_parallel_train_step(
             self.mesh, loss, optimizer, specs,
@@ -208,6 +214,7 @@ class Strategy:
             grad_clip_norm=cfg.training.grad_clip_norm,
             zero1_axis=self.zero1_axis,
             batch_specs=self.batch_partition_specs(model),
+            needs_rng=model.needs_rng,
         )
 
 

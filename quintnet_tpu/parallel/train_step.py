@@ -153,6 +153,23 @@ def init_zero1_opt_state(optimizer, params, param_specs, mesh: Mesh,
     return jax.jit(fn)(params), specs
 
 
+def device_dropout_key(seed, present_axes):
+    """Per-device dropout key: fold the device's (dp, ep, sp) coordinate
+    into the step seed — independent masks per token shard. NEVER folds
+    tp (tp ranks compute replicated activations whose masks must agree)
+    nor pp (schedules fold stage index themselves, parallel/pp.py).
+
+    The fold is canonical over the fixed axis list with 0 for axes the
+    mesh doesn't have, so the derived key depends only on the device's
+    logical data coordinate, not on which axes exist — single-device and
+    tp-only runs get bit-identical masks (tests/test_dropout.py)."""
+    key = jax.random.key(seed)
+    for a in ("dp", "ep", "sp"):
+        idx = lax.axis_index(a) if a in present_axes else 0
+        key = jax.random.fold_in(key, idx)
+    return key
+
+
 def make_parallel_train_step(
     mesh: Mesh,
     loss_fn: Callable,
@@ -169,6 +186,7 @@ def make_parallel_train_step(
     grad_fn: Optional[Callable] = None,
     zero1_axis: Optional[str] = None,
     batch_specs=None,
+    needs_rng: bool = False,
 ):
     """Build a jitted train step over an arbitrary (dp, tp, pp[, sp]) mesh.
 
@@ -180,18 +198,28 @@ def make_parallel_train_step(
     that compute grads without outer AD (1F1B) plug in here, replacing
     value_and_grad + accumulate.
 
-    Returns step(params, opt_state, batch) -> (params, opt_state, loss[, aux]).
+    ``needs_rng``: the model uses training dropout — ``loss_fn``/
+    ``grad_fn`` take a trailing ``key`` argument and the returned step
+    takes a ``seed`` (int) whose per-device key folds in dp/ep/sp
+    indices (:func:`device_dropout_key`).
+
+    Returns step(params, opt_state, batch[, seed]) ->
+    (params, opt_state, loss[, aux]).
     """
     data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     maxes = tuple(a for a in model_axes if a in mesh.axis_names)
     paxes = tuple(a for a in partial_axes if a in mesh.axis_names)
+    mesh_axes = tuple(mesh.axis_names)
 
-    def local_step(params, opt_state, batch):
+    def local_step(params, opt_state, batch, seed):
+        key = device_dropout_key(seed, mesh_axes) if needs_rng else None
         if grad_fn is not None:
-            out, grads = grad_fn(params, batch)
+            out, grads = (grad_fn(params, batch, key) if needs_rng
+                          else grad_fn(params, batch))
         else:
             out, grads = accumulate_grads(loss_fn, params, batch,
-                                          grad_accum_steps, has_aux)
+                                          grad_accum_steps, has_aux,
+                                          key=key)
         grads = reduce_grads(grads, param_specs,
                              data_axes=data_axes, model_axes=maxes,
                              partial_axes=paxes)
@@ -217,7 +245,7 @@ def make_parallel_train_step(
     # so the builder does not require materialised params.
     compiled = {}
 
-    def step(params, opt_state, batch):
+    def step(params, opt_state, batch, seed=None):
         if "fn" not in compiled:
             if zero1_axis is not None:
                 from quintnet_tpu.parallel import zero
@@ -233,12 +261,13 @@ def make_parallel_train_step(
             smapped = cc.shard_map_fn(
                 local_step,
                 mesh,
-                in_specs=(param_specs, o_specs, batch_spec),
+                in_specs=(param_specs, o_specs, batch_spec, P()),
                 out_specs=(param_specs, o_specs, P()),
             )
             compiled["fn"] = jax.jit(
                 smapped, donate_argnums=(0, 1) if donate else ()
             )
-        return compiled["fn"](params, opt_state, batch)
+        return compiled["fn"](params, opt_state, batch,
+                              jnp.uint32(seed if seed is not None else 0))
 
     return step

@@ -199,8 +199,12 @@ class Trainer:
             for i, (xb, yb) in enumerate(train_batches_fn(epoch)):
                 batch = self.strategy.shard_batch(
                     (jnp.asarray(xb), jnp.asarray(yb)), self.model)
+                # per-step dropout seed: deterministic in (config seed,
+                # epoch, step) so resume-from-epoch reproduces the run
+                seed = (self.config.training.seed * 2_000_003
+                        + epoch * 1_000_003 + i) & 0x7FFFFFFF
                 params, opt_state, loss = self.step_fn(params, opt_state,
-                                                       batch)
+                                                       batch, seed)
                 losses.append(float(loss))
                 if log_every and (i + 1) % log_every == 0:
                     self.log(f"epoch {epoch} step {i + 1}: "
