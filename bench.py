@@ -4,20 +4,37 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 The reference publishes no throughput numbers anywhere (BASELINE.md:21),
-so vs_baseline is reported against a fixed reference point derived from
-the reference's own hardware story: its GPT-2 run config processes a
-512-sample global batch per step on 8xA100 (micro 32 x grad_acc 8 x dp2,
-examples/gpt2_config.yaml); lacking its samples/sec we normalise to 1.0
-and additionally report measured MFU in the JSON extras.
+so ``vs_baseline`` is a real ratio against THIS repo's committed round-1
+measurement (BENCH_r01.json: 181.3 samples/s/chip for the default
+config, v5e chip, bs 8, seq 512, bf16, remat on) — >1.0 means the
+default config got faster than what round 1 shipped. Configs without a
+committed point report vs_baseline 1.0.
 
-Usage: python bench.py [--model gpt2|vit] [--steps 20] [--batch N]
+Modes:
+  python bench.py                      # gpt2 training throughput (default)
+  python bench.py --model vit          # ViT training throughput
+  python bench.py --model gpt2-moe     # MoE variant
+  python bench.py --model flash-attn --seq 8192
+      # flash-attention kernel vs XLA sdpa forward+backward micro-bench
+      # (substantiates the long-seq kernel speedup claim with a
+      # measured ratio in the JSON: extras.speedup_vs_sdpa)
+
+``--seq`` > 1024 raises GPT-2 n_positions to match and enables the
+flash path (ops/flash_attention.py engages Pallas at seq >= 4096).
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import time
+
+# Round-1 committed reference points (same chip class, default flags of
+# that round: bs 8, seq 512, bf16, remat=1). Keyed by metric name.
+COMMITTED_BASELINES = {
+    "gpt2_124m_seq512_train_samples_per_sec_per_chip": 181.3,
+}
 
 
 def flops_per_token_gpt2(cfg) -> float:
@@ -40,10 +57,57 @@ def flops_per_token_gpt2(cfg) -> float:
     return 6.0 * n_params
 
 
+def bench_flash_attn(args):
+    """Forward+backward attention micro-bench: Pallas flash kernel vs
+    the plain XLA sdpa path, GPT-2-base head geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    from quintnet_tpu.nn.attention import sdpa
+    from quintnet_tpu.ops.flash_attention import flash_attention
+
+    B, H, S, Dh = 1, 12, args.seq, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, Dh), jnp.bfloat16)
+               for kk in ks)
+
+    def run(fn):
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        out = g(q, k, v)  # compile
+        float(jnp.sum(out[0].astype(jnp.float32)))
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = g(q, k, v)
+        float(jnp.sum(out[0].astype(jnp.float32)))
+        return (time.perf_counter() - t0) / args.steps
+
+    t_flash = run(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    t_sdpa = run(lambda q, k, v: sdpa(q, k, v, causal=True))
+
+    # causal attention fwd+bwd ~ 3.5 * 2 * B*H*S^2*Dh (fwd 2 matmuls,
+    # bwd 5, halved by causal masking in the flash kernel's pruned grid)
+    flops = 3.5 * 2.0 * B * H * S * S * Dh
+    print(json.dumps({
+        "metric": f"flash_attn_seq{args.seq}_fwdbwd_time_ms",
+        "value": round(t_flash * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(t_sdpa / t_flash, 3),
+        "extras": {
+            "sdpa_time_ms": round(t_sdpa * 1e3, 3),
+            "speedup_vs_sdpa": round(t_sdpa / t_flash, 3),
+            "flash_tflops": round(flops / t_flash / 1e12, 2),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2",
-                    choices=["gpt2", "gpt2-moe", "vit"])
+                    choices=["gpt2", "gpt2-moe", "vit", "flash-attn"])
     ap.add_argument("--experts", type=int, default=8,
                     help="expert count for --model gpt2-moe")
     ap.add_argument("--steps", type=int, default=20)
@@ -52,9 +116,13 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
-    ap.add_argument("--remat", default=1, type=int,
+    ap.add_argument("--remat", default=0, type=int,
                     help="rematerialise blocks in backward (1) or keep "
-                         "activations (0); 0 is faster when HBM allows")
+                         "activations (0, default: GPT-2 124M fits v5e "
+                         "HBM without it and remat burns ~1/3 extra "
+                         "FLOPs)")
+    ap.add_argument("--vocab-parallel", action="store_true",
+                    help="shard wte + sharded-CE over tp (multi-chip)")
     args = ap.parse_args()
 
     import jax
@@ -64,6 +132,10 @@ def main():
 
     from quintnet_tpu.core.config import Config
     from quintnet_tpu.parallel.strategy import get_strategy
+
+    if args.model == "flash-attn":
+        bench_flash_attn(args)
+        return
 
     n_dev = len(jax.devices())
     cfg = Config.from_dict({
@@ -82,8 +154,15 @@ def main():
                               expert_top_k=2)
         else:
             gcfg = GPT2Config.base()
+        use_flash = args.seq >= 4096
+        if args.seq > gcfg.n_positions:
+            gcfg = dataclasses.replace(gcfg, n_positions=args.seq)
+        if args.vocab_parallel:
+            gcfg = dataclasses.replace(gcfg, vocab_parallel=True,
+                                       padded_vocab_size=50304)
         compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
         model = gpt2_model_spec(gcfg, remat=bool(args.remat),
+                                use_flash=use_flash,
                                 compute_dtype=compute_dtype)
         ids = np.random.default_rng(0).integers(
             0, gcfg.vocab_size, size=(args.batch * n_dev, args.seq),
@@ -95,7 +174,8 @@ def main():
             f"gpt2_moe{args.experts}"
         metric = f"{name}_seq{args.seq}_train_samples_per_sec_per_chip"
     else:
-        from quintnet_tpu.models.vit import ViTConfig, vit_model_spec
+        from quintnet_tpu.models.vit import (ViTConfig, vit_init,
+                                             vit_model_spec)
 
         vcfg = ViTConfig(hidden_dim=64, depth=8, num_heads=4)
         model = vit_model_spec(vcfg)
@@ -103,14 +183,17 @@ def main():
             size=(args.batch * n_dev, 28, 28, 1)).astype(np.float32)
         y = np.random.default_rng(1).integers(0, 10, size=(args.batch * n_dev,))
         batch = (jnp.asarray(x), jnp.asarray(y.astype(np.int32)))
-        n_params = 0
-        flops_per_step = 6.0 * 800_000 * args.batch * n_dev  # ~0.8M params
+        # actual parameter count (round 1 used a fabricated constant)
+        n_params = sum(int(np.prod(l.shape)) for l in
+                       jax.tree.leaves(vit_init(jax.random.key(0), vcfg)))
+        flops_per_step = (6.0 * n_params * vcfg.seq_len
+                          * args.batch * n_dev)
         metric = "vit_mnist_train_samples_per_sec_per_chip"
 
     opt = optax.adamw(1e-4)
     params = strat.shard_params(model, model.init(jax.random.key(0)))
     opt_state = strat.init_opt_state(model, opt, params)
-    b = strat.shard_batch(batch)
+    b = strat.shard_batch(batch, model)
     step = strat.make_train_step(model, opt)
 
     # compile + warmup. NOTE: float(loss) (device->host copy) is the sync
@@ -131,18 +214,29 @@ def main():
     flops_rate = flops_per_step / dt / n_dev
     # v5e peak: 197 TFLOP/s bf16 per chip
     mfu = flops_rate / 197e12 if jax.default_backend() == "tpu" else 0.0
+    # a committed baseline applies only to the config class it was
+    # measured under (bs 8/chip, bf16, dense loss); remat is the knob
+    # being tuned, so it MAY differ — that improvement is the point
+    default_config = (args.batch == 8 and args.dtype == "bfloat16"
+                      and not args.vocab_parallel)
+    baseline = COMMITTED_BASELINES.get(metric) if default_config else None
 
     print(json.dumps({
         "metric": metric,
         "value": round(per_chip, 3),
         "unit": "samples/s/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": (round(per_chip / baseline, 4)
+                        if baseline else 1.0),
         "extras": {
             "step_time_s": round(dt, 4),
             "devices": n_dev,
             "backend": jax.default_backend(),
+            "batch_per_chip": args.batch,
+            "dtype": args.dtype,
+            "remat": bool(args.remat),
             "mfu": round(mfu, 4),
             "loss": loss_val,
+            "baseline": baseline,
         },
     }))
 

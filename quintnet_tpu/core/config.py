@@ -111,7 +111,9 @@ class TrainingConfig:
     optimizer: str = "adam"  # adam | adamw | zero1_adamw
     grad_clip_norm: Optional[float] = 1.0
     seed: int = 0
-    schedule: str = "1f1b"  # 1f1b | afab (reference: schedule.py:39-516)
+    # 1f1b (vjp-recompute backward) | 1f1b_stored (store activations,
+    # the reference's semantics) | afab (reference: schedule.py:39-516)
+    schedule: str = "1f1b"
     sp_mode: str = "ring"  # ring | ulysses (sequence-parallel attention)
     dtype: str = "float32"
     param_dtype: str = "float32"

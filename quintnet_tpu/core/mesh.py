@@ -79,9 +79,24 @@ def build_mesh(
     ``torch.arange(world).view(dims)`` + one NCCL group per dim —
     mesh.py:213-251; none of that machinery is needed here).
     """
+    n = spec.world_size
     if devices is None:
         devices = jax.devices()
-    n = spec.world_size
+        if (len(devices) == n and devices
+                and devices[0].platform == "tpu"):
+            # pod-scale: lay the mesh out over the slice's physical ICI
+            # topology (rings/tori) instead of enumeration order, so
+            # minor-axis collectives ride adjacent links; falls back to
+            # the reshape when the topology solver has no assignment
+            from jax.experimental import mesh_utils
+
+            try:
+                return Mesh(
+                    mesh_utils.create_device_mesh(spec.shape,
+                                                  devices=devices),
+                    spec.names)
+            except (ValueError, NotImplementedError, AssertionError):
+                pass
     if len(devices) < n:
         raise ValueError(
             f"mesh {dict(spec.axes)} needs {n} devices, have {len(devices)}"

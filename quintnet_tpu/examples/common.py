@@ -19,24 +19,52 @@ def parse_args(default_config: str):
     ap.add_argument("--epochs", type=int, default=None)
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--data-dir", default=None)
+    add_multihost_args(ap)
     return ap.parse_args()
 
 
-def setup_platform(simulate: int):
+def add_multihost_args(ap):
+    """Pod-scale launch flags (reference: torchrun env rendezvous,
+    README.md:93-97). One process per host; on TPU pods --multihost
+    alone auto-detects the slice topology."""
+    ap.add_argument("--multihost", action="store_true",
+                    help="jax.distributed.initialize() (TPU pod)")
+    ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="explicit coordinator (CPU/dev multi-process)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    return ap
+
+
+def setup_platform(simulate: int, args=None):
     """Must run before first jax backend use."""
-    if simulate:
+    multihost = args is not None and (args.multihost or args.coordinator)
+    if simulate and not multihost:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={simulate}")
     import jax
 
-    if simulate:
+    if multihost:
+        from quintnet_tpu.core import runtime
+
+        runtime.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+            local_device_count=simulate or None,
+            platform="cpu" if simulate else None,
+        )
+        print(f"process {jax.process_index()}/{jax.process_count()}: "
+              f"{jax.local_device_count()} local / "
+              f"{jax.device_count()} global devices")
+    elif simulate:
         jax.config.update("jax_platforms", "cpu")
     return jax
 
 
 def run_vit(args, strategy_name: str):
-    setup_platform(args.simulate)
+    setup_platform(args.simulate, args)
 
     from quintnet_tpu.core.config import load_config
     from quintnet_tpu.data import ArrayDataset, load_mnist, make_batches
@@ -66,6 +94,10 @@ def run_vit(args, strategy_name: str):
         lambda ep: make_batches(train, bs, seed=ep),
         val_batches_fn=lambda ep: make_batches(test, bs, shuffle=False),
     )
-    print(f"done in {hist.wall_time_s:.1f}s; "
-          f"final train_loss {hist.train_loss[-1]:.4f}")
+    msg = (f"done in {hist.wall_time_s:.1f}s; "
+           f"final train_loss {hist.train_loss[-1]:.4f}")
+    if hist.val_metric:
+        # reference headline metric (README.md:214: 93.24% val acc)
+        msg += f"; final val_accuracy {hist.val_metric[-1]:.4f}"
+    print(msg)
     return hist

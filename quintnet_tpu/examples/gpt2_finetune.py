@@ -39,11 +39,14 @@ def main():
                     help="after training, greedy-generate summaries for "
                          "N val samples (KV-cache decoder) and report "
                          "ROUGE-1/2/L + BLEU")
+    from quintnet_tpu.examples.common import add_multihost_args
+
+    add_multihost_args(ap)
     args = ap.parse_args()
 
     from quintnet_tpu.examples.common import setup_platform
 
-    setup_platform(args.simulate)
+    setup_platform(args.simulate, args)
 
     import jax
 
@@ -147,11 +150,14 @@ def main():
 
         host = jax.device_get(trainer.final_state[0])
         host = gpt2_from_tp_layout(host, gcfg, cfg.tp_size)
+        max_prompt = max(max_len // 2, 8)
         prompts = val_ds.eval_prompts(
-            max_prompt_len=max(max_len // 2, 8), limit=args.gen_eval)
+            max_prompt_len=max_prompt, limit=args.gen_eval)
+        # clamp against the ACTUAL max prompt length so prompt+new never
+        # exceeds n_positions (tiny configs have max_len//2 < 8)
         scores = evaluate_generation(
             host, gcfg, prompts, tok,
-            max_new_tokens=min(64, gcfg.n_positions - max_len // 2),
+            max_new_tokens=min(64, gcfg.n_positions - max_prompt),
             eos_token_id=getattr(tok, "eos_token_id", None))
         print("generation eval:",
               {k: round(v, 4) for k, v in scores.items()})

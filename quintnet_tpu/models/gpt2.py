@@ -75,10 +75,28 @@ class GPT2Config:
     expert_capacity: Optional[int] = None
     aux_loss_weight: float = 1e-2
     router_z_weight: float = 0.0
+    # --- vocab parallelism: shard wte over tp (the reference DEFINES
+    # VocabParallelEmbedding but never uses it, layers.py:224-297 —
+    # GPT-2 replicates embeddings there). With it on, the lm-head loss
+    # is a sharded cross-entropy (local logsumexp + psum) so full
+    # [B, T, V] logits are NEVER materialised on any rank. Requires
+    # vocab_size % tp == 0 (pad, e.g. 50257 -> 50304, Megatron-style;
+    # padded columns are masked out of the softmax so the loss is
+    # bit-comparable to the unpadded model). Ignored when tp is off.
+    vocab_parallel: bool = False
+    # wte table rows when padding the vocab to a tp multiple;
+    # ``vocab_size`` stays the REAL vocab (labels/ids range, softmax
+    # support). None = no padding (table rows == vocab_size).
+    padded_vocab_size: Optional[int] = None
 
     @property
     def mlp_hidden(self) -> int:
         return 4 * self.n_embd
+
+    @property
+    def table_vocab_size(self) -> int:
+        """wte rows (padded vocab when padding is configured)."""
+        return self.padded_vocab_size or self.vocab_size
 
     @property
     def pdrops(self):
@@ -149,7 +167,7 @@ def gpt2_init(key, cfg: GPT2Config, *, dtype=jnp.float32):
     )
     return {
         "embedding": {
-            "wte": embedding_init(k_wte, cfg.vocab_size, cfg.n_embd,
+            "wte": embedding_init(k_wte, cfg.table_vocab_size, cfg.n_embd,
                                   dtype=dtype)["table"],
             "wpe": embedding_init(k_wpe, cfg.n_positions, cfg.n_embd,
                                   scale=0.01, dtype=dtype)["table"],
@@ -191,16 +209,27 @@ def gpt2_upcycle_to_moe(params, cfg: GPT2Config, key=None):
 
 
 def gpt2_embed(params, input_ids, *, sp_axis: Optional[str] = None,
-               embd_pdrop: float = 0.0, key=None):
+               embd_pdrop: float = 0.0, key=None,
+               vp_axis: Optional[str] = None):
     """[B, T_local] ids -> [B, T_local, D] (reference GPT2Embedding,
     replicated across TP — gpt2_embeddings.py:16-103, including its
     post-sum embedding dropout :100-101 when ``key`` is given).
 
     With ``sp_axis`` the sequence dim is sharded: this rank's position
-    embeddings start at axis_index * T_local."""
+    embeddings start at axis_index * T_local. With ``vp_axis`` the wte
+    VOCAB dim is sharded over that (tp) axis: out-of-shard ids
+    contribute zeros and one psum assembles the embedding
+    (parallel/tp.py:vocab_parallel_embedding semantics; the reference
+    defined-but-unused VocabParallelEmbedding, layers.py:224-297)."""
     emb = params["embedding"]
     T = input_ids.shape[-1]
-    tok = jnp.take(emb["wte"], input_ids, axis=0)
+    if vp_axis is not None:
+        from quintnet_tpu.parallel.tp import vocab_parallel_embedding
+
+        tok = vocab_parallel_embedding({"table": emb["wte"]}, input_ids,
+                                       axis=vp_axis)
+    else:
+        tok = jnp.take(emb["wte"], input_ids, axis=0)
     start = 0
     if sp_axis is not None:
         start = jax.lax.axis_index(sp_axis) * T
@@ -243,9 +272,23 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
 def gpt2_logits(params, h, cfg: GPT2Config):
     """ln_f then tied lm_head: logits = ln_f(h) @ wte^T
     (reference: lm_head is a copy of wte synced by hand,
-    gpt2_stage.py:112-141; here it IS wte)."""
+    gpt2_stage.py:112-141; here it IS wte).
+
+    With a padded vocab and an UNSHARDED table (wte rows ==
+    table_vocab_size: no-tp fallback of a vocab_parallel config, or
+    single-device generation), the padded columns are masked to -inf
+    here so they never enter any softmax and argmax-decoding can never
+    emit an id >= vocab_size. Vocab-SHARDED tables (local rows under
+    vp) are masked inside clm_loss_vp instead, which knows the shard
+    offset."""
     h = layer_norm_apply(params["head"]["ln_f"], h, eps=cfg.layer_norm_epsilon)
-    return jnp.dot(h, params["embedding"]["wte"].T).astype(jnp.float32)
+    logits = jnp.dot(h, params["embedding"]["wte"].T).astype(jnp.float32)
+    if (cfg.padded_vocab_size
+            and params["embedding"]["wte"].shape[0] == cfg.table_vocab_size):
+        col = jnp.arange(cfg.table_vocab_size)
+        logits = jnp.where(col < cfg.vocab_size, logits,
+                           jnp.finfo(jnp.float32).min)
+    return logits
 
 
 def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
@@ -258,8 +301,9 @@ def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
     k_embd = k_blocks = None
     if key is not None and cfg.needs_dropout:
         k_embd, k_blocks = jax.random.split(key)
+    vp_axis = tp_axis if (cfg.vocab_parallel and tp_axis) else None
     h = gpt2_embed(params, input_ids, sp_axis=sp_axis,
-                   embd_pdrop=cfg.pdrops[0], key=k_embd)
+                   embd_pdrop=cfg.pdrops[0], key=k_embd, vp_axis=vp_axis)
     out = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
                       sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
                       remat=remat, use_flash=use_flash, key=k_blocks)
@@ -294,25 +338,32 @@ def clm_loss(logits, labels):
     return jnp.sum(nll) / count
 
 
-def clm_loss_sp(logits, labels, *, sp_axis: str):
-    """CLM loss when the sequence dim is sharded over ``sp_axis``.
-
-    The next-token shift crosses chunk boundaries: each rank's last
-    position targets the FIRST label of the next rank's chunk (one
-    ppermute); the final rank's last position is invalid. Token-count
-    normalisation is global (psum of sums / psum of counts), so the
-    result equals :func:`clm_loss` on the gathered sequence exactly.
-    """
+def _sp_shift_targets(labels, sp_axis: str):
+    """Next-token target shift when the sequence dim is sharded: each
+    rank's last position targets the FIRST label of the next rank's
+    chunk (one ppermute); the global-final position (last rank's last
+    column) is invalidated. Shared by :func:`clm_loss_sp` and
+    :func:`clm_loss_vp` so the shift semantics cannot diverge."""
     sp = jax.lax.axis_size(sp_axis)
     idx = jax.lax.axis_index(sp_axis)
     # rank i+1 sends its first label column to rank i
     perm = [(i + 1, i) for i in range(sp - 1)]
     first_next = jax.lax.ppermute(labels[:, :1], sp_axis, perm)
     targets = jnp.concatenate([labels[:, 1:], first_next], axis=1)
-    # invalidate the global-final position (last rank's last column)
     col = jnp.arange(targets.shape[1])
     boundary = (idx == sp - 1) & (col == targets.shape[1] - 1)
-    targets = jnp.where(boundary[None, :], IGNORE_INDEX, targets)
+    return jnp.where(boundary[None, :], IGNORE_INDEX, targets)
+
+
+def clm_loss_sp(logits, labels, *, sp_axis: str):
+    """CLM loss when the sequence dim is sharded over ``sp_axis``.
+
+    The next-token shift crosses chunk boundaries
+    (:func:`_sp_shift_targets`). Token-count normalisation is global
+    (psum of sums / psum of counts), so the result equals
+    :func:`clm_loss` on the gathered sequence exactly.
+    """
+    targets = _sp_shift_targets(labels, sp_axis)
 
     valid = targets != IGNORE_INDEX
     safe = jnp.where(valid, targets, 0)
@@ -321,6 +372,55 @@ def clm_loss_sp(logits, labels, *, sp_axis: str):
     nll = jnp.where(valid, nll, 0.0)
     total = jax.lax.psum(jnp.sum(nll), sp_axis)
     count = jax.lax.psum(jnp.sum(valid), sp_axis)
+    return total / jnp.maximum(count, 1)
+
+
+def clm_loss_vp(local_logits, labels, *, tp_axis: str,
+                sp_axis: Optional[str] = None,
+                vocab_size: Optional[int] = None):
+    """CLM loss from VOCAB-SHARDED logits [B, T, V/tp] — the sharded
+    cross-entropy: full logits are never materialised on any rank.
+
+    Global logsumexp = log(psum(sum(exp(local - max)))) + max with the
+    max pmax'd over tp (stop_gradient on the shift — the true softmax
+    gradient flows through the exp/psum path). The target's logit is
+    picked by the one rank whose shard holds it and psummed. Equals
+    :func:`clm_loss` (resp. :func:`clm_loss_sp` when ``sp_axis``) on the
+    gathered logits exactly. ``vocab_size`` masks padded vocab columns
+    (Megatron-style padding to a tp multiple) out of the softmax so the
+    padded and unpadded models give identical losses."""
+    if sp_axis is None:
+        logits = local_logits[:, :-1]
+        targets = labels[:, 1:]
+    else:
+        targets = _sp_shift_targets(labels, sp_axis)
+        logits = local_logits
+
+    logits = logits.astype(jnp.float32)
+    vp = logits.shape[-1]
+    start = jax.lax.axis_index(tp_axis) * vp
+    if vocab_size is not None:
+        col_ids = start + jnp.arange(vp)
+        logits = jnp.where(col_ids < vocab_size, logits,
+                           jnp.finfo(jnp.float32).min)
+    valid = targets != IGNORE_INDEX
+    # stop_gradient BEFORE the pmax (pmax has no JVP rule; the shift is
+    # a constant anyway — the true softmax grad flows via exp/psum)
+    m = jax.lax.pmax(jax.lax.stop_gradient(jnp.max(logits, axis=-1)),
+                     tp_axis)
+    se = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
+    lse = jnp.log(jax.lax.psum(se, tp_axis)) + m
+    local_t = jnp.where(valid, targets, 0) - start
+    in_shard = (local_t >= 0) & (local_t < vp)
+    safe = jnp.clip(local_t, 0, vp - 1)
+    tl = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    tl = jax.lax.psum(jnp.where(in_shard, tl, 0.0), tp_axis)
+    nll = jnp.where(valid, lse - tl, 0.0)
+    total = jnp.sum(nll)
+    count = jnp.sum(valid)
+    if sp_axis is not None:
+        total = jax.lax.psum(total, sp_axis)
+        count = jax.lax.psum(count, sp_axis)
     return total / jnp.maximum(count, 1)
 
 
@@ -345,8 +445,14 @@ def gpt2_partition_specs(cfg: Optional[GPT2Config] = None, *,
         del bspecs["mlp"]
         bspecs["moe"] = moe_specs(ep_axis=ep_axis, tp_axis=tp_axis,
                                   stacked=True, pp_axis=pp_axis)
+    wte_spec = P()
+    if cfg is not None and cfg.vocab_parallel and tp_axis is not None:
+        # vocab dim sharded over tp; grads stay un-psummed over tp by
+        # reduce_grads' spec rule (train_step.py) — the vp loss/embed
+        # psums supply the tp cotangent factor exactly once.
+        wte_spec = P(tp_axis, None)
     return {
-        "embedding": {"wte": P(), "wpe": P()},
+        "embedding": {"wte": wte_spec, "wpe": P()},
         "blocks": bspecs,
         "head": {"ln_f": {"scale": P(), "bias": P()}},
     }
@@ -357,6 +463,12 @@ def gpt2_to_tp_layout(params, cfg: GPT2Config, tp: int):
     (parallel/tp.py docstring). Identity at tp=1."""
     from quintnet_tpu.parallel.tp import qkv_blocked_from_standard
 
+    if cfg.vocab_parallel and tp > 1 and cfg.table_vocab_size % tp != 0:
+        raise ValueError(
+            f"vocab_parallel needs (padded_)vocab_size % tp == 0; got "
+            f"{cfg.table_vocab_size} % {tp}. Set padded_vocab_size "
+            f"(e.g. 50257 -> 50304); padded columns are masked out of "
+            f"the loss.")
     if tp == 1:
         return params
     out = jax.tree.map(lambda x: x, params)
@@ -405,7 +517,8 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
     def embed_fn(params, input_ids, key=None):
         return gpt2_embed(_cast_tree(params, compute_dtype), input_ids,
                           sp_axis=sp_axis, embd_pdrop=cfg.pdrops[0],
-                          key=key)
+                          key=key,
+                          vp_axis=(tp_axis if cfg.vocab_parallel else None))
 
     def stage_fn(blocks_local, h, key=None):
         return gpt2_blocks(_cast_tree(blocks_local, compute_dtype), h, cfg,
@@ -413,10 +526,31 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
                            ep_axis=ep_axis, remat=remat, use_flash=use_flash,
                            key=key)
 
+    vp = cfg.vocab_parallel and tp_axis is not None
+    if vp or sp_axis is not None:
+        # the loss contains collectives (vp lse psums / sp shift+psum),
+        # which may not sit inside the schedules' lax.cond gate — split:
+        # gated collective-free lm-head matmul, unconditional reduction
+        # (parallel/pp.py SplitHead)
+        from quintnet_tpu.parallel.pp import SplitHead
+
+        def head_local_fn(params, h, labels):
+            return gpt2_logits(_cast_tree(params, compute_dtype), h, cfg)
+
+        def head_reduce_fn(logits, labels, valid):
+            if vp:
+                loss = clm_loss_vp(
+                    logits, labels, tp_axis=tp_axis, sp_axis=sp_axis,
+                    vocab_size=(cfg.vocab_size if cfg.padded_vocab_size
+                                else None))
+            else:
+                loss = clm_loss_sp(logits, labels, sp_axis=sp_axis)
+            return jnp.where(valid, loss, 0.0)
+
+        return embed_fn, stage_fn, SplitHead(head_local_fn, head_reduce_fn)
+
     def head_loss_fn(params, h, labels):
         logits = gpt2_logits(_cast_tree(params, compute_dtype), h, cfg)
-        if sp_axis is not None:
-            return clm_loss_sp(logits, labels, sp_axis=sp_axis)
         return clm_loss(logits, labels)
 
     return embed_fn, stage_fn, head_loss_fn
@@ -437,6 +571,11 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
                                    sp_axis=sp_axis, sp_mode=sp_mode,
                                    ep_axis=ep_axis, remat=remat,
                                    use_flash=use_flash, key=key)
+        if cfg.vocab_parallel and tp_axis is not None:
+            return clm_loss_vp(
+                logits, labels, tp_axis=tp_axis, sp_axis=sp_axis,
+                vocab_size=(cfg.vocab_size if cfg.padded_vocab_size
+                            else None)) + aux
         if sp_axis is not None:
             return clm_loss_sp(logits, labels, sp_axis=sp_axis) + aux
         return clm_loss(logits, labels) + aux

@@ -252,6 +252,24 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
     def to_tp_layout(params, tp):
         return vit_to_tp_layout(params, cfg, tp)
 
+    def eval_metrics_fn(params, batch, tp_axis=None, sp_axis=None,
+                        ep_axis=None):
+        x, y = batch
+        logits = vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat)
+        return {"loss": cross_entropy_loss(logits, y),
+                "accuracy": accuracy(logits, y)}
+
+    def pipeline_eval_fns(tp_axis=None, sp_axis=None, ep_axis=None):
+        embed_fn, stage_fn, _ = vit_pipeline_fns(cfg, tp_axis=tp_axis,
+                                                 remat=remat)
+
+        def head_metrics_fn(params, h, y):
+            logits = vit_head(params["head"], h).astype(jnp.float32)
+            return {"loss": cross_entropy_loss(logits, y),
+                    "accuracy": accuracy(logits, y)}
+
+        return embed_fn, stage_fn, head_metrics_fn
+
     return ModelSpec(
         init=lambda key: vit_init(key, cfg),
         loss_fn=loss_fn,
@@ -259,6 +277,8 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
         pipeline_fns=pipeline_fns,
         to_tp_layout=to_tp_layout,
         depth=cfg.depth,
+        eval_metrics_fn=eval_metrics_fn,
+        pipeline_eval_fns=pipeline_eval_fns,
     )
 
 

@@ -99,6 +99,21 @@ def mha_apply(
     if key is not None:
         k_attn, k_resid = jax.random.split(key)
 
+    if attn_pdrop > 0.0 and key is not None and (sp_axis is not None
+                                                 or use_flash):
+        # The flash/ring/ulysses kernels have no prob-dropout hook; a
+        # config asking for both would otherwise silently train with
+        # less regularization than requested. Trace-time warning so the
+        # mismatch is visible (fires once per compile, not per step).
+        import warnings
+
+        warnings.warn(
+            "attn_pdrop > 0 is ignored on the flash/ring/ulysses "
+            "attention path; only resid_pdrop is applied. Set "
+            "attn_pdrop=0 or use the plain sdpa path.",
+            stacklevel=2,
+        )
+
     qkv = linear_apply(p["qkv"], x)  # [B, S, 3*D_local]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)

@@ -89,6 +89,14 @@ def block_apply(
     if moe_args is not None:
         y, aux = moe_apply(p["moe"], h, moe_args, ep_axis=ep_axis,
                            tp_axis=tp_axis, act=act)
+        if k_mlp is not None and resid_pdrop > 0.0:
+            # Same resid_pdrop as the dense branch so MoE and dense
+            # configs with identical dropout settings regularize alike.
+            # Safe post-psum: the combined output is replicated across
+            # tp ranks, so the mask agrees on every rank.
+            from quintnet_tpu.nn.layers import dropout
+
+            y = dropout(k_mlp, y, resid_pdrop, deterministic=False)
         return x + y, aux
     return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis,
                          pdrop=resid_pdrop, key=k_mlp)

@@ -110,6 +110,47 @@ def _call_stage(stage_fn, blocks, h, k):
     return stage_fn(blocks, h) if k is None else stage_fn(blocks, h, key=k)
 
 
+class SplitHead(NamedTuple):
+    """Head loss in two phases so schedules can cond-gate the expensive
+    part without putting collectives inside the cond (XLA collectives
+    rendezvous group-wide regardless of the branch taken — a psum/
+    ppermute in the untaken branch deadlocks the runtime; verified on
+    the CPU collectives backend and unsafe on TPU SPMD too).
+
+    ``local_fn(params, h, y) -> pytree``: the expensive, COLLECTIVE-FREE
+    computation (e.g. the [*, vocab] lm-head matmul) — executed under
+    lax.cond only on the last stage's active ticks.
+    ``reduce_fn(local, y, valid) -> scalar``: cheap; may contain
+    collectives (sp/vp psums); runs unconditionally on EVERY stage with
+    zeroed ``local`` when gated off, and must return 0 when ``valid`` is
+    False."""
+
+    local_fn: Callable
+    reduce_fn: Callable
+
+
+def _apply_head(head, params, h, y, want):
+    """Run the head loss gated to ``want`` (a traced bool, uniform
+    across tp/sp ranks of a pp stage). Plain callable heads must be
+    collective-free: the whole fn goes in lax.cond so non-last stages
+    never execute the lm-head matmul the reference also skips (loss on
+    last stage only, schedule.py:317-344; a jnp.where after the matmul
+    would still burn the FLOPs — XLA cannot DCE through it). SplitHead
+    heads gate only local_fn and run reduce_fn unconditionally."""
+    if isinstance(head, SplitHead):
+        shapes = jax.eval_shape(head.local_fn, params, h, y)
+        zeros = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                             shapes)
+        local = lax.cond(want, lambda: head.local_fn(params, h, y),
+                         lambda: zeros)
+        return head.reduce_fn(local, y, want).astype(jnp.float32)
+    return lax.cond(
+        want,
+        lambda: head(params, h, y).astype(jnp.float32),
+        lambda: jnp.zeros((), jnp.float32),
+    )
+
+
 def make_afab_loss_fn(
     embed_fn: Callable,
     stage_fn: Callable,
@@ -151,9 +192,9 @@ def make_afab_loss_fn(
                 _call_stage(stage_fn, params["blocks"], h_in, k_s))
             y_t = jax.tree.map(lambda v: lax.dynamic_index_in_dim(
                 v, m_f, keepdims=False), y_mb)
-            loss_m = head_loss_fn(params, h_out, y_t)
             active = (t - s >= 0) & (t - s < M)
             valid = is_last & active
+            loss_m = _apply_head(head_loss_fn, params, h_out, y_t, valid)
             # every ACTIVE stage contributes its local blocks' MoE aux
             loss_t = (jnp.where(valid, loss_m, 0.0)
                       + jnp.where(active, aux, 0.0)) / M
@@ -174,15 +215,118 @@ def make_afab_loss_fn(
     return pipeline_loss
 
 
+def make_afab_eval_fn(
+    embed_fn: Callable,
+    stage_fn: Callable,
+    head_metrics_fn: Callable,
+    spec: PipelineSpec,
+):
+    """Forward-only pipeline evaluation (reference: PipelineTrainer.
+    evaluate, pipeline_parallel/trainer.py:222-253 — whose last stage
+    re-reads labels from its own dataloader; here labels ride with the
+    batch, same as training).
+
+    ``head_metrics_fn(params, h, y) -> {name: scalar}`` returns
+    per-microbatch MEAN metrics (e.g. loss, accuracy) computed on the
+    last stage. The result is their average over microbatches, made
+    uniform across pp ranks with a psum. Non-last stages never execute
+    the head (lax.cond). MoE aux losses are not included (eval metric
+    parity with the dense loss)."""
+    M = spec.n_micro
+    ax = spec.pp_axis
+
+    def eval_fn(params, batch):
+        x, y = batch
+        x_mb = _split_micro(x, M)
+        y_mb = _split_micro(y, M)
+
+        s = lax.axis_index(ax)
+        P_ = lax.axis_size(ax)
+        is_first = s == 0
+        is_last = s == P_ - 1
+        T = M + P_ - 1
+
+        x0 = jax.tree.map(lambda v: v[0], x_mb)
+        y0 = jax.tree.map(lambda v: v[0], y_mb)
+        h_shape = jax.eval_shape(lambda p, xi: embed_fn(p, xi), params, x0)
+        h0 = jnp.zeros(h_shape.shape, h_shape.dtype)
+        split = isinstance(head_metrics_fn, SplitHead)
+        if split:
+            l_shapes = jax.eval_shape(head_metrics_fn.local_fn,
+                                      params, h0, y0)
+            l_zeros = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), l_shapes)
+        else:
+            met_shapes = jax.eval_shape(
+                lambda p, h, yy: head_metrics_fn(p, h, yy), params, h0, y0)
+            zeros = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, jnp.float32), met_shapes)
+
+        def tick(h_send, t):
+            h_recv = cc.ppermute_shift(h_send, ax, shift=1, wrap=False)
+            m_f = jnp.clip(t - s, 0, M - 1)
+            x_t = jax.tree.map(lambda v: lax.dynamic_index_in_dim(
+                v, m_f, keepdims=False), x_mb)
+            emb = _call_embed(embed_fn, params, x_t, None)
+            h_in = jnp.where(is_first, emb, h_recv)
+            h_out, _aux = _stage_out(
+                _call_stage(stage_fn, params["blocks"], h_in, None))
+            y_t = jax.tree.map(lambda v: lax.dynamic_index_in_dim(
+                v, m_f, keepdims=False), y_mb)
+            active = (t - s >= 0) & (t - s < M)
+            valid = is_last & active
+            if split:
+                # local part gated; reduce (with its collectives) runs
+                # on every stage — see SplitHead
+                local = lax.cond(
+                    valid,
+                    lambda: head_metrics_fn.local_fn(params, h_out, y_t),
+                    lambda: l_zeros)
+                mets = jax.tree.map(
+                    lambda v: v.astype(jnp.float32),
+                    head_metrics_fn.reduce_fn(local, y_t, valid))
+            else:
+                mets = lax.cond(
+                    valid,
+                    lambda: jax.tree.map(
+                        lambda v: v.astype(jnp.float32),
+                        head_metrics_fn(params, h_out, y_t)),
+                    lambda: zeros)
+            return h_out, jax.tree.map(lambda v: v / M, mets)
+
+        _, mets = lax.scan(tick, h0, jnp.arange(T))
+        total = jax.tree.map(lambda v: jnp.sum(v, axis=0), mets)
+        return jax.tree.map(lambda v: lax.psum(v, ax), total)
+
+    return eval_fn
+
+
 def make_1f1b_grad_fn(
     embed_fn: Callable,
     stage_fn: Callable,
     head_loss_fn: Callable,
     spec: PipelineSpec,
+    *,
+    store_activations: bool = False,
 ):
     """Build ``grad_fn(params, (x, y)) -> (loss, grads)`` running the 1F1B
-    schedule with vjp-recompute backward. Plug into
-    make_parallel_train_step(grad_fn=...), ``partial_axes=('pp',)``."""
+    schedule. Plug into make_parallel_train_step(grad_fn=...),
+    ``partial_axes=('pp',)``.
+
+    ``store_activations=False`` (default '1f1b'): the backward sub-step
+    recomputes the microbatch forward via jax.vjp from the saved INPUT
+    — 2x forward FLOPs, O(P) saved inputs (the activation-checkpoint
+    trade).
+    ``store_activations=True`` ('1f1b_stored', the reference's actual
+    1F1B semantics — its input/output queues keep the autograd graph
+    alive, schedule.py:286-287): the forward sub-step runs jax.vjp once
+    and SAVES the vjp residuals; the backward sub-step replays them —
+    1x forward FLOPs, O(P) full per-microbatch stage residuals live
+    (every layer's activations). jax.vjp's pullback is a flattenable
+    pytree, so its dynamic leaves live in [CAP, ...]-stacked scan-carry
+    buffers, rebuilt at the backward sub-step with the template treedef.
+    Same gradients either way (tests/test_pp.py golden checks); pick by
+    HBM headroom."""
     M = spec.n_micro
     ax = spec.pp_axis
 
@@ -198,19 +342,22 @@ def make_1f1b_grad_fn(
         T = M + 2 * (P_static - 1)
         CAP = 2 * P_static - 1  # max in-flight microbatch inputs per device
 
-        def mb_fn(p, x_t, y_t, h_recv, m):
+        def mb_fn(p, x_t, y_t, h_recv, m, want_loss):
             """Complete per-device microbatch computation; vjp of this
             yields all local grads (embedding cotangent is blocked by the
             jnp.where on non-first stages, head's by the loss seed; MoE
             aux is seeded on EVERY stage — each stage owns its blocks'
             load-balance term). Dropout keys derive from (m, s), so the
-            backward-substep recompute reproduces the forward masks."""
+            backward-substep recompute reproduces the forward masks.
+            ``want_loss`` gates the lm-head matmul to the last stage's
+            active ticks only (cond, not where — see _gated_head_loss)."""
             k_e, k_s = _mb_keys(key, m, s)
             emb = _call_embed(embed_fn, p, x_t, k_e)
             h_in = jnp.where(is_first, emb, h_recv)
             h_out, aux = _stage_out(
                 _call_stage(stage_fn, p["blocks"], h_in, k_s))
-            loss_m = head_loss_fn(p, h_out, y_t) / M
+            loss_m = _apply_head(head_loss_fn, p, h_out, y_t,
+                                 want_loss) / M
             return h_out, (loss_m, aux / M)
 
         def pick(mb_tree, m):
@@ -221,11 +368,32 @@ def make_1f1b_grad_fn(
         h_shape = jax.eval_shape(
             lambda p, xi: embed_fn(p, xi), params, pick(x_mb, jnp.int32(0)))
         h0 = jnp.zeros(h_shape.shape, h_shape.dtype)
-        in_buf0 = jnp.zeros((CAP,) + h0.shape, h0.dtype)
         g_acc0 = jax.tree.map(jnp.zeros_like, params)
 
+        if store_activations:
+            # Template vjp: same trace as the in-tick vjp, with every
+            # input ABSTRACT (derived from tracers) so constant folding
+            # cannot change the residual structure vs the tick's. Slots
+            # are seeded with the template's REAL residuals (one extra
+            # microbatch-0 forward per step): never-yet-written slots are
+            # read by inactive backward ticks with zero seeds, and the
+            # replay must stay FINITE (0-residuals blow up through e.g.
+            # rsqrt-power recompute in the LN transpose; 0 * inf = NaN).
+            m_a = s * 0            # abstract int scalar
+            w_a = is_last & (s < 0)  # abstract bool scalar
+            h_a = h0 + (m_a * 0).astype(h0.dtype)
+            _, vjp_t = jax.vjp(
+                lambda p, hr: mb_fn(p, pick(x_mb, m_a), pick(y_mb, m_a),
+                                    hr, m_a, w_a),
+                params, h_a)
+            t_leaves, t_def = jax.tree_util.tree_flatten(vjp_t)
+            res_buf0 = tuple(
+                jnp.broadcast_to(l, (CAP,) + l.shape) for l in t_leaves)
+        else:
+            res_buf0 = jnp.zeros((CAP,) + h0.shape, h0.dtype)
+
         def tick(carry, t):
-            h_send, g_send, in_buf, g_acc, loss_acc = carry
+            h_send, g_send, res_buf, g_acc, loss_acc = carry
 
             # ---- forward sub-step: stage s processes microbatch t - s
             h_recv = cc.ppermute_shift(h_send, ax, shift=1, wrap=False)
@@ -233,12 +401,38 @@ def make_1f1b_grad_fn(
             fwd_active = (m_f >= 0) & (m_f < M)
             x_f = pick(x_mb, m_f)
             y_f = pick(y_mb, m_f)
-            h_out, (loss_f, aux_f) = mb_fn(params, x_f, y_f, h_recv, m_f)
-            # save this microbatch's INPUT for the vjp recompute
+
+            def write(buf, slot, new):
+                old = lax.dynamic_index_in_dim(buf, slot, keepdims=False)
+                return lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(fwd_active, new, old), slot, 0)
+
             slot_f = jnp.mod(m_f, CAP)
-            old = lax.dynamic_index_in_dim(in_buf, slot_f, keepdims=False)
-            in_buf = lax.dynamic_update_index_in_dim(
-                in_buf, jnp.where(fwd_active, h_recv, old), slot_f, 0)
+            if store_activations:
+                # one vjp: primal forward + residual save
+                (h_out, (loss_f, aux_f)), vjp_f = jax.vjp(
+                    lambda p, hr: mb_fn(p, x_f, y_f, hr, m_f,
+                                        is_last & fwd_active),
+                    params, h_recv)
+                f_leaves = jax.tree_util.tree_flatten(vjp_f)[0]
+                assert len(f_leaves) == len(t_leaves) and all(
+                    a.shape == b.shape and a.dtype == b.dtype
+                    for a, b in zip(f_leaves, t_leaves)), (
+                    "1f1b_stored: vjp residual structure differs from "
+                    "template — report this configuration")
+                # write UNCONDITIONALLY: inactive ticks store real
+                # (finite) residuals of the clipped microbatch, read
+                # only by inactive backwards with zero seeds; slot
+                # reuse is safe (a slot's previous owner has always
+                # been backwarded — see CAP derivation)
+                res_buf = tuple(
+                    lax.dynamic_update_index_in_dim(b, l, slot_f, 0)
+                    for b, l in zip(res_buf, f_leaves))
+            else:
+                h_out, (loss_f, aux_f) = mb_fn(params, x_f, y_f, h_recv,
+                                               m_f, is_last & fwd_active)
+                # save this microbatch's INPUT for the vjp recompute
+                res_buf = write(res_buf, slot_f, h_recv)
             loss_acc = (loss_acc
                         + jnp.where(is_last & fwd_active, loss_f, 0.0)
                         + jnp.where(fwd_active, aux_f, 0.0))
@@ -249,12 +443,20 @@ def make_1f1b_grad_fn(
             g_recv = cc.ppermute_shift(g_send, ax, shift=-1, wrap=False)
             m_b = t - 2 * (P_static - 1) + s
             bwd_active = (m_b >= 0) & (m_b < M)
-            x_b = pick(x_mb, m_b)
-            y_b = pick(y_mb, m_b)
             slot_b = jnp.mod(m_b, CAP)
-            h_saved = lax.dynamic_index_in_dim(in_buf, slot_b, keepdims=False)
-            _, vjp = jax.vjp(lambda p, hr: mb_fn(p, x_b, y_b, hr, m_b),
-                             params, h_saved)
+            if store_activations:
+                res = [lax.dynamic_index_in_dim(b, slot_b, keepdims=False)
+                       for b in res_buf]
+                vjp = jax.tree_util.tree_unflatten(t_def, res)
+            else:
+                x_b = pick(x_mb, m_b)
+                y_b = pick(y_mb, m_b)
+                h_saved = lax.dynamic_index_in_dim(res_buf, slot_b,
+                                                   keepdims=False)
+                _, vjp = jax.vjp(
+                    lambda p, hr: mb_fn(p, x_b, y_b, hr, m_b,
+                                        is_last & bwd_active),
+                    params, h_saved)
             act = bwd_active.astype(h0.dtype)
             seed_h = jnp.where(is_last, jnp.zeros_like(g_recv), g_recv) * act
             seed_loss = jnp.where(is_last & bwd_active, 1.0, 0.0)
@@ -262,9 +464,9 @@ def make_1f1b_grad_fn(
             g_params, g_h = vjp((seed_h, (seed_loss, seed_aux)))
             g_acc = jax.tree.map(jnp.add, g_acc, g_params)
 
-            return (h_out, g_h, in_buf, g_acc, loss_acc), None
+            return (h_out, g_h, res_buf, g_acc, loss_acc), None
 
-        carry0 = (h0, h0, in_buf0, g_acc0, jnp.zeros((), jnp.float32))
+        carry0 = (h0, h0, res_buf0, g_acc0, jnp.zeros((), jnp.float32))
         (_, _, _, grads, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T))
         # main loss lives on the last stage, each stage holds its own MoE

@@ -84,6 +84,15 @@ class ModelSpec:
     # batch (e.g. GPT-2 shards the sequence dim over sp). Default: batch
     # dim over the data axes, everything else replicated.
     batch_specs: Optional[Callable] = None
+    # optional eval hooks (Trainer.evaluate). Non-pp:
+    # ``eval_metrics_fn(params, batch, tp_axis, sp_axis, ep_axis) ->
+    # {name: scalar}`` (e.g. ViT adds accuracy — the metric the
+    # reference headline reports, README 93.24%). Pipeline:
+    # ``pipeline_eval_fns(tp_axis, sp_axis, ep_axis) ->
+    # (embed_fn, stage_fn, head_metrics_fn)`` per
+    # parallel/pp.py:make_afab_eval_fn. Defaults fall back to loss-only.
+    eval_metrics_fn: Optional[Callable] = None
+    pipeline_eval_fns: Optional[Callable] = None
     # True when loss_fn/pipeline fns take a dropout ``key`` kwarg that
     # must vary per step (the train step then derives per-device keys
     # from its ``seed`` argument — parallel/train_step.py).
@@ -114,17 +123,36 @@ class Strategy:
             ep_axis=self.axis_or_none("ep"),
         )
 
+    @property
+    def is_multiprocess(self) -> bool:
+        return jax.process_count() > 1
+
     def shard_params(self, model: ModelSpec, params):
         """Host/global params -> mesh-placed params (incl. tp layout fix).
 
-        NOTE: ``jax.device_put`` may alias the input's buffers when a
-        shard can reuse them in place; since ``make_train_step`` donates
-        its params, the INPUT tree must be treated as consumed — copy
-        first (``jax.tree.map(jnp.copy, ...)``) if you need it again.
+        Multi-process: every process must hold the same host-global
+        params (same init seed / same checkpoint); each materialises
+        only its addressable shards (core/runtime.py) — the role the
+        reference's per-rank sharded checkpoint reads play
+        (distributed_loading.py:203-376).
+
+        NOTE (single-process): ``jax.device_put`` may alias the input's
+        buffers when a shard can reuse them in place; since
+        ``make_train_step`` donates its params, the INPUT tree must be
+        treated as consumed — copy first (``jax.tree.map(jnp.copy, ...)``)
+        if you need it again.
         """
         tp = self.mesh.shape.get("tp", 1)
         params = model.to_tp_layout(params, tp)
-        return shard_pytree(self.mesh, params, self.param_specs(model))
+        specs = self.param_specs(model)
+        if self.is_multiprocess:
+            from quintnet_tpu.core.runtime import global_array_from_host_data
+
+            return jax.tree.map(
+                lambda x, s: global_array_from_host_data(
+                    NamedSharding(self.mesh, s), x),
+                params, specs)
+        return shard_pytree(self.mesh, params, specs)
 
     def batch_partition_specs(self, model: Optional[ModelSpec] = None):
         if model is not None and model.batch_specs is not None:
@@ -133,13 +161,41 @@ class Strategy:
         return P(self.batch_axes if self.batch_axes else None)
 
     def shard_batch(self, batch, model: Optional[ModelSpec] = None):
+        """HOST-GLOBAL batch -> mesh-placed batch. Multi-process: every
+        process holds the global batch; only local shards transfer."""
         specs = self.batch_partition_specs(model)
         if isinstance(specs, P):
             specs = jax.tree.map(lambda _: specs, batch)
+        if self.is_multiprocess:
+            from quintnet_tpu.core.runtime import global_array_from_host_data
+
+            return jax.tree.map(
+                lambda x, s: global_array_from_host_data(
+                    NamedSharding(self.mesh, s), x),
+                batch, specs)
         return jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
             batch, specs,
         )
+
+    def shard_batch_local(self, local_batch,
+                          model: Optional[ModelSpec] = None,
+                          global_batch_size: Optional[int] = None):
+        """PROCESS-LOCAL batch slice -> global mesh-placed batch (true
+        per-host feeding, the reference's DistributedSampler role —
+        examples/full_3d.py:129-155). Each process passes only its own
+        rows; see core/runtime.py:host_local_slice for which ones."""
+        from quintnet_tpu.core.runtime import (
+            global_array_from_process_data,
+        )
+
+        specs = self.batch_partition_specs(model)
+        if isinstance(specs, P):
+            specs = jax.tree.map(lambda _: specs, local_batch)
+        return jax.tree.map(
+            lambda x, s: global_array_from_process_data(
+                NamedSharding(self.mesh, s), x),
+            local_batch, specs)
 
     @property
     def zero1_axis(self) -> Optional[str]:
@@ -175,9 +231,11 @@ class Strategy:
             embed_fn, stage_fn, head_loss_fn = model.pipeline_fns(
                 tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
             pspec = PipelineSpec(n_micro=n_micro, pp_axis="pp")
-            if cfg.training.schedule.lower() in ("1f1b", "one_f_one_b"):
+            sched = cfg.training.schedule.lower()
+            if sched in ("1f1b", "one_f_one_b", "1f1b_stored"):
                 grad_fn = make_1f1b_grad_fn(
-                    embed_fn, stage_fn, head_loss_fn, pspec)
+                    embed_fn, stage_fn, head_loss_fn, pspec,
+                    store_activations=(sched == "1f1b_stored"))
                 return make_parallel_train_step(
                     self.mesh, None, optimizer, specs,
                     batch_axes=self.batch_axes,

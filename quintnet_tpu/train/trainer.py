@@ -29,7 +29,6 @@ import optax
 
 from quintnet_tpu.core.config import Config
 from quintnet_tpu.parallel.strategy import ModelSpec, Strategy, get_strategy
-from quintnet_tpu.train import metrics as M
 
 
 def make_optimizer(cfg: Config) -> optax.GradientTransformation:
@@ -56,6 +55,8 @@ class History:
     train_metric: List[float] = field(default_factory=list)
     val_metric: List[float] = field(default_factory=list)
     wall_time_s: float = 0.0
+    best_val_loss: float = float("inf")
+    best_epoch: int = -1
 
 
 class Trainer:
@@ -70,7 +71,9 @@ class Trainer:
                  optimizer: Optional[optax.GradientTransformation] = None,
                  task_type: str = "classification",
                  checkpoint_dir: Optional[str] = None,
-                 eval_logits_fn: Optional[Callable] = None,
+                 eval_logits_fn: Optional[Callable] = None,  # unused; kept
+                 # for call-site compat — accuracy now comes from the
+                 # model's eval_metrics_fn / pipeline_eval_fns hooks
                  log_fn: Callable[[str], None] = print):
         self.config = config
         self.model = model
@@ -79,6 +82,10 @@ class Trainer:
         self.task_type = task_type
         self.checkpoint_dir = checkpoint_dir
         self.log = log_fn
+        if self.strategy.is_multiprocess and jax.process_index() != 0:
+            # one SPMD log per job, not per host (reference: rank-0 tqdm
+            # guards); checkpoint saves stay collective on every process
+            self.log = lambda msg: None
         self.eval_logits_fn = eval_logits_fn
 
         self.step_fn = self.strategy.make_train_step(self.model, self.optimizer)
@@ -117,8 +124,28 @@ class Trainer:
         mgr = CheckpointManager(self.checkpoint_dir)
         mgr.save(epoch, {"params": params, "opt": opt_state, "epoch": epoch})
 
+    def save_best(self, epoch: int, params, opt_state, val_loss: float):
+        """Best-by-val-loss retention in a sibling ``<dir>-best``
+        directory (one kept), alongside the rolling epoch saves —
+        reference: best-and-final per-shard save, GPT2_Trainer.py:453-507.
+        Sibling, not subdir, so orbax's step listing of the main
+        directory never sees a non-numeric entry."""
+        if not self.checkpoint_dir:
+            return
+        from quintnet_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(self.checkpoint_dir.rstrip("/") + "-best",
+                                max_to_keep=1)
+        mgr.save(epoch, {"params": params, "opt": opt_state, "epoch": epoch,
+                         "val_loss": val_loss})
+
     # -- evaluation --------------------------------------------------------
     def _build_eval(self):
+        """One jitted eval step returning ``{name: scalar}`` device
+        metrics — loss always; accuracy for classification (incl. under
+        pp, via the forward-only pipeline eval gathering last-stage
+        metrics — the reference cannot report its headline 93.24% val
+        accuracy under pp at all)."""
         if self._eval_fn is not None:
             return self._eval_fn
         from jax.sharding import PartitionSpec as P
@@ -133,49 +160,66 @@ class Trainer:
 
         if strat.uses_pp:
             from quintnet_tpu.parallel.pp import (PipelineSpec,
-                                                  make_afab_loss_fn)
+                                                  make_afab_eval_fn)
 
-            embed_fn, stage_fn, head_loss_fn = self.model.pipeline_fns(
-                tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
-            loss_fn = make_afab_loss_fn(
-                embed_fn, stage_fn, head_loss_fn,
-                PipelineSpec(
-                    n_micro=self.config.training.gradient_accumulation_steps))
+            pspec = PipelineSpec(
+                n_micro=self.config.training.gradient_accumulation_steps)
+            if self.model.pipeline_eval_fns is not None:
+                embed_fn, stage_fn, head_metrics_fn = \
+                    self.model.pipeline_eval_fns(
+                        tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
+            else:
+                from quintnet_tpu.parallel.pp import SplitHead
+
+                embed_fn, stage_fn, head = self.model.pipeline_fns(
+                    tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
+                if isinstance(head, SplitHead):
+                    head_metrics_fn = SplitHead(
+                        head.local_fn,
+                        lambda local, y, valid:
+                            {"loss": head.reduce_fn(local, y, valid)})
+                else:
+                    def head_metrics_fn(p, h, y, _h=head):
+                        return {"loss": _h(p, h, y)}
+
+            metrics_fn = make_afab_eval_fn(
+                embed_fn, stage_fn, head_metrics_fn, pspec)
+        elif self.model.eval_metrics_fn is not None:
+            def metrics_fn(p, b):
+                return self.model.eval_metrics_fn(
+                    p, b, tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
         else:
-            def loss_fn(p, b):
-                return self.model.loss_fn(p, b, tp_axis=tp_axis,
-                                          sp_axis=sp_axis, ep_axis=ep_axis)
+            def metrics_fn(p, b):
+                return {"loss": self.model.loss_fn(
+                    p, b, tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)}
 
         def local_eval(p, b):
-            loss = loss_fn(p, b)
+            mets = metrics_fn(p, b)
             if strat.batch_axes:
-                loss = jax.lax.pmean(loss, strat.batch_axes)
-            return loss
+                mets = jax.tree.map(
+                    lambda v: jax.lax.pmean(v, strat.batch_axes), mets)
+            return mets
 
         batch_spec = strat.batch_partition_specs(self.model)
         self._eval_fn = jax.jit(cc.shard_map_fn(
             local_eval, strat.mesh,
-            in_specs=(specs, batch_spec), out_specs=P()))
+            in_specs=(specs, batch_spec),
+            out_specs=P()))
         return self._eval_fn
 
     def evaluate(self, params, batches: Iterable) -> Dict[str, float]:
         eval_fn = self._build_eval()
-        losses = []
-        accs = []
+        acc: Dict[str, list] = {}
         for xb, yb in batches:
             b = self.strategy.shard_batch((jnp.asarray(xb), jnp.asarray(yb)),
                                           self.model)
-            losses.append(float(eval_fn(params, b)))
-            if (self.task_type == "classification"
-                    and not self.strategy.uses_pp
-                    and self.eval_logits_fn is not None):
-                logits = self.eval_logits_fn(params, b[0])
-                accs.append(float(M.accuracy(logits, b[1])))
-        out = {"loss": float(np.mean(losses)) if losses else float("nan")}
+            for k, v in eval_fn(params, b).items():
+                acc.setdefault(k, []).append(v)  # device scalars; no sync
+        out = {k: float(np.mean([float(v) for v in vs]))
+               for k, vs in acc.items()}
+        out.setdefault("loss", float("nan"))
         if self.task_type == "clm":
             out["perplexity"] = float(np.exp(min(out["loss"], 20.0)))
-        elif accs:
-            out["accuracy"] = float(np.mean(accs))
         return out
 
     # -- training ----------------------------------------------------------
@@ -195,6 +239,10 @@ class Trainer:
         log_every = self.config.training.log_every
 
         for epoch in range(start, epochs):
+            # losses stay DEVICE scalars during the epoch — no per-step
+            # host sync blocking async dispatch (the reference blocks on
+            # .item() every step; so did round 1's float(loss)). Host
+            # reads happen only at log boundaries and epoch end.
             losses = []
             for i, (xb, yb) in enumerate(train_batches_fn(epoch)):
                 batch = self.strategy.shard_batch(
@@ -205,11 +253,13 @@ class Trainer:
                         + epoch * 1_000_003 + i) & 0x7FFFFFFF
                 params, opt_state, loss = self.step_fn(params, opt_state,
                                                        batch, seed)
-                losses.append(float(loss))
+                losses.append(loss)
                 if log_every and (i + 1) % log_every == 0:
+                    window = jnp.mean(jnp.stack(losses[-log_every:]))
                     self.log(f"epoch {epoch} step {i + 1}: "
-                             f"loss {np.mean(losses[-log_every:]):.4f}")
-            train_loss = float(np.mean(losses)) if losses else float("nan")
+                             f"loss {float(window):.4f}")
+            train_loss = (float(jnp.mean(jnp.stack(losses)))
+                          if losses else float("nan"))
             hist.train_loss.append(train_loss)
             msg = f"epoch {epoch}: train_loss {train_loss:.4f}"
             if self.task_type == "clm":
@@ -224,6 +274,11 @@ class Trainer:
                     if k in ev:
                         hist.val_metric.append(ev[k])
                         msg += f" val_{k} {ev[k]:.4f}"
+                if ev["loss"] < hist.best_val_loss:
+                    hist.best_val_loss = ev["loss"]
+                    hist.best_epoch = epoch
+                    self.save_best(epoch, params, opt_state, ev["loss"])
+                    msg += " (best)"
             self.log(msg)
             self.save(epoch, params, opt_state)
 

@@ -1,0 +1,97 @@
+"""Worker for tests/test_multihost.py — one process of a 2-process
+CPU run (4 virtual devices each, 8 global) training ViT on a dp4 x tp2
+mesh with BOTH per-host feeding modes. Not collected by pytest
+(underscore prefix); launched as `python tests/_mp_worker.py <pid> ...`.
+"""
+
+import json
+import sys
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    num_procs = int(sys.argv[2])
+    port = sys.argv[3]
+    outfile = sys.argv[4]
+
+    from quintnet_tpu.core import runtime
+
+    runtime.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+        local_device_count=4,
+        platform="cpu",
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.models.vit import ViTConfig, vit_init, vit_model_spec
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.process_count() == num_procs
+
+    cfg_model = ViTConfig(image_size=14, patch_size=7, in_channels=1,
+                          hidden_dim=16, depth=4, num_heads=2,
+                          num_classes=10)
+    cfg = Config.from_dict({
+        "mesh_dim": [4, 2],
+        "mesh_name": ["dp", "tp"],
+        "training": {"batch_size": 16,
+                     "gradient_accumulation_steps": 1,
+                     "grad_clip_norm": None},
+    })
+
+    # identical host-global data/params on every process (same seeds)
+    x = jax.random.normal(jax.random.key(1), (16, 14, 14, 1))
+    y = jax.random.randint(jax.random.key(2), (16,), 0, 10)
+    x, y = np.asarray(x), np.asarray(y)
+
+    model = vit_model_spec(cfg_model)
+    opt = optax.sgd(0.05)
+    strat = get_strategy("dp_tp", cfg)
+    assert strat.is_multiprocess
+    step = strat.make_train_step(model, opt)
+
+    def param_sqsum(mesh, p):
+        fn = jax.jit(
+            lambda t: sum(jnp.sum(jnp.square(l))
+                          for l in jax.tree.leaves(t)),
+            out_shardings=NamedSharding(mesh, P()))
+        return float(fn(p))
+
+    results = {}
+    for mode in ("global", "local"):
+        params = strat.shard_params(model, vit_init(jax.random.key(0),
+                                                    cfg_model))
+        opt_state = strat.init_opt_state(model, opt, params)
+        losses = []
+        for _ in range(2):
+            if mode == "global":
+                b = strat.shard_batch((x, y), model)
+            else:
+                # true per-host feeding: this process passes ONLY its rows
+                from quintnet_tpu.core.runtime import host_local_slice
+
+                specs = strat.batch_partition_specs(model)
+                shard_x = NamedSharding(strat.mesh, specs)
+                sl = host_local_slice(shard_x, x.shape)
+                b = strat.shard_batch_local((x[sl], y[sl[:1]]), model)
+            params, opt_state, loss = step(params, opt_state, b)
+            losses.append(float(loss))
+        results[mode] = {"losses": losses,
+                         "param_sqsum": param_sqsum(strat.mesh, params)}
+
+    with open(outfile, "w") as f:
+        json.dump({"process": proc_id, **results}, f)
+    print(f"worker {proc_id} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

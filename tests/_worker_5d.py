@@ -1,0 +1,108 @@
+"""Worker for tests/test_5d.py — runs GPT-2-MoE 1F1B training on a full
+five-axis dp x tp x pp x sp x ep = 2x2x2x2x2 mesh (32 virtual CPU
+devices, own process so the device count doesn't clash with the main
+suite's 8) and asserts golden parity with single-device math in-process.
+Writes a result JSON as its last act so the parent can distinguish
+"asserts passed" from "crashed".
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=32")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from quintnet_tpu.core.config import Config
+from quintnet_tpu.models.gpt2 import (
+    GPT2Config,
+    clm_loss,
+    gpt2_forward,
+    gpt2_init,
+    gpt2_model_spec,
+    gpt2_to_tp_layout,
+)
+from quintnet_tpu.parallel.strategy import get_strategy
+
+
+def main():
+    outfile = sys.argv[1]
+    assert jax.device_count() == 32, jax.device_count()
+
+    gcfg = GPT2Config.tiny(
+        vocab_size=128, n_positions=32, n_layer=2, n_head=4,
+        n_experts=4, expert_top_k=2, expert_capacity=4096,
+        aux_loss_weight=0.0)  # no drops, no aux: exact golden parity
+    cfg = Config.from_dict({
+        "mesh_dim": [2, 2, 2, 2, 2],
+        "mesh_name": ["dp", "tp", "pp", "sp", "ep"],
+        "training": {
+            "batch_size": 8,
+            "gradient_accumulation_steps": 2,
+            "schedule": "1f1b",
+            "grad_clip_norm": None,
+        },
+    })
+
+    ids = np.asarray(jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                        gcfg.vocab_size), np.int32)
+    params0 = gpt2_init(jax.random.key(0), gcfg)
+    opt = optax.sgd(0.05)
+
+    # single-device reference
+    def ref_loss(p):
+        logits, _aux = gpt2_forward(p, jnp.asarray(ids), gcfg)
+        return clm_loss(logits, jnp.asarray(ids))
+
+    p_ref = params0
+    state = opt.init(p_ref)
+    ref_losses = []
+    for _ in range(2):
+        loss, g = jax.value_and_grad(ref_loss)(p_ref)
+        upd, state = opt.update(g, state, p_ref)
+        p_ref = optax.apply_updates(p_ref, upd)
+        ref_losses.append(float(loss))
+
+    # 5D run
+    strat = get_strategy("5d", cfg)
+    assert dict(strat.mesh.shape) == {"dp": 2, "tp": 2, "pp": 2,
+                                      "sp": 2, "ep": 2}
+    model = gpt2_model_spec(gcfg)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params0))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch((jnp.asarray(ids), jnp.asarray(ids)), model)
+    step = strat.make_train_step(model, opt)
+    losses = []
+    for _ in range(2):
+        p, s, loss = step(p, s, b)
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+
+    p_ref_layout = gpt2_to_tp_layout(p_ref, gcfg, 2)
+    ref = dict(jax.tree_util.tree_leaves_with_path(p_ref_layout))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(p):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(leaf)), np.asarray(ref[path]),
+            rtol=5e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+    with open(outfile, "w") as f:
+        json.dump({"losses": losses, "ref_losses": ref_losses,
+                   "ok": True}, f)
+    print("5d worker done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

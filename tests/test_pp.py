@@ -93,14 +93,17 @@ def test_afab_matches_single_device(mesh_pp):
     _check_grads(g, g_ref)
 
 
-def test_1f1b_matches_single_device(mesh_pp):
+@pytest.mark.parametrize("stored", [False, True],
+                         ids=["recompute", "stored"])
+def test_1f1b_matches_single_device(mesh_pp, stored):
     params = vit_init(jax.random.key(0), CFG)
     batch = _data()
     loss_ref, g_ref = _ref_loss_and_grads(params, batch)
 
     embed_fn, stage_fn, head_loss_fn = vit_pipeline_fns(CFG)
     grad_fn = make_1f1b_grad_fn(embed_fn, stage_fn, head_loss_fn,
-                                PipelineSpec(n_micro=M))
+                                PipelineSpec(n_micro=M),
+                                store_activations=stored)
     specs = vit_partition_specs(CFG, tp_axis=None, pp_axis="pp")
 
     def local(p, b):

@@ -1,0 +1,217 @@
+"""Vocab-parallel GPT-2 golden tests.
+
+The reference defines VocabParallelEmbedding but never uses it
+(tensor_parallel/layers.py:224-297 — GPT-2 replicates embeddings,
+gpt2_embeddings.py:8-9). Here vocab parallelism is a first-class GPT-2
+option (models/gpt2.py GPT2Config.vocab_parallel): wte sharded over tp,
+embedding via masked-lookup + psum, and a sharded cross-entropy that
+never materialises full [B, T, V] logits. These tests pin it to the
+replicated/single-device math.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from quintnet_tpu.core import collectives as cc
+from quintnet_tpu.core.config import Config
+from quintnet_tpu.models.gpt2 import (
+    GPT2Config,
+    clm_loss,
+    clm_loss_vp,
+    gpt2_apply,
+    gpt2_init,
+    gpt2_model_spec,
+    gpt2_to_tp_layout,
+)
+from quintnet_tpu.parallel.strategy import get_strategy
+
+VOCAB = 128
+CFG = GPT2Config.tiny(vocab_size=VOCAB)
+VP_CFG = dataclasses.replace(CFG, vocab_parallel=True)
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _data(n=8, t=16, seed=3):
+    k1 = jax.random.key(seed)
+    ids = jax.random.randint(k1, (n, t), 0, VOCAB)
+    # mask a fixed PREFIX per row (prompt masking, reference collator
+    # semantics): identical valid counts per dp shard, so the dp
+    # mean-of-shard-means equals the global mean exactly and the golden
+    # comparison is tight
+    col = jnp.arange(t)
+    labels = jnp.where(col[None, :] < 3, -100, ids)
+    return ids, labels
+
+
+def test_clm_loss_vp_matches_dense():
+    """Sharded CE == dense CE on the same (column-sharded) logits."""
+    mesh = _mesh((2,), ("tp",))
+    logits = jax.random.normal(jax.random.key(0), (4, 12, VOCAB))
+    _, labels = _data(4, 12)
+
+    dense = clm_loss(logits, labels)
+
+    fn = cc.shard_map_fn(
+        lambda lg, lb: clm_loss_vp(lg, lb, tp_axis="tp"),
+        mesh,
+        in_specs=(P(None, None, "tp"), P()),
+        out_specs=P(),
+    )
+    sharded = jax.jit(fn)(logits, labels)
+    np.testing.assert_allclose(float(sharded), float(dense), rtol=1e-6)
+
+
+def _config(mesh_dim, mesh_name, schedule="afab", grad_acc=1):
+    return Config.from_dict({
+        "mesh_dim": list(mesh_dim),
+        "mesh_name": list(mesh_name),
+        "training": {
+            "batch_size": 8,
+            "gradient_accumulation_steps": grad_acc,
+            "schedule": schedule,
+            "grad_clip_norm": None,
+        },
+    })
+
+
+def _reference_update(params, batch, opt, cfg=CFG, steps=2):
+    ids, labels = batch
+
+    def loss_fn(p):
+        return clm_loss(gpt2_apply(p, ids, cfg), labels)
+
+    state = opt.init(params)
+    losses = []
+    for _ in range(steps):
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        upd, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, upd)
+        losses.append(float(loss))
+    return losses, params
+
+
+def _run_strategy(name, cfg, model_cfg, params, batch, steps=2):
+    strat = get_strategy(name, cfg)
+    model = gpt2_model_spec(model_cfg)
+    opt = optax.sgd(0.05)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch(batch, model)
+    step = strat.make_train_step(model, opt)
+    losses = []
+    for _ in range(steps):
+        p, s, loss = step(p, s, b)
+        losses.append(float(loss))
+    return losses, p
+
+
+@pytest.mark.parametrize(
+    "name,mesh_dim,mesh_name,schedule,grad_acc",
+    [
+        ("tp", [2], ["tp"], "afab", 1),
+        ("dp_tp", [2, 2], ["dp", "tp"], "afab", 1),
+        ("3d", [2, 2, 2], ["dp", "tp", "pp"], "afab", 2),
+        ("3d", [2, 2, 2], ["dp", "tp", "pp"], "1f1b", 2),
+        # tp x sp x pp: vp loss composed with the sequence-sharded CE
+        ("auto", [2, 2, 2], ["tp", "sp", "pp"], "1f1b", 2),
+    ],
+)
+def test_vp_matches_single_device(name, mesh_dim, mesh_name, schedule,
+                                  grad_acc):
+    cfg = _config(mesh_dim, mesh_name, schedule, grad_acc)
+    params = gpt2_init(jax.random.key(0), CFG)
+    batch = _data()
+    opt = optax.sgd(0.05)
+
+    ref_losses, p_ref = _reference_update(params, batch, opt)
+    losses, p2 = _run_strategy(name, cfg, VP_CFG, params, batch)
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+
+    p_ref_layout = gpt2_to_tp_layout(p_ref, CFG, cfg.tp_size)
+    ref = dict(jax.tree_util.tree_leaves_with_path(p_ref_layout))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(p2):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(leaf)), np.asarray(ref[path]),
+            rtol=2e-4, atol=1e-5, err_msg=f"{name}:{jax.tree_util.keystr(path)}")
+
+
+def test_vp_padded_vocab_masks_pad_columns():
+    """padded_vocab_size: loss identical to the unpadded model and the
+    padded wte rows receive exactly zero gradient."""
+    real_v = 123  # not divisible by tp=2
+    base = GPT2Config.tiny(vocab_size=real_v)
+    padded = dataclasses.replace(base, vocab_parallel=True,
+                                 padded_vocab_size=128)
+
+    params = gpt2_init(jax.random.key(0), base)
+    k1, k2 = jax.random.split(jax.random.key(7))
+    ids = jax.random.randint(k1, (8, 16), 0, real_v)
+    labels = jnp.where(jax.random.uniform(k2, (8, 16)) < 0.1, -100, ids)
+    opt = optax.sgd(0.05)
+
+    ref_losses, p_ref = _reference_update(params, (ids, labels), opt,
+                                          cfg=base)
+
+    # pad wte rows with garbage (not zeros) to prove masking works
+    pad = jnp.full((128 - real_v, base.n_embd), 3.7, jnp.float32)
+    p_padded = jax.tree.map(jnp.copy, params)
+    p_padded["embedding"]["wte"] = jnp.concatenate(
+        [p_padded["embedding"]["wte"], pad], axis=0)
+
+    cfg = _config([2], ["tp"])
+    losses, p2 = _run_strategy("tp", cfg, padded, p_padded, (ids, labels))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5)
+    wte2 = np.asarray(jax.device_get(p2["embedding"]["wte"]))
+    # padded rows: zero grad -> unchanged under sgd
+    np.testing.assert_array_equal(wte2[real_v:], np.asarray(pad))
+    np.testing.assert_allclose(
+        wte2[:real_v], np.asarray(p_ref["embedding"]["wte"]),
+        rtol=2e-4, atol=1e-5)
+
+
+def test_padded_vocab_masked_without_tp():
+    """A vocab_parallel+padded config run with NO tp axis (single-device
+    fallback, generation) must still mask the padded columns: loss equals
+    the unpadded model and argmax can never pick an id >= vocab_size."""
+    from quintnet_tpu.models.gpt2 import gpt2_apply
+
+    real_v = 123
+    base = GPT2Config.tiny(vocab_size=real_v)
+    padded = dataclasses.replace(base, vocab_parallel=True,
+                                 padded_vocab_size=128)
+    params = gpt2_init(jax.random.key(0), base)
+    p_padded = jax.tree.map(jnp.copy, params)
+    p_padded["embedding"]["wte"] = jnp.concatenate(
+        [p_padded["embedding"]["wte"],
+         jnp.full((128 - real_v, base.n_embd), 9.9, jnp.float32)], axis=0)
+
+    ids = jax.random.randint(jax.random.key(5), (2, 12), 0, real_v)
+    logits_base = gpt2_apply(params, ids, base)
+    logits_pad = gpt2_apply(p_padded, ids, padded)
+    # real columns identical; padded columns -inf -> never argmax'd,
+    # zero softmax mass
+    np.testing.assert_allclose(np.asarray(logits_pad[..., :real_v]),
+                               np.asarray(logits_base), rtol=1e-6)
+    assert np.all(np.asarray(jnp.argmax(logits_pad, -1)) < real_v)
+    np.testing.assert_allclose(
+        float(clm_loss(logits_pad, ids)), float(clm_loss(logits_base, ids)),
+        rtol=1e-6)
+
+
+def test_vp_requires_divisible_vocab():
+    bad = dataclasses.replace(GPT2Config.tiny(vocab_size=123),
+                              vocab_parallel=True)
+    with pytest.raises(ValueError, match="vocab_parallel"):
+        gpt2_to_tp_layout(gpt2_init(jax.random.key(0), bad), bad, tp=2)
