@@ -1,19 +1,27 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention kernels: forward AND hand-tiled backward.
 
 Tiles Q/K/V through VMEM with online-softmax accumulators in scratch so
 the [S, S] score matrix never reaches HBM (the reference relies on
 cuDNN's fused SDPA — gpt2_attention.py:156-161; this is the TPU-native
 equivalent, written against jax.experimental.pallas).
 
-Grid: (batch*heads, q_blocks, k_blocks), k innermost — scratch
-accumulators persist across the k dimension and the output block is
-finalised at the last k step. Causal masking is applied in-kernel;
-k-blocks entirely above the diagonal still run (masked) in this v1 —
-grid pruning is a follow-up.
+Forward grid: (batch*heads, q_blocks, k_blocks), k innermost — scratch
+accumulators persist across the k dimension; the output block and the
+row logsumexp (saved for backward, FlashAttention-2 style) are finalised
+at the last k step.
 
-Backward: custom_vjp recomputing through the exact jnp blockwise
-implementation (ops/flash_attention.py) — activation-checkpoint style,
-O(S) memory; a hand-tiled bwd kernel is a follow-up optimisation.
+Backward: two kernels (TPU Pallas has no cross-grid-cell atomics, so
+dK/dV and dQ accumulate over different grid orders):
+- dK/dV: grid (bh, k_blocks, q_blocks), q innermost, dk/dv in scratch;
+- dQ:    grid (bh, q_blocks, k_blocks), k innermost, dq in scratch;
+with the standard recurrence p = exp(s - lse), dv += p^T dO,
+ds = p * (dO v^T - delta), dk += ds^T q, dq += ds k, where
+delta = rowsum(dO * O) is precomputed outside the kernel.
+
+Causal grid pruning: fully-masked blocks (k block strictly above the
+diagonal) skip ALL their matmuls via pl.when in forward and both
+backward kernels — ~2x less MXU work at long S. (The block DMA still
+runs — rectangular grids — but long-sequence attention is FLOPs-bound.)
 """
 
 from __future__ import annotations
@@ -35,8 +43,15 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30  # avoid literal -inf inside the kernel (exp/max safety)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale: float, causal: bool, block_q: int, block_k: int):
+def _block_live(qi, ki, block_q: int, block_k: int):
+    """True when the (qi, ki) tile intersects the causal lower triangle:
+    its smallest column index <= its largest row index."""
+    return ki * block_k <= qi * block_q + block_q - 1
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -46,40 +61,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)          # [bq, d]
-    k = k_ref[0].astype(jnp.float32)          # [bk, d]
-    v = v_ref[0].astype(jnp.float32)          # [bk, d]
+    live = _block_live(qi, ki, block_q, block_k) if causal else ki >= 0
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)          # [bk, d]
 
-    if causal:
-        qi = pl.program_id(1)
-        rows = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        cols = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(cols <= rows, s, NEG_INF)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
 
-    m_prev = m_scr[:, :1]                      # [bq, 1]
-    l_prev = l_scr[:, :1]                      # [bq, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)                     # NEG_INF rows -> exp(~-1e30)=0
-    l_cur = jnp.sum(p, axis=1, keepdims=True)
-    corr = jnp.exp(m_prev - m_new)
-    l_new = l_prev * corr + l_cur
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        l_prev = l_scr[:, :1]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # NEG_INF -> 0
+        l_cur = jnp.sum(p, axis=1, keepdims=True)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + l_cur
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
-                    ).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # lse carried as [bq, 1] (trailing unit dim keeps the block
+        # legal for Mosaic: last dims must be (8k, 128k) or array-equal)
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -97,7 +118,7 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk)
     grid = (b * h, s // bq, s // bk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -105,8 +126,14 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -114,36 +141,195 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         ] if _HAVE_PLTPU else None,
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse.reshape(b, h, s, 1)
+
+
+def _bwd_block(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
+               block_q, block_k):
+    """Shared per-tile backward math -> (p, ds), both [bq, bk] f32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+    p = jnp.exp(s - lse)                          # [bq, bk]; masked -> 0
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [bq, bk]
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale: float, causal: bool, block_q: int, block_k: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = _block_live(qi, ki, block_q, block_k) if causal else qi >= 0
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _bwd_block(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
+                           scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # p^T dO  [bk, d]
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # ds^T q  [bk, d]
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *,
+                   scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = _block_live(qi, ki, block_q, block_k) if causal else ki >= 0
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _bwd_block(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
+                           scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # ds k  [bq, d]
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal: bool, block_q: int,
+               block_k: int, interpret: bool):
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)       # [b, h, s, 1]
+
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+    dor = do.reshape(b * h, s, d)
+    lser = lse.reshape(b * h, s, 1)
+    dr = delta.reshape(b * h, s, 1)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, a, b_: (bh, a, 0))
+    row_spec = pl.BlockSpec((1, bq, 1), lambda bh, a, b_: (bh, a, 0))
+
+    # dK/dV: k blocks on grid dim 1, q innermost (dim 2)
+    kv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+    dk, dv = pl.pallas_call(
+        kv_kernel,
+        grid=(b * h, s // bk, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ] if _HAVE_PLTPU else None,
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, dr)
+
+    # dQ: q blocks on grid dim 1, k innermost (dim 2)
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, s // bq, s // bk),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            q_spec,
+            row_spec,
+            row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+        ] if _HAVE_PLTPU else None,
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, dr)
+
+    rs = lambda x: x.reshape(b, h, s, d)
+    return rs(dq), rs(dk), rs(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def pallas_flash_attention(q, k, v, causal: bool = False,
                            block_q: int = 128, block_k: int = 128,
                            interpret: bool = False):
-    """[B, H, S, D] fused attention via the Pallas TPU kernel.
+    """[B, H, S, D] fused attention via the Pallas TPU kernels (fwd and
+    hand-tiled bwd).
 
-    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
+    ``interpret=True`` runs the kernels in the Pallas interpreter (CPU
     testing). S must divide by the block sizes (the dispatcher in
     ops/flash_attention.py falls back to jnp otherwise).
     """
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    from quintnet_tpu.ops.flash_attention import blockwise_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, block_q=block_q, block_k=block_k),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
+                      interpret)
 
 
 pallas_flash_attention.defvjp(_fa_fwd, _fa_bwd)

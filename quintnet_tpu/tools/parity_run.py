@@ -1,0 +1,210 @@
+"""Convergence-parity runs: sharded == unsharded over FULL trainer runs.
+
+BASELINE.md's acceptance criterion is matching the reference's published
+curves within 1% (ViT-MNIST 93.24% val acc, GPT-2 val PPL 27.21 —
+/root/reference/README.md:199-238). Those numbers need the real MNIST /
+CNN-DailyMail files, which this zero-egress environment does not have;
+what CAN be demonstrated end-to-end here — and is the part no
+single-step golden test covers — is that the full trainer+data+schedule
+loop converges IDENTICALLY sharded and unsharded over many epochs:
+
+  python -m quintnet_tpu.tools.parity_run --task vit  --mode single
+  python -m quintnet_tpu.tools.parity_run --task vit  --mode 3d
+  python -m quintnet_tpu.tools.parity_run --task gpt2 --mode single
+  python -m quintnet_tpu.tools.parity_run --task gpt2 --mode 3d
+  python -m quintnet_tpu.tools.parity_run --report   # -> PARITY.md
+
+Each run writes artifacts/parity/{task}_{mode}.json (per-epoch losses +
+metrics). --report merges them into PARITY.md with the per-epoch deltas.
+Runs use the same init seed, the same global batch order, and a 2x2x2
+dp x tp x pp mesh (1F1B) for '3d' — the reference's headline topology.
+With real data dropped in (data/ mnist.npz, --csv for gpt2), the same
+commands reproduce the reference's task for direct curve comparison.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+ART_DIR = "artifacts/parity"
+
+VIT_EPOCHS = 10
+GPT2_EPOCHS = 3
+
+
+def _setup(mode: str):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def run_vit(mode: str) -> dict:
+    _setup(mode)
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.data import ArrayDataset, make_batches
+    from quintnet_tpu.data.datasets import synthetic_mnist
+    from quintnet_tpu.models.vit import ViTConfig, vit_model_spec
+    from quintnet_tpu.parallel.strategy import get_strategy
+    from quintnet_tpu.train.trainer import Trainer
+
+    mesh = ([2, 2, 2], ["dp", "tp", "pp"]) if mode == "3d" else ([1], ["dp"])
+    cfg = Config.from_dict({
+        "mesh_dim": mesh[0], "mesh_name": mesh[1],
+        "training": {
+            "batch_size": 64,  # reference effective batch (README:218-222)
+            "gradient_accumulation_steps": 2,
+            "schedule": "1f1b",
+            "optimizer": "adam",
+            "learning_rate": 1e-3,
+            "grad_clip_norm": None,
+            "epochs": VIT_EPOCHS,
+            "log_every": 0,
+        },
+    })
+    # reference ViT widths (hidden 64, depth 8, heads 4)
+    vcfg = ViTConfig(hidden_dim=64, depth=8, num_heads=4)
+    model = vit_model_spec(vcfg)
+    strategy = get_strategy("3d" if mode == "3d" else "single", cfg)
+
+    xtr, ytr = synthetic_mnist(8192, seed=0)
+    xte, yte = synthetic_mnist(1024, seed=1)
+    train, test = ArrayDataset(xtr, ytr), ArrayDataset(xte, yte)
+
+    trainer = Trainer(cfg, model, strategy=strategy,
+                      task_type="classification")
+    hist = trainer.fit(
+        lambda ep: make_batches(train, 64, seed=ep),
+        val_batches_fn=lambda ep: make_batches(test, 64, shuffle=False),
+    )
+    return {
+        "task": "vit", "mode": mode, "mesh": dict(strategy.mesh.shape),
+        "epochs": VIT_EPOCHS,
+        "train_loss": hist.train_loss,
+        "val_loss": hist.val_loss,
+        "val_accuracy": hist.val_metric,
+        "wall_time_s": round(hist.wall_time_s, 1),
+    }
+
+
+def run_gpt2(mode: str) -> dict:
+    _setup(mode)
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.data import ByteTokenizer, SummarizationDataset
+    from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
+    from quintnet_tpu.parallel.strategy import get_strategy
+    from quintnet_tpu.train.trainer import Trainer
+
+    mesh = ([2, 2, 2], ["dp", "tp", "pp"]) if mode == "3d" else ([1], ["dp"])
+    cfg = Config.from_dict({
+        "mesh_dim": mesh[0], "mesh_name": mesh[1],
+        "training": {
+            "batch_size": 16,
+            "gradient_accumulation_steps": 4,  # reference grad_acc shape
+            "schedule": "1f1b",
+            "optimizer": "adamw",
+            "learning_rate": 5e-4,
+            "weight_decay": 0.01,
+            "grad_clip_norm": 1.0,
+            "epochs": GPT2_EPOCHS,
+            "log_every": 0,
+        },
+    })
+    tok = ByteTokenizer()
+    v = -(-max(tok.vocab_size, 128) // 8) * 8
+    gcfg = GPT2Config.tiny(vocab_size=v, n_positions=128, n_embd=64,
+                           n_layer=4, n_head=4)
+    model = gpt2_model_spec(gcfg)
+    strategy = get_strategy("3d" if mode == "3d" else "single", cfg)
+
+    train = SummarizationDataset.synthetic(1024, tok, max_length=128)
+    val = SummarizationDataset.synthetic(256, tok, max_length=128, seed=1)
+
+    trainer = Trainer(cfg, model, strategy=strategy, task_type="clm")
+    hist = trainer.fit(
+        lambda ep: train.batches(16, seed=ep),
+        val_batches_fn=lambda ep: val.batches(16, shuffle=False),
+    )
+    return {
+        "task": "gpt2", "mode": mode, "mesh": dict(strategy.mesh.shape),
+        "epochs": GPT2_EPOCHS,
+        "train_loss": hist.train_loss,
+        "val_loss": hist.val_loss,
+        "val_perplexity": hist.val_metric,
+        "wall_time_s": round(hist.wall_time_s, 1),
+    }
+
+
+def report() -> str:
+    def load(task, mode):
+        path = os.path.join(ART_DIR, f"{task}_{mode}.json")
+        with open(path) as f:
+            return json.load(f)
+
+    lines = [
+        "# PARITY — sharded vs single-device convergence",
+        "",
+        "Full multi-epoch Trainer runs (same seed, same batch order) on a",
+        "2x2x2 dp x tp x pp mesh (1F1B — the reference's headline",
+        "topology, README.md:199-238) vs single device. The acceptance",
+        "bar from BASELINE.md is curve identity within 1%; the runs",
+        "below use the synthetic datasets (this environment has no",
+        "network egress and no MNIST/CNN-DailyMail files — drop",
+        "`data/mnist.npz` / `--csv` in and the same commands reproduce",
+        "the reference's real-data task). Produced by",
+        "`python -m quintnet_tpu.tools.parity_run`; raw JSON under",
+        "`artifacts/parity/`.",
+        "",
+    ]
+    for task, metric_key, metric_name in (
+            ("vit", "val_accuracy", "val acc"),
+            ("gpt2", "val_perplexity", "val ppl")):
+        s = load(task, "single")
+        d = load(task, "3d")
+        lines += [f"## {task.upper()} ({s['epochs']} epochs)", "",
+                  f"| epoch | train loss (1 dev) | train loss (3D) | "
+                  f"rel diff | {metric_name} (1 dev) | {metric_name} (3D) |",
+                  "|---|---|---|---|---|---|"]
+        max_rel = 0.0
+        for e in range(s["epochs"]):
+            a, b = s["train_loss"][e], d["train_loss"][e]
+            rel = abs(a - b) / max(abs(a), 1e-9)
+            max_rel = max(max_rel, rel)
+            ma, mb = s[metric_key][e], d[metric_key][e]
+            lines.append(f"| {e} | {a:.4f} | {b:.4f} | {rel:.2%} | "
+                         f"{ma:.4f} | {mb:.4f} |")
+        verdict = "PASS" if max_rel < 0.01 else "FAIL"
+        lines += ["", f"Max relative train-loss difference: "
+                  f"**{max_rel:.3%}** (bar: 1%) -> **{verdict}**", ""]
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--task", choices=["vit", "gpt2"])
+    ap.add_argument("--mode", choices=["single", "3d"])
+    ap.add_argument("--report", action="store_true")
+    args = ap.parse_args()
+
+    if args.report:
+        md = report()
+        with open("PARITY.md", "w") as f:
+            f.write(md)
+        print(md)
+        return
+
+    os.makedirs(ART_DIR, exist_ok=True)
+    res = run_vit(args.mode) if args.task == "vit" else run_gpt2(args.mode)
+    out = os.path.join(ART_DIR, f"{args.task}_{args.mode}.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
