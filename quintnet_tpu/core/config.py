@@ -114,7 +114,11 @@ class TrainingConfig:
     # 1f1b (vjp-recompute backward) | 1f1b_stored (store activations,
     # the reference's semantics) | afab (reference: schedule.py:39-516)
     schedule: str = "1f1b"
-    sp_mode: str = "ring"  # ring | ulysses (sequence-parallel attention)
+    # sequence-parallel attention algorithm: ring | zigzag | ulysses.
+    # zigzag = load-balanced causal ring (~2x less compute at high sp,
+    # ops/ring_attention.py:zigzag_ring_attention); falls back to plain
+    # ring for non-causal attention automatically.
+    sp_mode: str = "ring"
     dtype: str = "float32"
     param_dtype: str = "float32"
     remat: bool = False

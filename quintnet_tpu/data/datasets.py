@@ -81,16 +81,33 @@ def _norm(x: np.ndarray) -> np.ndarray:
     return x.reshape(x.shape[0], 28, 28, 1)
 
 
-def synthetic_mnist(n: int, *, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
-    """Learnable stand-in: each class is a fixed random 28x28 prototype
-    plus noise. A model that learns real MNIST structure will also drive
-    this loss down, so trainer/convergence plumbing stays testable."""
+def synthetic_mnist(n: int, *, seed: int = 0,
+                    signal: Tuple[float, float] = (0.06, 0.55),
+                    noise: float = 1.0) -> Tuple[np.ndarray, np.ndarray]:
+    """Learnable-but-hard stand-in: each class is a fixed random 28x28
+    prototype scaled by a PER-SAMPLE amplitude drawn from
+    ``U[signal[0], signal[1]]``, plus unit Gaussian noise.
+
+    The variable amplitude mimics real MNIST's easy-majority/hard-tail
+    structure: high-amplitude samples are learned in the first epoch,
+    the low-amplitude tail only as the model refines its estimate of the
+    prototype directions — so a 10-epoch run traces a real learning
+    curve (~57% epoch 1 -> ~90% epoch 10 for the reference ViT widths)
+    rather than saturating at 1.0 in epoch 0, and the Bayes-optimal
+    ceiling (nearest-prototype rule, measured over 40k samples) sits at
+    ~96%, near the reference's real-MNIST 93.24% val acc
+    (/root/reference/README.md:214). The previous constant-amplitude
+    design (signal 1.0, noise 0.8) was linearly separable in practice
+    and its parity artifacts showed sharding identity but no learning
+    trajectory."""
     protos = np.random.default_rng(42).normal(
         size=(10, 28, 28, 1)).astype(np.float32)  # shared across splits
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, 10, size=n).astype(np.int32)
-    noise = rng.normal(scale=0.8, size=(n, 28, 28, 1)).astype(np.float32)
-    return protos[labels] + noise, labels
+    amp = rng.uniform(signal[0], signal[1],
+                      size=(n, 1, 1, 1)).astype(np.float32)
+    eps = rng.normal(scale=noise, size=(n, 28, 28, 1)).astype(np.float32)
+    return protos[labels] * amp + eps, labels
 
 
 @dataclass

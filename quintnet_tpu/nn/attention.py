@@ -80,7 +80,8 @@ def mha_apply(
     With ``sp_axis`` the sequence dim is sharded and the inner attention
     runs sequence-parallel — long-context support the reference does not
     have. ``sp_mode`` picks the algorithm: 'ring' (K/V rotation via
-    ppermute, ops/ring_attention.py) or 'ulysses' (head-scatter
+    ppermute, ops/ring_attention.py), 'zigzag' (load-balanced causal
+    ring — ~2x less compute at high sp) or 'ulysses' (head-scatter
     all-to-all, ops/ulysses_attention.py; composes with flash).
 
     ``return_kv=True`` additionally returns the per-head (k, v)
@@ -88,31 +89,18 @@ def mha_apply(
     (models/gpt2_generate.py).
 
     Dropout (training only — pass ``key``): ``attn_pdrop`` on the
-    attention probabilities (plain sdpa path only; the flash/ring/
-    ulysses kernels skip it — the reference has neither sp nor flash),
-    ``resid_pdrop`` after the output projection, applied post-psum so
-    the mask agrees across tp ranks (reference gpt2_attention.py:156-180).
+    attention probabilities — supported on EVERY path (plain sdpa, the
+    flash blockwise fallback, ring, ulysses; the reference gets the
+    same coverage from sdpa's dropout_p, gpt2_attention.py:156-161) —
+    and ``resid_pdrop`` after the output projection, applied post-psum
+    so the mask agrees across tp ranks (gpt2_attention.py:156-180).
     Under tp the SAME prob-dropout mask pattern is reused on each rank's
     head block — head-group correlation, accepted for mask/key locality.
     """
     k_attn = k_resid = None
     if key is not None:
         k_attn, k_resid = jax.random.split(key)
-
-    if attn_pdrop > 0.0 and key is not None and (sp_axis is not None
-                                                 or use_flash):
-        # The flash/ring/ulysses kernels have no prob-dropout hook; a
-        # config asking for both would otherwise silently train with
-        # less regularization than requested. Trace-time warning so the
-        # mismatch is visible (fires once per compile, not per step).
-        import warnings
-
-        warnings.warn(
-            "attn_pdrop > 0 is ignored on the flash/ring/ulysses "
-            "attention path; only resid_pdrop is applied. Set "
-            "attn_pdrop=0 or use the plain sdpa path.",
-            stacklevel=2,
-        )
+    drop_kw = dict(pdrop=attn_pdrop, key=k_attn)
 
     qkv = linear_apply(p["qkv"], x)  # [B, S, 3*D_local]
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -124,18 +112,24 @@ def mha_apply(
         from quintnet_tpu.ops.ulysses_attention import ulysses_attention
 
         o = ulysses_attention(q, k, v, axis=sp_axis, causal=causal,
-                              use_flash=use_flash)
+                              use_flash=use_flash, **drop_kw)
+    elif sp_axis is not None and sp_mode == "zigzag":
+        from quintnet_tpu.ops.ring_attention import zigzag_ring_attention
+
+        o = zigzag_ring_attention(q, k, v, axis=sp_axis, causal=causal,
+                                  **drop_kw)
     elif sp_axis is not None:
         if sp_mode != "ring":
             raise ValueError(
-                f"unknown sp_mode {sp_mode!r}; expected 'ring' or 'ulysses'")
+                f"unknown sp_mode {sp_mode!r}; expected 'ring', 'zigzag' "
+                "or 'ulysses'")
         from quintnet_tpu.ops.ring_attention import ring_attention
 
-        o = ring_attention(q, k, v, axis=sp_axis, causal=causal)
+        o = ring_attention(q, k, v, axis=sp_axis, causal=causal, **drop_kw)
     elif use_flash:
         from quintnet_tpu.ops.flash_attention import flash_attention
 
-        o = flash_attention(q, k, v, causal=causal)
+        o = flash_attention(q, k, v, causal=causal, **drop_kw)
     else:
         o = sdpa(q, k, v, causal=causal, pdrop=attn_pdrop, key=k_attn)
 

@@ -21,11 +21,19 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _one_query_block(q_blk, qi, k_blocks, v_blocks, kv_valid, *,
-                     causal: bool, block_q: int, block_k: int, scale: float):
+def _one_query_block(q_blk, qi, key_qb, k_blocks, v_blocks, kv_valid, *,
+                     causal: bool, block_q: int, block_k: int, scale: float,
+                     pdrop: float):
     """Online-softmax over all KV blocks for one query block.
 
-    q_blk: [bq, d]; k_blocks/v_blocks: [nk, bk, d]; kv_valid: [nk, bk].
+    q_blk: [bq, d]; k_blocks/v_blocks: [nk, bk, d]; kv_valid: [nk, bk];
+    key_qb: per-(batch, head, q-block) PRNG key (or None) for
+    attention-probability dropout.
+
+    Dropout semantics match sdpa's drop-after-softmax: the normaliser
+    ``l`` accumulates the UNdropped probs while the numerator ``acc``
+    accumulates dropped ones — exp(s)·mask/keep divided by Σ exp(s)
+    equals dropout(softmax(s)) since the 1/keep scaling commutes.
     """
     d = q_blk.shape[-1]
     nk = k_blocks.shape[0]
@@ -46,8 +54,13 @@ def _one_query_block(q_blk, qi, k_blocks, v_blocks, kv_valid, *,
         p = jnp.where(mask, jnp.exp(scores - m_safe[:, None]), 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + jnp.sum(p, -1)
+        p_num = p
+        if key_qb is not None and pdrop > 0.0:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(key_qb, ki), 1.0 - pdrop, p.shape)
+            p_num = jnp.where(keep, p / (1.0 - pdrop), 0.0)
         acc_new = acc * corr[:, None] + jnp.einsum(
-            "qk,kd->qd", p, v_blk.astype(jnp.float32))
+            "qk,kd->qd", p_num, v_blk.astype(jnp.float32))
         return (m_safe, l_new, acc_new), None
 
     init = (
@@ -61,9 +74,14 @@ def _one_query_block(q_blk, qi, k_blocks, v_blocks, kv_valid, *,
 
 
 def blockwise_attention(q, k, v, *, causal: bool,
-                        block_q: int = 128, block_k: int = 128):
+                        block_q: int = 128, block_k: int = 128,
+                        pdrop: float = 0.0, key=None):
     """Exact blockwise attention [B,H,S,D] -> [B,H,S,D] (jnp reference for
-    the Pallas kernel; also the long-context-safe fallback)."""
+    the Pallas kernel; also the long-context-safe fallback).
+
+    ``pdrop``/``key``: attention-probability dropout (training only) —
+    the reference gets this from sdpa's dropout_p in every config
+    (gpt2_attention.py:156-161); here the fused paths support it too."""
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, s)
@@ -77,15 +95,22 @@ def blockwise_attention(q, k, v, *, causal: bool,
     vb = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))).reshape(b, h, nk, block_k, d)
     kv_valid = (jnp.arange(nk * block_k) < s).reshape(nk, block_k)
 
-    def one(q_blk, qi, k_all, v_all):
-        return _one_query_block(q_blk, qi, k_all, v_all, kv_valid,
-                                causal=causal, block_q=block_q,
-                                block_k=block_k, scale=scale)
+    use_drop = key is not None and pdrop > 0.0
+    # one key per (batch, head, q-block) cell; the k-block index is
+    # folded inside the scan so every (q, k) pair draws an iid mask
+    keys = (jax.random.split(key, (b, h, nq)) if use_drop else
+            jnp.zeros((b, h, nq), jnp.uint32))  # dummy, vmap shape only
 
-    f = jax.vmap(one, in_axes=(0, 0, None, None))   # q blocks
-    f = jax.vmap(f, in_axes=(0, None, 0, 0))        # heads
-    f = jax.vmap(f, in_axes=(0, None, 0, 0))        # batch
-    out = f(qb, jnp.arange(nq), kb, vb)             # [B,H,nq,bq,d]
+    def one(q_blk, qi, kq, k_all, v_all):
+        return _one_query_block(q_blk, qi, kq if use_drop else None,
+                                k_all, v_all, kv_valid,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k, scale=scale, pdrop=pdrop)
+
+    f = jax.vmap(one, in_axes=(0, 0, 0, None, None))   # q blocks
+    f = jax.vmap(f, in_axes=(0, None, 0, 0, 0))        # heads
+    f = jax.vmap(f, in_axes=(0, None, 0, 0, 0))        # batch
+    out = f(qb, jnp.arange(nq), keys, kb, vb)          # [B,H,nq,bq,d]
     return out.reshape(b, h, nq * block_q, d)[:, :, :s].astype(q.dtype)
 
 
@@ -96,14 +121,22 @@ PALLAS_MIN_SEQ = 4096  # crossover measured on v5e-lite: XLA's fused sdpa
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 128, block_k: int = 128,
-                    min_seq_for_pallas: int = PALLAS_MIN_SEQ):
+                    min_seq_for_pallas: int = PALLAS_MIN_SEQ,
+                    pdrop: float = 0.0, key=None):
     """[B, H, S, Dh] fused attention. Pallas TPU kernel when on a TPU
     backend, the sequence divides the block size, and S is past the
-    measured crossover; exact blockwise jnp otherwise."""
+    measured crossover; exact blockwise jnp otherwise.
+
+    ``pdrop``/``key``: attention-prob dropout. The hand-tiled Pallas
+    kernel carries no PRNG, so a dropout-enabled call routes to the
+    blockwise jnp path (still O(S) live memory under scan) — correctness
+    of the requested regularisation wins over kernel speed; benches and
+    inference never pass a key so they keep the fast path."""
     s = q.shape[-2]
     bq, bk = min(block_q, s), min(block_k, s)
+    use_drop = key is not None and pdrop > 0.0
     if (jax.default_backend() == "tpu" and s % bq == 0 and s % bk == 0
-            and s >= min_seq_for_pallas):
+            and s >= min_seq_for_pallas and not use_drop):
         try:
             from quintnet_tpu.ops.pallas_attention import pallas_flash_attention
 
@@ -111,4 +144,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
         except ImportError:
             pass
     return blockwise_attention(q, k, v, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               pdrop=pdrop, key=key)

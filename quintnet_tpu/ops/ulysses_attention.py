@@ -24,6 +24,7 @@ all-to-all), so this file contains no custom VJP.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -32,13 +33,18 @@ from quintnet_tpu.nn import attention as _attn
 
 
 def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
-                      use_flash: bool = False):
+                      use_flash: bool = False,
+                      pdrop: float = 0.0, key=None):
     """Attention over sequence-sharded inputs via two all-to-alls.
 
     q/k/v: [B, H_local, S_local, Dh] with the sequence dim sharded over
     mesh axis ``axis``. Requires H_local divisible by the axis size.
     Returns [B, H_local, S_local, Dh], numerically equal to full-sequence
     attention on the gathered sequence (tests/test_sp.py golden checks).
+
+    ``pdrop``/``key``: attention-prob dropout on the inner (full-
+    sequence, local-head-subset) attention; each rank folds its axis
+    index since it owns a disjoint head subset after the scatter.
     """
     sp = lax.axis_size(axis)
     h_local = q.shape[1]
@@ -56,12 +62,18 @@ def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
     qkv = cc.all_to_all(qkv, axis, split_dim=2, concat_dim=3)
     qf, kf, vf = qkv[0], qkv[1], qkv[2]
 
+    k_local = None
+    if key is not None and pdrop > 0.0:
+        k_local = jax.random.fold_in(key, lax.axis_index(axis))
+
     if use_flash:
         from quintnet_tpu.ops.flash_attention import flash_attention
 
-        of = flash_attention(qf, kf, vf, causal=causal)
+        of = flash_attention(qf, kf, vf, causal=causal,
+                             pdrop=pdrop, key=k_local)
     else:
-        of = _attn.sdpa(qf, kf, vf, causal=causal)
+        of = _attn.sdpa(qf, kf, vf, causal=causal,
+                        pdrop=pdrop, key=k_local)
 
     # gather heads back, re-scatter sequence: [B, H_local, S_local, Dh]
     return cc.all_to_all(of, axis, split_dim=2, concat_dim=1)

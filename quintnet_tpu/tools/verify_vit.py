@@ -1,0 +1,110 @@
+"""Single-device reload verifier for distributed ViT training.
+
+Reference: examples/verify_model.py:23-60 — reload the trained
+checkpoint with NO distributed code, strip the wrapper prefixes, and
+re-compute accuracy on one device as ground truth; parity with the
+distributed run's reported val accuracy is the acceptance criterion.
+(GPT-2 has tools/verify_gpt2.py; this is the classification analogue.)
+
+  python -m quintnet_tpu.tools.verify_vit --checkpoint-dir ckpt \
+      [--tp 2] [--expected-accuracy 0.93] [--data-dir data]
+
+Restores the latest orbax step as plain host arrays (no Strategy, no
+mesh, no shard_map anywhere in this module), un-permutes the tp-blocked
+fused-QKV layout when the checkpoint came from a tp>1 run (--tp; see
+parallel/tp.py layout convention), and evaluates accuracy over the test
+split with a plain ``vit_apply``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def verify_vit(checkpoint_dir: str, cfg, *, tp: int = 1,
+               data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+               data_dir: Optional[str] = None,
+               batch_size: int = 256) -> dict:
+    """Reload latest checkpoint -> single-device accuracy/loss dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from quintnet_tpu.models.vit import (accuracy, cross_entropy_loss,
+                                         vit_apply)
+    from quintnet_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(checkpoint_dir)
+    state = mgr.restore()  # host numpy, no mesh involved
+    params = state["params"]
+
+    if tp > 1:
+        # invert the tp-blocked fused-QKV column permutation the sharded
+        # run trains in (parallel/tp.py:111-137) back to standard [q|k|v]
+        from quintnet_tpu.parallel.tp import qkv_standard_from_blocked
+
+        qkv = params["blocks"]["attn"]["qkv"]
+        qkv["w"] = qkv_standard_from_blocked(qkv["w"], cfg.num_heads, tp)
+        if "b" in qkv:
+            qkv["b"] = qkv_standard_from_blocked(qkv["b"], cfg.num_heads, tp)
+
+    if data is None:
+        from quintnet_tpu.data.datasets import load_mnist
+
+        data = load_mnist(data_dir, split="test")
+    x, y = data
+
+    apply_fn = jax.jit(lambda p, xb: vit_apply(p, xb, cfg))
+    losses, accs, n = [], [], 0
+    for i in range(0, len(x) - (len(x) % batch_size) or len(x), batch_size):
+        xb = jnp.asarray(x[i:i + batch_size])
+        yb = jnp.asarray(y[i:i + batch_size])
+        logits = apply_fn(params, xb)
+        losses.append(float(cross_entropy_loss(logits, yb)) * len(xb))
+        accs.append(float(accuracy(logits, yb)) * len(xb))
+        n += len(xb)
+    return {
+        "epoch": int(state.get("epoch", -1)),
+        "loss": sum(losses) / max(n, 1),
+        "accuracy": sum(accs) / max(n, 1),
+        "n_examples": n,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tp size of the run that wrote the checkpoint "
+                         "(un-permutes the blocked QKV layout)")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--hidden-dim", type=int, default=64)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--num-heads", type=int, default=4)
+    ap.add_argument("--patch-size", type=int, default=7)
+    ap.add_argument("--expected-accuracy", type=float, default=None,
+                    help="val accuracy the distributed trainer reported; "
+                         "exit 1 if the reloaded model misses it by >1%")
+    args = ap.parse_args()
+
+    from quintnet_tpu.models.vit import ViTConfig
+
+    cfg = ViTConfig(hidden_dim=args.hidden_dim, depth=args.depth,
+                    num_heads=args.num_heads, patch_size=args.patch_size)
+    res = verify_vit(args.checkpoint_dir, cfg, tp=args.tp,
+                     data_dir=args.data_dir)
+    print(f"reloaded epoch {res['epoch']}: "
+          f"loss {res['loss']:.4f} accuracy {res['accuracy']:.4f} "
+          f"({res['n_examples']} examples)")
+    if args.expected_accuracy is not None:
+        diff = abs(res["accuracy"] - args.expected_accuracy)
+        ok = diff <= 0.01
+        print(f"distributed-run accuracy {args.expected_accuracy:.4f} "
+              f"-> |diff| {diff:.4f} {'PASS' if ok else 'FAIL'} (bar 1%)")
+        raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

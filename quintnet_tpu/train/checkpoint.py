@@ -51,12 +51,18 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore(self, template: Any, *, step: Optional[int] = None) -> Any:
+    def restore(self, template: Any = None, *, step: Optional[int] = None
+                ) -> Any:
+        """``template=None`` restores as plain host numpy arrays with the
+        saved structure — the no-mesh reload path the single-device
+        verifiers use (reference: examples/verify_model.py:23-60 reloads
+        with no distributed code)."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        return self._mgr.restore(step,
-                                 args=ocp.args.StandardRestore(template))
+        args = (ocp.args.StandardRestore(template)
+                if template is not None else ocp.args.StandardRestore())
+        return self._mgr.restore(step, args=args)
 
     def close(self):
         self._mgr.close()

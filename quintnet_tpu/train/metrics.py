@@ -111,32 +111,10 @@ def compute_rouge_bleu(predictions: Sequence[str],
 
 
 # --------------------------------------------------------------------------
-# Greedy generation (reference utils/metrics.py:74-149)
-
-def greedy_generate(apply_fn: Callable, params, input_ids: np.ndarray,
-                    *, max_new_tokens: int, eos_token_id: int | None = None
-                    ) -> np.ndarray:
-    """Greedy decode with a full-forward per step (KV cache is a planned
-    ops/ upgrade; the reference's loop is also full-forward,
-    metrics.py:74-149). input_ids: [B, T0] -> [B, T0 + max_new]."""
-    ids = jnp.asarray(input_ids)
-
-    @jax.jit
-    def next_token(p, cur):
-        logits = apply_fn(p, cur)
-        return jnp.argmax(logits[:, -1, :], axis=-1)
-
-    done = np.zeros((ids.shape[0],), bool)
-    for _ in range(max_new_tokens):
-        nxt = np.asarray(next_token(params, ids))
-        if eos_token_id is not None:
-            nxt = np.where(done, eos_token_id, nxt)
-            done |= nxt == eos_token_id
-        ids = jnp.concatenate([ids, jnp.asarray(nxt)[:, None]], axis=1)
-        if eos_token_id is not None and done.all():
-            break
-    return np.asarray(ids)
-
+# Generation eval (the production decode path is the KV-cache decoder in
+# models/gpt2_generate.py; the reference's full-forward-per-token loop,
+# utils/metrics.py:74-149, survives only as a golden oracle inside
+# tests/test_generate.py)
 
 def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                         max_new_tokens: int = 64,

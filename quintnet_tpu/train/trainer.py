@@ -71,9 +71,6 @@ class Trainer:
                  optimizer: Optional[optax.GradientTransformation] = None,
                  task_type: str = "classification",
                  checkpoint_dir: Optional[str] = None,
-                 eval_logits_fn: Optional[Callable] = None,  # unused; kept
-                 # for call-site compat — accuracy now comes from the
-                 # model's eval_metrics_fn / pipeline_eval_fns hooks
                  log_fn: Callable[[str], None] = print):
         self.config = config
         self.model = model
@@ -86,7 +83,6 @@ class Trainer:
             # one SPMD log per job, not per host (reference: rank-0 tqdm
             # guards); checkpoint saves stay collective on every process
             self.log = lambda msg: None
-        self.eval_logits_fn = eval_logits_fn
 
         self.step_fn = self.strategy.make_train_step(self.model, self.optimizer)
         self._eval_fn = None

@@ -58,6 +58,45 @@ def test_orbax_roundtrip_sharded(tmp_path):
     mgr.close()
 
 
+def test_verify_vit_reload_matches_trainer_eval(tmp_path):
+    """Train sharded (3D) with checkpointing, then reload single-device
+    with NO mesh code (tools/verify_vit.py) and re-compute accuracy —
+    the reference's examples/verify_model.py:23-60 acceptance loop. The
+    reloaded accuracy must match the trainer's reported val accuracy."""
+    from quintnet_tpu.data.datasets import synthetic_mnist
+    from quintnet_tpu.data import ArrayDataset, make_batches
+    from quintnet_tpu.tools.verify_vit import verify_vit
+    from quintnet_tpu.train.trainer import Trainer
+
+    cfg = Config.from_dict({
+        "mesh_dim": [2, 2, 2], "mesh_name": ["dp", "tp", "pp"],
+        "training": {"batch_size": 32, "gradient_accumulation_steps": 2,
+                     "schedule": "1f1b", "optimizer": "adam",
+                     "learning_rate": 1e-3, "grad_clip_norm": None,
+                     "epochs": 1, "log_every": 0},
+    })
+    model = vit_model_spec(CFG)
+    xtr, ytr = synthetic_mnist(256, seed=0)
+    xte, yte = synthetic_mnist(128, seed=1)
+    xtr, xte = xtr[:, 7:21, 7:21, :], xte[:, 7:21, 7:21, :]  # 14x14 CFG
+    train = ArrayDataset(xtr, ytr)
+
+    ckpt = str(tmp_path / "ckpt")
+    trainer = Trainer(cfg, model, task_type="classification",
+                      checkpoint_dir=ckpt, log_fn=lambda s: None)
+    hist = trainer.fit(
+        lambda ep: make_batches(train, 32, seed=ep),
+        val_batches_fn=lambda ep: make_batches(
+            ArrayDataset(xte, yte), 32, shuffle=False),
+    )
+    reported = hist.val_metric[-1]
+
+    res = verify_vit(ckpt, CFG, tp=2, data=(xte[:128], yte[:128]),
+                     batch_size=32)
+    assert res["epoch"] == 0
+    assert abs(res["accuracy"] - reported) <= 0.01, (res, reported)
+
+
 def test_orbax_cross_mesh_restore(tmp_path):
     """Save under 3D sharding, restore replicated on a dp-only mesh — the
     online version of the reference's offline merge_checkpoints.py."""

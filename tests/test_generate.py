@@ -15,9 +15,31 @@ from quintnet_tpu.models.gpt2_generate import (
     gpt2_generate,
     gpt2_prefill,
 )
-from quintnet_tpu.train.metrics import greedy_generate
-
 CFG = GPT2Config.tiny(n_layer=2)
+
+
+def greedy_generate(apply_fn, params, input_ids, *, max_new_tokens,
+                    eos_token_id=None):
+    """Test-only golden oracle: full forward per token (the reference's
+    generation strategy, utils/metrics.py:74-149). O(T^2)/token — kept
+    here purely to check the KV-cache decoder against independent math."""
+    ids = jnp.asarray(input_ids)
+
+    @jax.jit
+    def next_token(p, cur):
+        logits = apply_fn(p, cur)
+        return jnp.argmax(logits[:, -1, :], axis=-1)
+
+    done = np.zeros((ids.shape[0],), bool)
+    for _ in range(max_new_tokens):
+        nxt = np.asarray(next_token(params, ids))
+        if eos_token_id is not None:
+            nxt = np.where(done, eos_token_id, nxt)
+            done |= nxt == eos_token_id
+        ids = jnp.concatenate([ids, jnp.asarray(nxt)[:, None]], axis=1)
+        if eos_token_id is not None and done.all():
+            break
+    return np.asarray(ids)
 
 
 def _params():

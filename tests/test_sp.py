@@ -102,6 +102,99 @@ def test_gpt2_sp_forward_matches_single_device(mesh_sp):
                                rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.parametrize("sp", [1, 2, 4, 8])
+def test_zigzag_ring_attention_matches_sdpa(sp):
+    """Load-balanced zigzag layout must stay EXACT (relayout + selected
+    chunk-pair scheduling is pure bookkeeping) at every ring size,
+    including odd-even boundary cases."""
+    from quintnet_tpu.ops.ring_attention import zigzag_ring_attention
+
+    b, h, s, d = 2, 2, 32, 8
+    q = jax.random.normal(jax.random.key(0), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(1), (b, h, s, d))
+    v = jax.random.normal(jax.random.key(2), (b, h, s, d))
+
+    ref = sdpa(q, k, v, causal=True)
+    mesh = mesh_from_sizes(sp=sp)
+    out = cc.shard_map_fn(
+        lambda q_, k_, v_: zigzag_ring_attention(q_, k_, v_, axis="sp",
+                                                 causal=True),
+        mesh,
+        in_specs=(P(None, None, "sp"),) * 3,
+        out_specs=P(None, None, "sp"),
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_matches_plain_ring(mesh_sp):
+    from quintnet_tpu.ops.ring_attention import zigzag_ring_attention
+
+    b, h, s, d = 1, 2, 64, 8
+    q = jax.random.normal(jax.random.key(5), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(6), (b, h, s, d))
+    v = jax.random.normal(jax.random.key(7), (b, h, s, d))
+
+    run = lambda fn: cc.shard_map_fn(
+        lambda q_, k_, v_: fn(q_, k_, v_, axis="sp", causal=True),
+        mesh_sp,
+        in_specs=(P(None, None, "sp"),) * 3,
+        out_specs=P(None, None, "sp"),
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(run(zigzag_ring_attention)),
+                               np.asarray(run(ring_attention)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_ring_attention_grads_match(mesh_sp):
+    from quintnet_tpu.ops.ring_attention import zigzag_ring_attention
+
+    b, h, s, d = 1, 2, 16, 4
+    q = jax.random.normal(jax.random.key(0), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(1), (b, h, s, d))
+    v = jax.random.normal(jax.random.key(2), (b, h, s, d))
+    w = jax.random.normal(jax.random.key(3), (b, h, s, d))
+
+    def ref_loss(q_, k_, v_):
+        return jnp.sum(sdpa(q_, k_, v_, causal=True) * w)
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    def local(q_, k_, v_, w_):
+        def loss(a, b_, c):
+            out = zigzag_ring_attention(a, b_, c, axis="sp", causal=True)
+            return jnp.sum(out * w_)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+
+    sp_spec = P(None, None, "sp")
+    g = cc.shard_map_fn(
+        local, mesh_sp,
+        in_specs=(sp_spec,) * 4,
+        out_specs=(sp_spec,) * 3,
+    )(q, k, v, w)
+    for a, b_ in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_gpt2_sp_zigzag_forward_matches_single_device(mesh_sp):
+    params = gpt2_init(jax.random.key(0), TINY)
+    ids = jax.random.randint(jax.random.key(1), (2, 32), 0, TINY.vocab_size)
+
+    ref = gpt2_apply(params, ids, TINY)
+
+    out = cc.shard_map_fn(
+        lambda p, i: gpt2_apply(p, i, TINY, sp_axis="sp",
+                                sp_mode="zigzag"),
+        mesh_sp,
+        in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-4)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_matches_sdpa(mesh_sp, causal):
     from quintnet_tpu.ops.ulysses_attention import ulysses_attention
@@ -194,6 +287,8 @@ def test_gpt2_sp_ulysses_forward_matches_single_device(mesh_sp):
     ([2, 2], ["dp", "sp"], "afab", 1, "ring"),
     ([2, 2, 2], ["tp", "pp", "sp"], "1f1b", 2, "ring"),
     ([2, 2, 2], ["tp", "pp", "sp"], "1f1b", 2, "ulysses"),
+    ([4], ["sp"], "afab", 1, "zigzag"),
+    ([2, 2, 2], ["tp", "pp", "sp"], "1f1b", 2, "zigzag"),
 ])
 def test_gpt2_sp_train_step_matches_single_device(mesh_dim, mesh_name,
                                                   schedule, grad_acc,

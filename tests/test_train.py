@@ -15,7 +15,7 @@ from quintnet_tpu.data import (
     load_mnist,
     make_batches,
 )
-from quintnet_tpu.models.vit import ViTConfig, vit_apply, vit_model_spec
+from quintnet_tpu.models.vit import ViTConfig, vit_model_spec
 from quintnet_tpu.train import metrics as M
 from quintnet_tpu.train.trainer import Trainer
 
@@ -24,14 +24,17 @@ CFG = ViTConfig(image_size=28, patch_size=7, in_channels=1, hidden_dim=16,
 
 
 def test_synthetic_mnist_learnable_and_split_consistent():
-    xtr, ytr = load_mnist(split="train", synthetic_size=256)
-    xte, yte = load_mnist(split="test", synthetic_size=64)
-    assert xtr.shape == (256, 28, 28, 1) and ytr.shape == (256,)
-    # same class prototypes across splits: same-class means correlate
+    xtr, ytr = load_mnist(split="train", synthetic_size=2048)
+    xte, yte = load_mnist(split="test", synthetic_size=512)
+    assert xtr.shape == (2048, 28, 28, 1) and ytr.shape == (2048,)
+    # same class prototypes across splits: same-class means correlate.
+    # The task is deliberately low-SNR (Bayes acc ~94%, see
+    # synthetic_mnist docstring) so the correlation needs enough samples
+    # per class to emerge from the noise.
     m_tr = xtr[ytr == 3].mean(0).ravel()
     m_te = xte[yte == 3].mean(0).ravel()
     corr = np.corrcoef(m_tr, m_te)[0, 1]
-    assert corr > 0.5, corr
+    assert corr > 0.35, corr
 
 
 def test_make_batches_shapes():
@@ -87,8 +90,7 @@ def test_trainer_fit_reduces_loss_dp():
     x, y = load_mnist(split="train", synthetic_size=128)
     ds = ArrayDataset(x, y)
     trainer = Trainer(cfg, model, task_type="classification",
-                      log_fn=lambda s: None,
-                      eval_logits_fn=lambda p, xb: vit_apply(p, xb, CFG))
+                      log_fn=lambda s: None)
     hist = trainer.fit(
         lambda ep: make_batches(ds, 32, seed=ep),
         val_batches_fn=lambda ep: make_batches(ds, 32, shuffle=False),
