@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import sys
 import time
 
 # Round-1 committed reference points (same chip class, default flags of
@@ -35,6 +36,41 @@ import time
 COMMITTED_BASELINES = {
     "gpt2_124m_seq512_train_samples_per_sec_per_chip": 181.3,
 }
+
+
+def init_backend_with_retry(retries: int = 5, backoff_s: float = 10.0):
+    """Touch the JAX backend, retrying transient tunnel outages.
+
+    Round 3 shipped zero perf evidence because the tunneled TPU backend
+    returned UNAVAILABLE at capture time and bench.py died with a
+    traceback (rc=1). A flaky tunnel must degrade to a diagnostic JSON
+    line, never a zeroed round: retry with linear backoff, and on
+    persistent failure print well-formed JSON and exit 0.
+    """
+    import jax
+    import jax.extend.backend
+
+    last_err = None
+    for attempt in range(retries):
+        try:
+            return jax.devices()
+        except RuntimeError as e:  # jax wraps backend-init failures
+            last_err = e
+            if attempt + 1 < retries:
+                # Failed backend inits are cached per-process by jax;
+                # clear so the next attempt actually retries.
+                jax.extend.backend.clear_backends()
+                time.sleep(backoff_s * (attempt + 1))
+    print(json.dumps({
+        "metric": "backend_unavailable",
+        "value": 0.0,
+        "unit": "none",
+        "vs_baseline": 0.0,
+        "error": "tpu_unavailable",
+        "error_detail": str(last_err)[:500],
+        "retries": retries,
+    }))
+    sys.exit(0)
 
 
 def flops_per_token_gpt2(cfg) -> float:
@@ -116,11 +152,14 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
-    ap.add_argument("--remat", default=0, type=int,
-                    help="rematerialise blocks in backward (1) or keep "
-                         "activations (0, default: GPT-2 124M fits v5e "
-                         "HBM without it and remat burns ~1/3 extra "
-                         "FLOPs)")
+    ap.add_argument("--remat", default=1, type=int,
+                    help="rematerialise blocks in backward (default 1: "
+                         "measured faster on v5e — 188.3 vs 169.5 "
+                         "samples/s/chip at bs 8/seq 512, round-2 A-B. "
+                         "Remat shrinks the live activation set, so XLA "
+                         "keeps the backward working set in VMEM/HBM "
+                         "without spilling; the recompute FLOPs are "
+                         "cheaper than the saved memory traffic)")
     ap.add_argument("--vocab-parallel", action="store_true",
                     help="shard wte + sharded-CE over tp (multi-chip)")
     args = ap.parse_args()
@@ -133,11 +172,13 @@ def main():
     from quintnet_tpu.core.config import Config
     from quintnet_tpu.parallel.strategy import get_strategy
 
+    devices = init_backend_with_retry()
+
     if args.model == "flash-attn":
         bench_flash_attn(args)
         return
 
-    n_dev = len(jax.devices())
+    n_dev = len(devices)
     cfg = Config.from_dict({
         "mesh_dim": [n_dev], "mesh_name": ["dp"],
         "training": {"batch_size": args.batch * n_dev,
@@ -242,4 +283,22 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except RuntimeError as e:
+        # Still emit one JSON line, but only classify genuine tunnel
+        # outages as soft failures (rc=0); other RuntimeErrors (OOM,
+        # XlaRuntimeError mid-run) are real regressions and keep rc=1
+        # so they can't masquerade as infrastructure noise.
+        msg = str(e)
+        unavailable = ("UNAVAILABLE" in msg or "Unable to initialize"
+                       in msg or "failed to connect" in msg.lower())
+        print(json.dumps({
+            "metric": "backend_failed_midrun",
+            "value": 0.0,
+            "unit": "none",
+            "vs_baseline": 0.0,
+            "error": "tpu_unavailable" if unavailable else "runtime_error",
+            "error_detail": msg[:500],
+        }))
+        sys.exit(0 if unavailable else 1)

@@ -107,8 +107,20 @@ class TrainingConfig:
     gradient_accumulation_steps: int = 1
     epochs: int = 1
     learning_rate: float = 3e-4
-    weight_decay: float = 0.0
+    # None -> per-optimizer default (0.01 for adamw, the reference's
+    # GPT2Trainer value); an explicit 0.0 really means no decay
+    weight_decay: Optional[float] = None
     optimizer: str = "adam"  # adam | adamw | zero1_adamw
+    # LR schedule (the reference trains at a constant lr everywhere —
+    # trainer.py:89, GPT2_Trainer.py:100-104; schedules are an upgrade):
+    # constant | cosine | linear. warmup_steps prepends a linear 0->lr
+    # ramp to any of them; cosine/linear decay to
+    # learning_rate*min_lr_ratio over decay_steps TOTAL steps (incl.
+    # warmup), so decay_steps > warmup_steps is required for those.
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    decay_steps: int = 0
+    min_lr_ratio: float = 0.0
     grad_clip_norm: Optional[float] = 1.0
     seed: int = 0
     # 1f1b (vjp-recompute backward) | 1f1b_stored (store activations,

@@ -135,6 +135,74 @@ def make_batches(ds: ArrayDataset, batch_size: int, *, seed: int = 0,
         yield ds.x[j], ds.y[j]
 
 
+def load_hf_dataset(path: str, split: str = "train"):
+    """Load a HuggingFace ``save_to_disk`` directory or a single ``.arrow``
+    file (reference CustomDataset, utils/Dataloader.py:38-141).
+
+    Directory: ``load_from_disk``; if it holds a DatasetDict the ``split``
+    is selected (unknown split -> ValueError listing the available ones,
+    same contract as the reference). ``.arrow`` file: ``Dataset.from_file``.
+    The ``datasets`` package is an optional dependency — a clear
+    ImportError is raised when absent (this framework's own loaders read
+    IDX/npz/CSV without it).
+    """
+    try:
+        from datasets import Dataset, DatasetDict, load_from_disk
+    except ImportError as e:
+        raise ImportError(
+            "load_hf_dataset needs the optional 'datasets' package "
+            "(pip install datasets); the built-in IDX/npz/CSV loaders "
+            "work without it") from e
+
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"dataset path does not exist: {path}")
+    if os.path.isdir(path):
+        ds = load_from_disk(path)
+        if isinstance(ds, DatasetDict):
+            if split not in ds:
+                raise ValueError(
+                    f"split {split!r} not found; available: {list(ds.keys())}")
+            return ds[split]
+        return ds
+    if path.endswith(".arrow"):
+        return Dataset.from_file(path)
+    raise ValueError(
+        f"unsupported dataset path {path!r}: expected a save_to_disk "
+        "directory or a .arrow file")
+
+
+def summarization_from_hf(path: str, tokenizer, *, split: str = "train",
+                          max_length: int = 512,
+                          article_col: str = "article",
+                          summary_col: str = "highlights",
+                          limit: Optional[int] = None
+                          ) -> "SummarizationDataset":
+    """HF CNN/DailyMail-style dataset -> :class:`SummarizationDataset`
+    (the reference pairs CustomDataset with SummarizationDataset for the
+    same corpus, utils/Dataloader.py:216-260)."""
+    ds = load_hf_dataset(path, split)
+    n = min(limit, len(ds)) if limit is not None else len(ds)
+    rows = []
+    for i in range(n):
+        row = ds[i]  # one Arrow row decode per index
+        rows.append((row[article_col], row[summary_col]))
+    return SummarizationDataset(rows, tokenizer, max_length=max_length)
+
+
+def mnist_from_hf(path: str, *, split: str = "train",
+                  image_col: str = "image", label_col: str = "label"
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """HF-format MNIST -> normalised (images [N,28,28,1], labels [N])
+    with the same mean/std as :func:`load_mnist` (reference
+    mnist_transform, utils/Dataloader.py:179-214). Accepts PIL images or
+    nested lists/arrays in ``image_col``."""
+    ds = load_hf_dataset(path, split)
+    imgs = np.stack([np.asarray(r[image_col], dtype=np.uint8)
+                     for r in ds])
+    labels = np.asarray([r[label_col] for r in ds], dtype=np.int32)
+    return _norm(imgs.reshape(len(imgs), 28, 28)), labels
+
+
 class ByteTokenizer:
     """Byte-level fallback tokenizer (no-network stand-in for HF
     GPT2Tokenizer): ids 0-255 are bytes, 256=pad/eos."""

@@ -47,7 +47,15 @@ class ViTConfig:
     num_heads: int = 4
     mlp_ratio: float = 4.0
     num_classes: int = 10
+    # One rate for embedding/attention/residual sites (the reference ViT
+    # has no dropout at all — utils/model.py — so 0.0 keeps parity; the
+    # knob is wired, not silently ignored: vit_apply threads it to the
+    # same block sites GPT-2 uses, gated by the train step's seed)
     dropout: float = 0.0
+
+    @property
+    def needs_dropout(self) -> bool:
+        return self.dropout > 0.0
 
     @property
     def num_patches(self) -> int:
@@ -92,15 +100,21 @@ def vit_init(key, cfg: ViTConfig, *, dtype=jnp.float32):
     }
 
 
-def vit_embed(p_emb, images, patch_size: int):
+def vit_embed(p_emb, images, patch_size: int, *, pdrop: float = 0.0,
+              key=None):
     """images [B, H, W, C] -> tokens [B, N+1, D] (reference ViTEmbedding,
-    model.py:271-323)."""
+    model.py:271-323). ``key`` enables embedding dropout in training."""
     x = patchify(images, patch_size)
     x = linear_apply(p_emb["patch"], x)
     b = x.shape[0]
     cls = jnp.broadcast_to(p_emb["cls"], (b, 1, x.shape[-1])).astype(x.dtype)
     x = jnp.concatenate([cls, x], axis=1)
-    return x + p_emb["pos"].astype(x.dtype)
+    x = x + p_emb["pos"].astype(x.dtype)
+    if key is not None and pdrop > 0.0:
+        from quintnet_tpu.nn.layers import dropout
+
+        x = dropout(key, x, pdrop, deterministic=False)
+    return x
 
 
 def vit_head(p_head, x):
@@ -117,11 +131,14 @@ def vit_apply(
     tp_axis: Optional[str] = None,
     remat: bool = False,
     compute_dtype=None,
+    key=None,
 ):
     """Forward pass: [B, H, W, C] (or [B, C, H, W] — auto-detected) -> logits.
 
     ``tp_axis``: see nn/transformer.py — heads/MLP column-row sharded;
     ``num_heads`` passed to attention is LOCAL heads.
+    ``key``: training-dropout key (rate ``cfg.dropout`` at the embedding
+    /attention/residual sites); None -> deterministic eval.
     """
     if images.ndim == 4 and images.shape[1] == cfg.in_channels \
             and images.shape[-1] != cfg.in_channels:
@@ -135,7 +152,11 @@ def vit_apply(
         tp = jax.lax.axis_size(tp_axis)
     local_heads = cfg.num_heads // tp
 
-    x = vit_embed(params["embedding"], images, cfg.patch_size)
+    k_embd = k_blocks = None
+    if key is not None and cfg.dropout > 0.0:
+        k_embd, k_blocks = jax.random.split(key)
+    x = vit_embed(params["embedding"], images, cfg.patch_size,
+                  pdrop=cfg.dropout, key=k_embd)
     x = stacked_blocks_apply(
         params["blocks"],
         x,
@@ -144,6 +165,9 @@ def vit_apply(
         act=jax.nn.relu,  # reference ViT MLP uses ReLU (model.py:112-148)
         tp_axis=tp_axis,
         remat=remat,
+        attn_pdrop=cfg.dropout,
+        resid_pdrop=cfg.dropout,
+        key=k_blocks,
     )
     return vit_head(params["head"], x).astype(jnp.float32)
 
@@ -205,7 +229,8 @@ def vit_pipeline_fns(cfg: ViTConfig, *, tp_axis: Optional[str] = None,
         if x.ndim == 4 and x.shape[1] == cfg.in_channels \
                 and x.shape[-1] != cfg.in_channels:
             x = x.transpose(0, 2, 3, 1)
-        return vit_embed(params["embedding"], x, cfg.patch_size)
+        return vit_embed(params["embedding"], x, cfg.patch_size,
+                         pdrop=cfg.dropout, key=key)
 
     def stage_fn(blocks_local, h, key=None):
         tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
@@ -216,6 +241,9 @@ def vit_pipeline_fns(cfg: ViTConfig, *, tp_axis: Optional[str] = None,
             act=jax.nn.relu,
             tp_axis=tp_axis,
             remat=remat,
+            attn_pdrop=cfg.dropout,
+            resid_pdrop=cfg.dropout,
+            key=key,
         )
 
     def head_loss_fn(params, h, y):
@@ -241,7 +269,8 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
                 key=None):
         x, y = batch
         return cross_entropy_loss(
-            vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat), y)
+            vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat,
+                      key=key), y)
 
     def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
         return vit_pipeline_fns(cfg, tp_axis=tp_axis, remat=remat)
@@ -279,6 +308,7 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
         depth=cfg.depth,
         eval_metrics_fn=eval_metrics_fn,
         pipeline_eval_fns=pipeline_eval_fns,
+        needs_rng=cfg.needs_dropout,
     )
 
 

@@ -68,6 +68,8 @@ def make_zero1(
         r = lax.axis_index(axis)
         return optimizer.init(local_chunk(flat, dp, r, chunk))
 
+    opt_extra = optax.with_extra_args_support(optimizer)
+
     def update_local(grads, opt_state, params):
         flat_p, unravel = ravel_pytree(params)
         flat_g, _ = ravel_pytree(grads)
@@ -76,7 +78,17 @@ def make_zero1(
         r = lax.axis_index(axis)
         p_chunk = local_chunk(flat_p, dp, r, chunk)
         g_chunk = local_chunk(flat_g, dp, r, chunk)
-        updates, opt_state = optimizer.update(g_chunk, opt_state, p_chunk)
+        # Elementwise decay mask (ndim>1 leaves), raveled and chunked
+        # like the params: per-leaf optax masks cannot see parameter
+        # boundaries inside the flat chunk, so masked_decay
+        # (train/trainer.py) takes this via the extra-args protocol;
+        # transforms without extra-args support ignore it. Trace-time
+        # constant — XLA folds it.
+        flat_m, _ = ravel_pytree(jax.tree.map(
+            lambda p: jnp.full(p.shape, p.ndim > 1, flat_p.dtype), params))
+        m_chunk = local_chunk(flat_m, dp, r, chunk)
+        updates, opt_state = opt_extra.update(g_chunk, opt_state, p_chunk,
+                                              decay_mask=m_chunk)
         p_chunk = optax.apply_updates(p_chunk, updates)
         flat_new = cc.all_gather(p_chunk, axis, gather_dim=0)  # [dp*chunk]
         flat_new = flat_new[: flat_p.shape[0]]

@@ -31,20 +31,103 @@ from quintnet_tpu.core.config import Config
 from quintnet_tpu.parallel.strategy import ModelSpec, Strategy, get_strategy
 
 
+def make_lr_schedule(cfg: Config):
+    """LR schedule from config fields (the reference trains at constant
+    lr only — trainer.py:89, GPT2_Trainer.py:100-104).
+
+    ``lr_schedule``: constant | cosine | linear; ``warmup_steps``
+    prepends a linear 0->peak ramp; cosine/linear decay to
+    ``learning_rate * min_lr_ratio`` at step ``decay_steps`` (a TOTAL
+    step count, warmup included). Returns a float for the plain
+    constant case so optimizer states stay countless where possible.
+    """
+    t = cfg.training
+    lr, name = t.learning_rate, t.lr_schedule.lower()
+    if name == "constant":
+        if not t.warmup_steps:
+            return lr
+        return optax.schedules.join_schedules(
+            [optax.schedules.linear_schedule(0.0, lr, t.warmup_steps),
+             optax.schedules.constant_schedule(lr)],
+            [t.warmup_steps])
+    if t.decay_steps <= t.warmup_steps:
+        raise ValueError(
+            f"lr_schedule={name!r} needs decay_steps > warmup_steps "
+            f"(got decay_steps={t.decay_steps}, warmup={t.warmup_steps})")
+    end = lr * t.min_lr_ratio
+    if name == "cosine":
+        return optax.schedules.warmup_cosine_decay_schedule(
+            init_value=0.0 if t.warmup_steps else lr, peak_value=lr,
+            warmup_steps=t.warmup_steps, decay_steps=t.decay_steps,
+            end_value=end)
+    if name == "linear":
+        return optax.schedules.join_schedules(
+            [optax.schedules.linear_schedule(
+                0.0 if t.warmup_steps else lr, lr, max(t.warmup_steps, 1)),
+             optax.schedules.linear_schedule(
+                 lr, end, t.decay_steps - t.warmup_steps)],
+            [t.warmup_steps])
+    raise ValueError(f"unknown lr_schedule {t.lr_schedule!r}")
+
+
+def masked_decay(weight_decay: float):
+    """Decoupled weight decay skipping LayerNorm scales and biases.
+
+    Standard practice (and what torch AdamW users hand-configure via
+    param groups); the reference decays everything (GPT2_Trainer.py:100).
+    Default mask: decay only leaves with ndim > 1 (matrices/embeddings);
+    1-D leaves (biases, LN scale/shift) are skipped.
+
+    Under ZeRO-1 the optimizer runs on a flat chunk where per-leaf
+    masking cannot see parameter boundaries, so the transform also
+    accepts an ELEMENTWISE ``decay_mask`` extra arg (optax extra-args
+    protocol); parallel/zero.py ravels the ndim>1 mask alongside the
+    params and passes its chunk — the two paths are bit-identical
+    (tests/test_zero.py).
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params, *, decay_mask=None, **extra):
+        del extra
+        if params is None:
+            raise ValueError("masked_decay requires params")
+        if decay_mask is None:
+            decay_mask = jax.tree.map(
+                lambda p: jnp.asarray(p.ndim > 1, p.dtype), params)
+        updates = jax.tree.map(
+            lambda u, p, m: u + weight_decay * m.astype(u.dtype) * p,
+            updates, params, decay_mask)
+        return updates, state
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
 def make_optimizer(cfg: Config) -> optax.GradientTransformation:
     """Optimizer from config (reference: Adam in Trainer vs AdamW in
     GPT2Trainer — trainer.py:89 vs GPT2_Trainer.py:100; here one factory).
-    zero1_* names shard the state over dp (parallel/zero.py)."""
+    zero1_* names shard the state over dp (parallel/zero.py). AdamW is
+    built as scale_by_adam + masked_decay + lr so the decay composes
+    with schedules exactly like optax.adamw (decay scaled by lr_t) while
+    skipping LN/bias leaves."""
     t = cfg.training
     name = t.optimizer.lower()
     if name.startswith("zero1_"):
         name = name[len("zero1_"):]
+    lr = make_lr_schedule(cfg)
     if name == "adam":
-        return optax.adam(t.learning_rate)
+        return optax.adam(lr)
     if name == "adamw":
-        return optax.adamw(t.learning_rate, weight_decay=t.weight_decay or 0.01)
+        return optax.chain(
+            optax.scale_by_adam(),
+            masked_decay(0.01 if t.weight_decay is None
+                         else t.weight_decay),
+            optax.scale_by_learning_rate(lr),
+        )
     if name == "sgd":
-        return optax.sgd(t.learning_rate)
+        return optax.sgd(lr)
     raise ValueError(f"unknown optimizer {t.optimizer!r}")
 
 

@@ -1,0 +1,91 @@
+"""HF datasets/arrow reader (reference CustomDataset,
+utils/Dataloader.py:38-141): save_to_disk dirs, DatasetDict splits,
+bare .arrow files, and the summarization/MNIST bridges."""
+
+import numpy as np
+import pytest
+
+datasets = pytest.importorskip("datasets")
+
+from quintnet_tpu.data.datasets import (
+    ByteTokenizer,
+    load_hf_dataset,
+    mnist_from_hf,
+    summarization_from_hf,
+)
+
+
+@pytest.fixture
+def summ_dir(tmp_path):
+    ds = datasets.DatasetDict({
+        "train": datasets.Dataset.from_dict({
+            "article": [f"article number {i} with several words" for i in range(6)],
+            "highlights": [f"summary {i}" for i in range(6)],
+        }),
+        "validation": datasets.Dataset.from_dict({
+            "article": ["val article"], "highlights": ["val summary"],
+        }),
+    })
+    p = tmp_path / "summ"
+    ds.save_to_disk(str(p))
+    return str(p)
+
+
+def test_load_dir_with_splits(summ_dir):
+    train = load_hf_dataset(summ_dir, "train")
+    assert len(train) == 6
+    val = load_hf_dataset(summ_dir, "validation")
+    assert val[0]["article"] == "val article"
+
+
+def test_unknown_split_lists_available(summ_dir):
+    with pytest.raises(ValueError, match="train"):
+        load_hf_dataset(summ_dir, "test")
+
+
+def test_load_single_dataset_dir(tmp_path):
+    ds = datasets.Dataset.from_dict({"a": [1, 2, 3]})
+    p = tmp_path / "single"
+    ds.save_to_disk(str(p))
+    # split is ignored for a split-less save (reference behavior)
+    assert len(load_hf_dataset(str(p), "train")) == 3
+
+
+def test_load_bare_arrow_file(tmp_path, summ_dir):
+    import glob
+
+    arrow = glob.glob(f"{summ_dir}/train/*.arrow")[0]
+    ds = load_hf_dataset(arrow)
+    assert len(ds) == 6
+
+
+def test_missing_path_raises():
+    with pytest.raises(FileNotFoundError):
+        load_hf_dataset("/nonexistent/nowhere")
+
+
+def test_summarization_bridge(summ_dir):
+    sd = summarization_from_hf(summ_dir, ByteTokenizer(), max_length=64,
+                               limit=4)
+    assert len(sd) == 4
+    ids, labels = next(sd.batches(2, shuffle=False))
+    assert ids.shape == (2, 64) and labels.shape == (2, 64)
+    # prompt region masked to -100, summary region supervised
+    assert (labels[0] == -100).any() and (labels[0] != -100).any()
+
+
+def test_mnist_bridge(tmp_path):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (10, 28, 28), dtype=np.uint8)
+    ds = datasets.Dataset.from_dict({
+        "image": [im.tolist() for im in imgs],
+        "label": list(range(10)),
+    })
+    p = tmp_path / "mnist"
+    ds.save_to_disk(str(p))
+    x, y = mnist_from_hf(str(p))
+    assert x.shape == (10, 28, 28, 1) and x.dtype == np.float32
+    np.testing.assert_array_equal(y, np.arange(10))
+    # normalisation matches load_mnist's mean/std
+    np.testing.assert_allclose(
+        x[0, 0, 0, 0], (imgs[0, 0, 0] / 255.0 - 0.1307) / 0.3081, rtol=1e-5)
