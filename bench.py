@@ -106,6 +106,7 @@ def bench_flash_attn(args):
     ks = jax.random.split(jax.random.key(0), 3)
     q, k, v = (jax.random.normal(kk, (B, H, S, Dh), jnp.bfloat16)
                for kk in ks)
+    blk = dict(block_q=args.block_q, block_k=args.block_k)
 
     def run(fn):
         def loss(q, k, v):
@@ -120,7 +121,8 @@ def bench_flash_attn(args):
         float(jnp.sum(out[0].astype(jnp.float32)))
         return (time.perf_counter() - t0) / args.steps
 
-    t_flash = run(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    t_flash = run(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                  **blk))
     t_sdpa = run(lambda q, k, v: sdpa(q, k, v, causal=True))
 
     # causal attention fwd+bwd ~ 3.5 * 2 * B*H*S^2*Dh (fwd 2 matmuls,
@@ -135,6 +137,8 @@ def bench_flash_attn(args):
             "sdpa_time_ms": round(t_sdpa * 1e3, 3),
             "speedup_vs_sdpa": round(t_sdpa / t_flash, 3),
             "flash_tflops": round(flops / t_flash / 1e12, 2),
+            "block_q": args.block_q,
+            "block_k": args.block_k,
             "backend": jax.default_backend(),
         },
     }))
@@ -146,6 +150,10 @@ def main():
                     choices=["gpt2", "gpt2-moe", "vit", "flash-attn"])
     ap.add_argument("--experts", type=int, default=8,
                     help="expert count for --model gpt2-moe")
+    ap.add_argument("--block-q", type=int, default=128,
+                    help="flash kernel q tile (--model flash-attn)")
+    ap.add_argument("--block-k", type=int, default=128,
+                    help="flash kernel k tile (--model flash-attn)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--batch", type=int, default=8)
@@ -162,9 +170,24 @@ def main():
                          "cheaper than the saved memory traffic)")
     ap.add_argument("--vocab-parallel", action="store_true",
                     help="shard wte + sharded-CE over tp (multi-chip)")
+    ap.add_argument("--loss-chunk", type=int, default=0,
+                    help="chunked CE: compute the CLM loss in sequence "
+                         "chunks of N positions so full [B,S,V] f32 "
+                         "logits never materialise (0=off)")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the timed "
+                         "steps into DIR (inspect with xprof/tensorboard)")
+    ap.add_argument("--platform", default=None,
+                    help="override the JAX platform (e.g. 'cpu' to smoke-"
+                         "test the bench loop without the TPU tunnel; "
+                         "this environment's sitecustomize pins 'axon' "
+                         "and ignores the JAX_PLATFORMS env var)")
     args = ap.parse_args()
 
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -201,6 +224,8 @@ def main():
         if args.vocab_parallel:
             gcfg = dataclasses.replace(gcfg, vocab_parallel=True,
                                        padded_vocab_size=50304)
+        if args.loss_chunk:
+            gcfg = dataclasses.replace(gcfg, loss_chunk=args.loss_chunk)
         compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
         model = gpt2_model_spec(gcfg, remat=bool(args.remat),
                                 use_flash=use_flash,
@@ -244,11 +269,15 @@ def main():
         params, opt_state, loss = step(params, opt_state, b)
     float(loss)
 
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         params, opt_state, loss = step(params, opt_state, b)
     loss_val = float(loss)
     dt = (time.perf_counter() - t0) / args.steps
+    if args.trace:
+        jax.profiler.stop_trace()
 
     samples_per_sec = args.batch * n_dev / dt
     per_chip = samples_per_sec / n_dev

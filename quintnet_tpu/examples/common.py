@@ -17,6 +17,8 @@ def parse_args(default_config: str):
     ap.add_argument("--simulate", type=int, default=0,
                     help="run on N virtual CPU devices instead of TPU")
     ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--limit", type=int, default=None,
+                    help="cap train/val samples per epoch (smoke runs)")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--data-dir", default=None)
     add_multihost_args(ap)
@@ -83,6 +85,10 @@ def run_vit(args, strategy_name: str):
 
     xtr, ytr = load_mnist(args.data_dir, split="train")
     xte, yte = load_mnist(args.data_dir, split="test")
+    limit = getattr(args, "limit", None)
+    if limit:
+        xtr, ytr = xtr[:limit], ytr[:limit]
+        xte, yte = xte[:limit], yte[:limit]
     train = ArrayDataset(xtr, ytr)
     test = ArrayDataset(xte, yte)
     bs = cfg.training.batch_size

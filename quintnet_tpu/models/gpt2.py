@@ -88,6 +88,12 @@ class GPT2Config:
     # ``vocab_size`` stays the REAL vocab (labels/ids range, softmax
     # support). None = no padding (table rows == vocab_size).
     padded_vocab_size: Optional[int] = None
+    # --- chunked CE (replicated-activation paths): compute the CLM loss
+    # in sequence chunks of this many positions so full [B, S, V] f32
+    # logits never materialise (clm_loss_chunked). 0 = off. Ignored
+    # under sp (clm_loss_sp) / vocab_parallel (clm_loss_vp), which
+    # already avoid full logits their own way.
+    loss_chunk: int = 0
 
     @property
     def mlp_hidden(self) -> int:
@@ -285,19 +291,27 @@ def gpt2_logits(params, h, cfg: GPT2Config):
     logits = jnp.dot(h, params["embedding"]["wte"].T).astype(jnp.float32)
     if (cfg.padded_vocab_size
             and params["embedding"]["wte"].shape[0] == cfg.table_vocab_size):
-        col = jnp.arange(cfg.table_vocab_size)
-        logits = jnp.where(col < cfg.vocab_size, logits,
-                           jnp.finfo(jnp.float32).min)
+        logits = mask_padded_cols(logits, cfg)
     return logits
 
 
-def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
-                 tp_axis: Optional[str] = None,
-                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
-                 ep_axis: Optional[str] = None,
-                 remat: bool = False, use_flash: bool = False, key=None):
-    """-> (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs.
-    ``key``: training-dropout key (None -> deterministic/eval)."""
+def mask_padded_cols(logits, cfg: "GPT2Config"):
+    """-inf the vocab-padding columns of FULL-width logits so they never
+    enter a softmax or win an argmax (single place for the semantics:
+    used by gpt2_logits, clm_loss_chunked and the tp decoder)."""
+    col = jnp.arange(logits.shape[-1])
+    return jnp.where(col < cfg.vocab_size, logits,
+                     jnp.finfo(jnp.float32).min)
+
+
+def gpt2_hidden(params, input_ids, cfg: GPT2Config, *,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                ep_axis: Optional[str] = None,
+                remat: bool = False, use_flash: bool = False, key=None):
+    """embed + blocks -> (final hidden states [B, T, D], moe_aux); the
+    pre-lm-head half of :func:`gpt2_forward` (chunked-CE computes the
+    loss straight from these, never building full logits)."""
     k_embd = k_blocks = None
     if key is not None and cfg.needs_dropout:
         k_embd, k_blocks = jax.random.split(key)
@@ -307,7 +321,19 @@ def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
     out = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
                       sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
                       remat=remat, use_flash=use_flash, key=k_blocks)
-    h, aux = out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
+    return out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
+
+
+def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
+                 tp_axis: Optional[str] = None,
+                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                 ep_axis: Optional[str] = None,
+                 remat: bool = False, use_flash: bool = False, key=None):
+    """-> (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs.
+    ``key``: training-dropout key (None -> deterministic/eval)."""
+    h, aux = gpt2_hidden(params, input_ids, cfg, tp_axis=tp_axis,
+                         sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
+                         remat=remat, use_flash=use_flash, key=key)
     return gpt2_logits(params, h, cfg), aux
 
 
@@ -336,6 +362,54 @@ def clm_loss(logits, labels):
     nll = jnp.where(valid, nll, 0.0)
     count = jnp.maximum(jnp.sum(valid), 1)
     return jnp.sum(nll) / count
+
+
+def clm_loss_chunked(params, h, labels, cfg: "GPT2Config", *, chunk: int):
+    """CLM loss computed in sequence chunks straight from the final
+    hidden states: the full [B, S, V] logits / log-softmax (f32: ~823MB
+    for the bs-8/seq-512 bench config) NEVER materialize — each scan
+    step computes one [B, chunk, V] slab, reduces it to (nll_sum,
+    count), and the jax.checkpoint'd body recomputes the slab in
+    backward instead of storing it. Same math as clm_loss to float
+    reassociation (tests/test_gpt2.py golden).
+
+    Single-device / dp/tp-replicated-activation path only (sp shards
+    the sequence -> clm_loss_sp; vocab_parallel -> clm_loss_vp)."""
+    h = layer_norm_apply(params["head"]["ln_f"], h,
+                         eps=cfg.layer_norm_epsilon)
+    wte = params["embedding"]["wte"]
+    h_pred = h[:, :-1]
+    targets = labels[:, 1:]
+    B, S, D = h_pred.shape
+    pad = (-S) % chunk
+    if pad:
+        h_pred = jnp.pad(h_pred, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)),
+                          constant_values=IGNORE_INDEX)
+    nc = (S + pad) // chunk
+    h_c = h_pred.reshape(B, nc, chunk, D).transpose(1, 0, 2, 3)
+    t_c = targets.reshape(B, nc, chunk).transpose(1, 0, 2)
+    mask_pad_cols = (cfg.padded_vocab_size
+                     and wte.shape[0] == cfg.table_vocab_size)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        hc, tc = xs
+        logits = jnp.dot(hc, wte.T).astype(jnp.float32)
+        if mask_pad_cols:
+            logits = mask_padded_cols(logits, cfg)
+        valid = tc != IGNORE_INDEX
+        safe = jnp.where(valid, tc, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        nll_sum, count = carry
+        return (nll_sum + jnp.sum(jnp.where(valid, nll, 0.0)),
+                count + jnp.sum(valid)), None
+
+    (nll_sum, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (h_c, t_c))
+    return nll_sum / jnp.maximum(count, 1)
 
 
 def _sp_shift_targets(labels, sp_axis: str):
@@ -550,8 +624,11 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
         return embed_fn, stage_fn, SplitHead(head_local_fn, head_reduce_fn)
 
     def head_loss_fn(params, h, labels):
-        logits = gpt2_logits(_cast_tree(params, compute_dtype), h, cfg)
-        return clm_loss(logits, labels)
+        p = _cast_tree(params, compute_dtype)
+        if cfg.loss_chunk > 0:
+            return clm_loss_chunked(p, h, labels, cfg,
+                                    chunk=cfg.loss_chunk)
+        return clm_loss(gpt2_logits(p, h, cfg), labels)
 
     return embed_fn, stage_fn, head_loss_fn
 
@@ -566,12 +643,20 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
     def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
                 key=None):
         input_ids, labels = batch
-        logits, aux = gpt2_forward(_cast_tree(params, compute_dtype),
-                                   input_ids, cfg, tp_axis=tp_axis,
+        p = _cast_tree(params, compute_dtype)
+        vp = cfg.vocab_parallel and tp_axis is not None
+        if cfg.loss_chunk > 0 and not vp and sp_axis is None:
+            h, aux = gpt2_hidden(p, input_ids, cfg, tp_axis=tp_axis,
+                                 sp_axis=sp_axis, sp_mode=sp_mode,
+                                 ep_axis=ep_axis, remat=remat,
+                                 use_flash=use_flash, key=key)
+            return clm_loss_chunked(p, h, labels, cfg,
+                                    chunk=cfg.loss_chunk) + aux
+        logits, aux = gpt2_forward(p, input_ids, cfg, tp_axis=tp_axis,
                                    sp_axis=sp_axis, sp_mode=sp_mode,
                                    ep_axis=ep_axis, remat=remat,
                                    use_flash=use_flash, key=key)
-        if cfg.vocab_parallel and tp_axis is not None:
+        if vp:
             return clm_loss_vp(
                 logits, labels, tp_axis=tp_axis, sp_axis=sp_axis,
                 vocab_size=(cfg.vocab_size if cfg.padded_vocab_size

@@ -16,12 +16,19 @@ Here decoding is TPU-shaped:
   exit, without dynamic shapes).
 
 Greedy by default; ``temperature > 0`` switches to sampling.
-Generation runs single-device (the reference's generation eval is also
-single-device and skipped under PP — GPT2_Trainer.py:509-555).
+
+Generation runs single-device by default, and TP-SHARDED via
+:func:`gpt2_generate_tp`: head-sharded prefill+decode with the
+RowParallel psum in every cached attention step and (for
+``cfg.vocab_parallel``) vocab-sharded logits assembled by all-gather.
+The reference cannot generate under ANY parallelism (gen eval skipped,
+GPT2_Trainer.py:509-555) — anything bigger than one chip's HBM can't
+eval there; here the same tp mesh that trains also decodes.
 """
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Optional
 
@@ -31,68 +38,107 @@ import numpy as np
 from jax import lax
 
 from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_logits
-from quintnet_tpu.nn.layers import gelu
+from quintnet_tpu.nn.layers import gelu, layer_norm_apply
 from quintnet_tpu.nn.transformer import block_decode, block_prefill
 
 
-def gpt2_prefill(params, input_ids, cfg: GPT2Config, *, cache_len: int):
+def _local_heads(cfg: GPT2Config, tp_axis: Optional[str]) -> int:
+    if tp_axis is None:
+        return cfg.n_head
+    return cfg.n_head // lax.axis_size(tp_axis)
+
+
+def _embed_tok(emb, ids, cfg: GPT2Config, tp_axis: Optional[str]):
+    """Token embedding; vocab-sharded lookup + psum under vp."""
+    if tp_axis is not None and cfg.vocab_parallel:
+        from quintnet_tpu.parallel.tp import vocab_parallel_embedding
+
+        return vocab_parallel_embedding({"table": emb["wte"]}, ids,
+                                        axis=tp_axis)
+    return jnp.take(emb["wte"], ids, axis=0)
+
+
+def _logits(params, h, cfg: GPT2Config, tp_axis: Optional[str]):
+    """Full-vocab logits. Under vocab_parallel the local [.., V/tp]
+    shard is all-gathered on the vocab dim (parallel/tp.py
+    vocab_parallel_logits) and padded columns masked."""
+    if tp_axis is None or not cfg.vocab_parallel:
+        return gpt2_logits(params, h, cfg)
+    from quintnet_tpu.models.gpt2 import mask_padded_cols
+    from quintnet_tpu.parallel.tp import vocab_parallel_logits
+
+    h = layer_norm_apply(params["head"]["ln_f"], h,
+                         eps=cfg.layer_norm_epsilon)
+    logits = vocab_parallel_logits(
+        params["embedding"]["wte"].T, h, axis=tp_axis).astype(jnp.float32)
+    if cfg.padded_vocab_size:
+        logits = mask_padded_cols(logits, cfg)
+    return logits
+
+
+def gpt2_prefill(params, input_ids, cfg: GPT2Config, *, cache_len: int,
+                 tp_axis: Optional[str] = None):
     """[B, T0] prompt -> (last-position logits [B, V],
-    (k_cache, v_cache) each [L, B, H, cache_len, Dh])."""
+    (k_cache, v_cache) each [L, B, H, cache_len, Dh]).
+    Under ``tp_axis`` H is LOCAL heads (H/tp)."""
     B, T0 = input_ids.shape
     emb = params["embedding"]
-    h = (jnp.take(emb["wte"], input_ids, axis=0)
-         + emb["wpe"][None, :T0, :])
+    h = _embed_tok(emb, input_ids, cfg, tp_axis) + emb["wpe"][None, :T0, :]
+    heads = _local_heads(cfg, tp_axis)
 
     def body(x, blk):
-        x, (k, v) = block_prefill(blk, x, num_heads=cfg.n_head, act=gelu,
-                                  moe_args=cfg.moe_args)
+        x, (k, v) = block_prefill(blk, x, num_heads=heads, act=gelu,
+                                  moe_args=cfg.moe_args, tp_axis=tp_axis)
         return x, (k, v)
 
     h, (ks, vs) = lax.scan(body, h, params["blocks"])
     pad = [(0, 0), (0, 0), (0, 0), (0, cache_len - T0), (0, 0)]
-    return (gpt2_logits(params, h[:, -1:, :], cfg)[:, 0, :],
+    return (_logits(params, h[:, -1:, :], cfg, tp_axis)[:, 0, :],
             (jnp.pad(ks, pad), jnp.pad(vs, pad)))
 
 
-def gpt2_decode_step(params, tok, pos, caches, cfg: GPT2Config):
+def gpt2_decode_step(params, tok, pos, caches, cfg: GPT2Config,
+                     tp_axis: Optional[str] = None):
     """One cached decode step: tok [B] int32, pos scalar, caches
     [L, B, H, T, Dh] -> (logits [B, V], updated caches)."""
     emb = params["embedding"]
-    x = (jnp.take(emb["wte"], tok[:, None], axis=0)
+    x = (_embed_tok(emb, tok[:, None], cfg, tp_axis)
          + lax.dynamic_slice_in_dim(emb["wpe"], pos, 1, axis=0)[None])
 
     ks, vs = caches
+    heads = _local_heads(cfg, tp_axis)
 
     def body(h, layer):
         blk, kc, vc = layer
         h, kc, vc = block_decode(blk, h, kc, vc, pos,
-                                 num_heads=cfg.n_head, act=gelu,
-                                 moe_args=cfg.moe_args)
+                                 num_heads=heads, act=gelu,
+                                 moe_args=cfg.moe_args, tp_axis=tp_axis)
         return h, (kc, vc)
 
     h, (ks, vs) = lax.scan(body, x, (params["blocks"], ks, vs))
-    return gpt2_logits(params, h, cfg)[:, 0, :], (ks, vs)
+    return _logits(params, h, cfg, tp_axis)[:, 0, :], (ks, vs)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "eos_token_id",
-                                   "temperature"))
-def _generate_jit(params, input_ids, key, cfg: GPT2Config,
-                  max_new_tokens: int, eos_token_id: Optional[int],
-                  temperature: float):
+def _generate_body(params, input_ids, key, cfg: GPT2Config,
+                   max_new_tokens: int, eos_token_id: Optional[int],
+                   temperature: float, tp_axis: Optional[str] = None):
     B, T0 = input_ids.shape
     cache_len = T0 + max_new_tokens
     logits0, caches = gpt2_prefill(params, input_ids, cfg,
-                                   cache_len=cache_len)
+                                   cache_len=cache_len, tp_axis=tp_axis)
 
     def pick(logits, k):
         if temperature > 0.0:
+            # same key on every tp rank (replicated inputs) -> same
+            # sample; no cross-rank divergence to reconcile
             return jax.random.categorical(k, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
     def step(carry, _):
         tok, pos, caches, done, k = carry
         k, sub = jax.random.split(k)
-        logits, caches = gpt2_decode_step(params, tok, pos, caches, cfg)
+        logits, caches = gpt2_decode_step(params, tok, pos, caches, cfg,
+                                          tp_axis=tp_axis)
         nxt = pick(logits, sub).astype(jnp.int32)
         if eos_token_id is not None:
             nxt = jnp.where(done, eos_token_id, nxt)
@@ -111,6 +157,10 @@ def _generate_jit(params, input_ids, key, cfg: GPT2Config,
         [input_ids, first[:, None], rest.T.astype(jnp.int32)], axis=1)
 
 
+_generate_jit = partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "eos_token_id", "temperature"))(_generate_body)
+
+
 def gpt2_generate(params, input_ids, cfg: GPT2Config, *,
                   max_new_tokens: int, eos_token_id: Optional[int] = None,
                   temperature: float = 0.0, key=None) -> np.ndarray:
@@ -127,3 +177,59 @@ def gpt2_generate(params, input_ids, cfg: GPT2Config, *,
                         cfg, int(max_new_tokens), eos_token_id,
                         float(temperature))
     return np.asarray(out)
+
+
+def gpt2_generate_tp(params, input_ids, cfg: GPT2Config, *, mesh,
+                     tp_axis: str = "tp", max_new_tokens: int,
+                     eos_token_id: Optional[int] = None,
+                     temperature: float = 0.0, key=None) -> np.ndarray:
+    """TP-sharded generation over a live mesh.
+
+    ``params`` must be in the tp layout (gpt2_to_tp_layout) and sharded
+    per gpt2_partition_specs(cfg, tp_axis=tp_axis) — i.e. exactly the
+    training layout, so a training run can evaluate generation without
+    re-gathering anything. The whole prefill + decode scan runs inside
+    one shard_map: head-sharded attention with a psum per cached step
+    (nn/attention.py mha_decode), TP mlp, and vocab-sharded logits
+    all-gathered under ``cfg.vocab_parallel``. Output tokens are
+    replicated — bit-identical to single-device decode
+    (tests/test_generate.py golden).
+
+    The reference SKIPS generation eval under any parallelism
+    (GPT2_Trainer.py:509-555); 124M fits one chip, but its >1-chip
+    models would simply have no eval story.
+    """
+    if max_new_tokens < 1:
+        return np.asarray(input_ids)
+    if input_ids.shape[1] + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt {input_ids.shape[1]} + max_new {max_new_tokens} "
+            f"exceeds n_positions={cfg.n_positions}")
+    key = key if key is not None else jax.random.key(0)
+    fn = _tp_generate_fn(cfg, mesh, tp_axis, int(max_new_tokens),
+                         eos_token_id, float(temperature))
+    return np.asarray(fn(params, jnp.asarray(input_ids, jnp.int32), key))
+
+
+@functools.lru_cache(maxsize=32)
+def _tp_generate_fn(cfg: GPT2Config, mesh, tp_axis: str,
+                    max_new_tokens: int, eos_token_id: Optional[int],
+                    temperature: float):
+    """One cached jitted shard_map program per (cfg, mesh, decode
+    params) — a fresh closure per call would defeat the jit cache and
+    recompile the whole prefill+decode every generation batch."""
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.core import collectives as cc
+    from quintnet_tpu.models.gpt2 import gpt2_partition_specs
+
+    specs = gpt2_partition_specs(cfg, tp_axis=tp_axis)
+
+    def local_gen(p, ids, k):
+        return _generate_body(p, ids, k, cfg, max_new_tokens,
+                              eos_token_id, temperature, tp_axis=tp_axis)
+
+    return jax.jit(cc.shard_map_fn(
+        local_gen, mesh,
+        in_specs=(specs, P(), P()),
+        out_specs=P()))

@@ -149,7 +149,8 @@ def mha_apply(
     return y
 
 
-def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int):
+def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
+               tp_axis: Optional[str] = None):
     """Single-token cached attention: x [B, 1, D], caches [B, H, T, Dh],
     ``pos`` the (dynamic) write position. Returns (y, k_cache, v_cache).
 
@@ -157,7 +158,13 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int):
     (utils/metrics.py:74-149, O(T^2) per token); here one token attends
     against the cache — O(T) per token, fully jittable (static shapes,
     dynamic_update_slice for the cache write, masked softmax over the
-    not-yet-written tail)."""
+    not-yet-written tail).
+
+    ``tp_axis``: head-sharded decode — ``num_heads`` is LOCAL heads, the
+    cache holds this rank's heads, and the output projection psums over
+    the axis (RowParallel, same as mha_apply's training path). The
+    reference skips generation entirely under any parallelism
+    (GPT2_Trainer.py:509-555)."""
     qkv = linear_apply(p["qkv"], x)  # [B, 1, 3D]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
@@ -177,6 +184,8 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int):
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
     return y, k_cache, v_cache

@@ -188,23 +188,28 @@ def _block_mlp(p, x, *, act, moe_args, ep_axis, tp_axis):
 
 
 def block_prefill(p, x, *, num_heads: int, act: Callable = gelu,
-                  moe_args: Optional[MoEArgs] = None):
+                  moe_args: Optional[MoEArgs] = None,
+                  tp_axis: Optional[str] = None):
     """Causal block forward that also returns this layer's (k, v)
-    [B, H, S, Dh] — the prefill half of KV-cache generation."""
+    [B, H, S, Dh] — the prefill half of KV-cache generation.
+    ``tp_axis``: head-sharded — ``num_heads`` is LOCAL heads and the
+    returned cache holds only this rank's heads."""
     a, (k, v) = mha_apply(p["attn"], layer_norm_apply(p["ln1"], x),
-                          num_heads=num_heads, causal=True, return_kv=True)
+                          num_heads=num_heads, causal=True, return_kv=True,
+                          tp_axis=tp_axis)
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=None), (k, v)
+                      tp_axis=tp_axis), (k, v)
 
 
 def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                  act: Callable = gelu,
-                 moe_args: Optional[MoEArgs] = None):
+                 moe_args: Optional[MoEArgs] = None,
+                 tp_axis: Optional[str] = None):
     """Single-token cached block step (nn/attention.py mha_decode)."""
     a, k_cache, v_cache = mha_decode(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache, pos,
-        num_heads=num_heads)
+        num_heads=num_heads, tp_axis=tp_axis)
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=None), k_cache, v_cache
+                      tp_axis=tp_axis), k_cache, v_cache

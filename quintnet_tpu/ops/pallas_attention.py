@@ -22,6 +22,12 @@ Causal grid pruning: fully-masked blocks (k block strictly above the
 diagonal) skip ALL their matmuls via pl.when in forward and both
 backward kernels — ~2x less MXU work at long S. (The block DMA still
 runs — rectangular grids — but long-sequence attention is FLOPs-bound.)
+
+Throughput notes (round-4): matmul inputs stay in their NATIVE dtype —
+bf16 activations hit the MXU at full bf16 rate with f32 accumulation
+(`preferred_element_type`); the previous unconditional f32 upcast halved
+matmul throughput. The causal iota/mask is built only for tiles that
+CROSS the diagonal (lax.cond); interior tiles run unmasked.
 """
 
 from __future__ import annotations
@@ -49,6 +55,21 @@ def _block_live(qi, ki, block_q: int, block_k: int):
     return ki * block_k <= qi * block_q + block_q - 1
 
 
+def _block_needs_mask(qi, ki, block_q: int, block_k: int):
+    """True when the tile CROSSES the diagonal (some but not all entries
+    masked). Fully-below-diagonal tiles skip the iota/where entirely —
+    at long S the vast majority of live tiles."""
+    return ki * block_k + block_k - 1 > qi * block_q
+
+
+def _causal_mask(s, qi, ki, block_q: int, block_k: int):
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols <= rows, s, NEG_INF)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, block_q: int, block_k: int):
     qi = pl.program_id(1)
@@ -65,20 +86,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0].astype(jnp.float32)          # [bk, d]
-        v = v_ref[0].astype(jnp.float32)          # [bk, d]
+        # native-dtype MXU inputs (bf16 in -> bf16 matmul, f32
+        # accumulate): upcasting to f32 first would HALVE matmul
+        # throughput on v5e; softmax stats stay f32 regardless
+        q = q_ref[0]                               # [bq, d]
+        k = k_ref[0]                               # [bk, d]
+        v = v_ref[0]                               # [bk, d]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
 
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
+            # only diagonal-crossing tiles pay the iota/mask; fully
+            # lower-triangle tiles are unmasked
+            s = jax.lax.cond(
+                _block_needs_mask(qi, ki, block_q, block_k),
+                lambda t: _causal_mask(t, qi, ki, block_q, block_k),
+                lambda t: t, s)
 
         m_prev = m_scr[:, :1]                      # [bq, 1]
         l_prev = l_scr[:, :1]                      # [bq, 1]
@@ -89,7 +114,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + l_cur
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -146,16 +171,16 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
 
 def _bwd_block(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
                block_q, block_k):
-    """Shared per-tile backward math -> (p, ds), both [bq, bk] f32."""
+    """Shared per-tile backward math -> (p, ds), both [bq, bk] f32.
+    Matmul inputs stay in their native dtype (bf16 MXU when bf16 in)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     if causal:
-        rows = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        cols = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(cols <= rows, s, NEG_INF)
+        s = jax.lax.cond(
+            _block_needs_mask(qi, ki, block_q, block_k),
+            lambda t: _causal_mask(t, qi, ki, block_q, block_k),
+            lambda t: t, s)
     p = jnp.exp(s - lse)                          # [bq, bk]; masked -> 0
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -180,18 +205,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         p, ds = _bwd_block(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
                            scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # p^T dO  [bk, d]
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # ds^T q  [bk, d]
 
     @pl.when(qi == nq - 1)
@@ -215,15 +240,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         _, ds = _bwd_block(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
                            scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # ds k  [bq, d]
 
     @pl.when(ki == nk - 1)

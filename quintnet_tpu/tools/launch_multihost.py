@@ -1,0 +1,111 @@
+"""Multi-process launcher — the torchrun role for this framework.
+
+The reference is launched with ``torchrun --nproc_per_node=8 -m
+QuintNet.examples.full_3d`` (reference README.md:93-97): torchrun spawns
+one process per rank and injects the rendezvous env. Here the analogue
+spawns N copies of any entry command and appends the flags every example
+already accepts (examples/common.py add_multihost_args):
+
+    --coordinator localhost:<port> --num-processes N --process-id i
+
+Usage (2-process CPU demo, 4 virtual devices each -> one 8-device mesh):
+
+    python -m quintnet_tpu.tools.launch_multihost --nproc 2 -- \\
+        python -m quintnet_tpu.examples.full_3d --simulate 4 --epochs 1
+
+On a real TPU pod this tool is NOT needed per-host process spawning —
+run the SAME command on every host with ``--multihost`` and
+jax.distributed discovers the slice topology from TPU metadata
+(core/runtime.py:initialize); your pod process manager (GKE, xmanager,
+gcloud compute ssh loop) plays the role this script plays locally. This
+launcher covers single-host multi-process dev/CI runs and is the
+documented template for what each pod host must execute.
+
+Output of every rank is streamed line-by-line with a ``[rank i]``
+prefix (torchrun behavior); first nonzero exit kills the others and
+becomes this process's exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, rank: int, out) -> None:
+    for line in proc.stdout:
+        out.write(f"[rank {rank}] {line.decode(errors='replace')}")
+        out.flush()
+
+
+def launch(cmd, nproc: int, *, port: int | None = None,
+           out=sys.stdout) -> int:
+    """Spawn ``cmd`` nproc times with coordinator flags appended; return
+    the first nonzero exit code (0 if all succeed)."""
+    port = port or free_port()
+    procs = []
+    threads = []
+    env = dict(os.environ)
+    for i in range(nproc):
+        full = list(cmd) + ["--coordinator", f"localhost:{port}",
+                            "--num-processes", str(nproc),
+                            "--process-id", str(i)]
+        p = subprocess.Popen(full, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, env=env)
+        t = threading.Thread(target=_stream, args=(p, i, out), daemon=True)
+        t.start()
+        procs.append(p)
+        threads.append(t)
+
+    rc = 0
+    try:
+        for p in procs:
+            code = p.wait()
+            if code != 0 and rc == 0:
+                rc = code
+                for q in procs:  # fail fast: no point waiting on a
+                    if q.poll() is None:  # half-dead rendezvous
+                        q.send_signal(signal.SIGTERM)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        rc = 130
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Spawn N local processes of an example with "
+                    "coordinator flags appended (the torchrun role).",
+        usage="%(prog)s --nproc N [--port P] -- <command> [args...]")
+    ap.add_argument("--nproc", type=int, required=True,
+                    help="number of processes (one per would-be host)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="coordinator port (default: a free one)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to spawn; separate with --")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (put it after --)")
+    return launch(cmd, args.nproc, port=args.port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

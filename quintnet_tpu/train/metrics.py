@@ -119,7 +119,8 @@ def compute_rouge_bleu(predictions: Sequence[str],
 def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                         max_new_tokens: int = 64,
                         eos_token_id: int | None = None,
-                        batch_size: int = 8) -> Dict[str, float]:
+                        batch_size: int = 8,
+                        mesh=None, tp_axis: str = "tp") -> Dict[str, float]:
     """Generate continuations with the KV-cache decoder and score
     ROUGE-1/2/L + BLEU against references (reference evaluate_generation:
     utils/metrics.py:152-206, which re-runs the full prefix per token and
@@ -128,8 +129,14 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
     ``prompts``: (prompt token ids, reference text) pairs, e.g. from
     SummarizationDataset.eval_prompts. Prompts are grouped by length so
     each distinct shape compiles once, then generated in batches.
+
+    ``mesh``: run TP-SHARDED decode on a live mesh — ``params`` stay in
+    their tp training layout (models/gpt2_generate.py gpt2_generate_tp).
+    The reference skips generation eval under any parallelism
+    (GPT2_Trainer.py:509-555).
     """
-    from quintnet_tpu.models.gpt2_generate import gpt2_generate
+    from quintnet_tpu.models.gpt2_generate import (gpt2_generate,
+                                                   gpt2_generate_tp)
 
     by_len: Dict[int, List[int]] = {}
     for i, (ids, _ref) in enumerate(prompts):
@@ -146,9 +153,15 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                 # prefill+decode costs far more than the wasted rows
                 pad = np.repeat(batch[-1:], batch_size - len(grp), axis=0)
                 batch = np.concatenate([batch, pad], axis=0)
-            out = gpt2_generate(params, batch, cfg,
-                                max_new_tokens=max_new_tokens,
-                                eos_token_id=eos_token_id)
+            if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
+                out = gpt2_generate_tp(params, batch, cfg, mesh=mesh,
+                                       tp_axis=tp_axis,
+                                       max_new_tokens=max_new_tokens,
+                                       eos_token_id=eos_token_id)
+            else:
+                out = gpt2_generate(params, batch, cfg,
+                                    max_new_tokens=max_new_tokens,
+                                    eos_token_id=eos_token_id)
             for row, i in zip(out, grp):
                 new = row[n:]
                 if eos_token_id is not None:

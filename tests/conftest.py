@@ -24,6 +24,37 @@ jax.config.update("jax_enable_x64", False)
 import numpy as np
 import pytest
 
+# Whole files whose tests are multi-minute on one CPU core (subprocess
+# meshes, full-matrix parity, long schedules). Everything else is
+# auto-marked ``fast`` — `pytest -m fast` stays green in <5 min
+# single-core; `-m slow` (or no -m) runs the rest. Individual tests can
+# still carry an explicit @pytest.mark.slow inside fast files.
+SLOW_FILES = {
+    "test_5d.py",         # 32-device 5D subprocess run (~9 min budget)
+    "test_multihost.py",  # real 2-process jax.distributed rendezvous
+    "test_launcher.py",   # spawns multi-process demos
+    "test_sp.py",         # ring/zigzag/ulysses golden matrix (~4 min)
+    "test_vp.py",         # vocab-parallel loss/embedding matrix (~2 min)
+    "test_train.py",      # multi-epoch trainer runs + resume
+    "test_generate.py",   # KV-cache + tp decode goldens (~4 min)
+    "test_moe.py",        # MoE routing/dispatch matrix (~4 min)
+    "test_dropout.py",    # seed-discipline matrix across strategies (~5 min)
+    "test_gpt2.py",       # 3D training goldens + HF import (~2 min)
+    "test_dp.py",         # replica-identity/grad-accum goldens (~1.5 min)
+    "test_strategy.py",   # full strategy x schedule matrix (~2 min)
+    "test_flash.py",      # pallas interpret-mode kernels (~1.5 min)
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        explicit_slow = item.get_closest_marker("slow") is not None
+        if explicit_slow or fname in SLOW_FILES:
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.fast)
+
 
 @pytest.fixture(scope="session", autouse=True)
 def _devices():

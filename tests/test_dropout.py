@@ -231,19 +231,30 @@ class TestFusedPathAttnDropout:
         mesh = mesh_from_sizes(sp=2)
         sp_spec = P(None, None, "sp")
 
-        def run(pdrop, key):
-            def local(q_, k_, v_):
+        # ONE compiled function per pdrop with the key as a traced arg —
+        # the previous shape of this test rebuilt the shard_map closure
+        # per sampled key and spent 20+ min recompiling 128 times
+        # (pdrop stays static: the attention paths branch on it in
+        # Python)
+        def make_fn(pdrop):
+            def local(q_, k_, v_, key_):
                 if sp_mode == "ring":
                     return ring_attention(q_, k_, v_, axis="sp",
                                           causal=True, pdrop=pdrop,
-                                          key=key)
+                                          key=key_)
                 return ulysses_attention(q_, k_, v_, axis="sp",
                                          causal=True, pdrop=pdrop,
-                                         key=key)
+                                         key=key_)
 
-            return cc.shard_map_fn(
-                local, mesh, in_specs=(sp_spec, sp_spec, sp_spec),
-                out_specs=sp_spec)(q, k, v)
+            return jax.jit(cc.shard_map_fn(
+                local, mesh,
+                in_specs=(sp_spec, sp_spec, sp_spec, P()),
+                out_specs=sp_spec))
+
+        fns = {0.0: make_fn(0.0), 0.3: make_fn(0.3)}
+
+        def run(pdrop, key):
+            return fns[pdrop](q, k, v, key)
 
         ref = sdpa(q, k, v, causal=True)
         # pdrop=0 with a key stays exact
@@ -255,11 +266,16 @@ class TestFusedPathAttnDropout:
         c = run(0.3, jax.random.key(3))
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert not np.allclose(np.asarray(a), np.asarray(c))
-        # unbiased: MC mean over keys approaches the undropped output
+        # unbiased: MC mean over keys approaches the undropped output.
+        # Bound: per-element MC std of a pdrop=0.3 prob-dropout output
+        # here is ~0.6; max over 2*2*32*8=1024 elements of a 128-sample
+        # mean concentrates near 0.6/sqrt(128)*sqrt(2*ln 1024) ~ 0.2 —
+        # 0.27 gives ~3-sigma headroom (ulysses measured 0.209, ring
+        # 0.19; a hard 0.2 bound was inside the noise band and flaked)
         keys = jax.random.split(jax.random.key(6), 128)
         outs = jnp.stack([run(0.3, kk) for kk in keys])
         err = float(jnp.max(jnp.abs(jnp.mean(outs, 0) - ref)))
-        assert err < 0.2, err
+        assert err < 0.27, err
 
 
 class TestViTDropout:

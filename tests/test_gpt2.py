@@ -184,3 +184,48 @@ def test_bf16_compute_keeps_f32_master_params():
     g = jax.grad(lambda p: model.loss_fn(p, (ids, labels)))(params)
     for leaf in jax.tree.leaves(g):
         assert leaf.dtype == jnp.float32
+
+
+def test_chunked_ce_matches_plain_loss_and_grads():
+    """clm_loss_chunked == clm_loss (value AND grads) — same math,
+    chunked so full [B, S, V] logits never materialise."""
+    import numpy as np
+
+    from quintnet_tpu.models.gpt2 import gpt2_init, gpt2_model_spec
+
+    cfg_plain = GPT2Config.tiny(n_layer=2)
+    cfg_chunk = GPT2Config.tiny(n_layer=2, loss_chunk=16)
+    params = gpt2_init(jax.random.key(0), cfg_plain)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg_plain.vocab_size, (2, 48)),
+                      jnp.int32)
+    labels = ids.at[:, :7].set(-100)  # exercise IGNORE_INDEX masking
+    batch = (ids, labels)
+
+    m_plain = gpt2_model_spec(cfg_plain)
+    m_chunk = gpt2_model_spec(cfg_chunk)
+    l1, g1 = jax.value_and_grad(lambda p: m_plain.loss_fn(p, batch))(params)
+    l2, g2 = jax.value_and_grad(lambda p: m_chunk.loss_fn(p, batch))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_chunked_ce_nondivisible_seq():
+    """Padded tail chunk contributes nothing (padding targets are
+    IGNORE_INDEX)."""
+    import numpy as np
+
+    from quintnet_tpu.models.gpt2 import gpt2_init, gpt2_model_spec
+
+    cfg_plain = GPT2Config.tiny(n_layer=2)
+    cfg_chunk = GPT2Config.tiny(n_layer=2, loss_chunk=16)
+    params = gpt2_init(jax.random.key(0), cfg_plain)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg_plain.vocab_size, (2, 37)),
+                      jnp.int32)  # 36 targets: 2 chunks of 16 + pad
+    batch = (ids, ids)
+    l1 = float(gpt2_model_spec(cfg_plain).loss_fn(params, batch))
+    l2 = float(gpt2_model_spec(cfg_chunk).loss_fn(params, batch))
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)

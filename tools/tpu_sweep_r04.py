@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Round-4 TPU measurement driver (VERDICT r03 items 1-3).
+
+Runs bench.py across the requested grid on the real chip and writes:
+  artifacts/sweep_r04.json  — bs {8,16,32} x remat {0,1} x seq {512,1024}
+  artifacts/flash_r04.json  — flash-attn vs sdpa at seq {2048,4096,8192}
+                              plus a block-size mini-sweep at 8192
+  artifacts/trace_r04/      — jax.profiler trace of the default config
+
+Each entry is bench.py's own JSON line plus the argv that produced it.
+Run from the repo root when the TPU tunnel is up:  python tools/tpu_sweep_r04.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(argv, timeout=1200):
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")] + argv
+    print("::", " ".join(argv), flush=True)
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                       timeout=timeout)
+    line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+    try:
+        d = json.loads(line)
+    except json.JSONDecodeError:
+        d = {"error": "unparseable", "stdout": r.stdout[-300:],
+             "stderr": r.stderr[-300:]}
+    d["argv"] = argv
+    d["rc"] = r.returncode
+    print("  ->", json.dumps({k: d.get(k) for k in
+                              ("metric", "value", "vs_baseline", "error")}),
+          flush=True)
+    return d
+
+
+def main():
+    os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
+
+    # 1. throughput sweep (VERDICT item 2)
+    sweep = []
+    for seq in (512, 1024):
+        for bs in (8, 16, 32):
+            for remat in (1, 0):
+                sweep.append(run_bench([
+                    "--batch", str(bs), "--seq", str(seq),
+                    "--remat", str(remat), "--steps", "20"]))
+                with open(os.path.join(REPO, "artifacts/sweep_r04.json"),
+                          "w") as f:
+                    json.dump(sweep, f, indent=1)
+
+    # 2. flash kernel (VERDICT item 3)
+    flash = []
+    for seq in (2048, 4096, 8192):
+        flash.append(run_bench(["--model", "flash-attn", "--seq", str(seq),
+                                "--steps", "30"]))
+        with open(os.path.join(REPO, "artifacts/flash_r04.json"), "w") as f:
+            json.dump(flash, f, indent=1)
+    for bq, bk in ((256, 256), (256, 512), (512, 512), (128, 512)):
+        flash.append(run_bench(["--model", "flash-attn", "--seq", "8192",
+                                "--block-q", str(bq), "--block-k", str(bk),
+                                "--steps", "30"]))
+        with open(os.path.join(REPO, "artifacts/flash_r04.json"), "w") as f:
+            json.dump(flash, f, indent=1)
+
+    # 3. profiler trace of the best default (VERDICT items 1-2)
+    run_bench(["--steps", "10",
+               "--trace", os.path.join(REPO, "artifacts/trace_r04")])
+
+    print("sweep done; artifacts written", flush=True)
+
+
+if __name__ == "__main__":
+    main()
