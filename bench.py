@@ -188,9 +188,14 @@ def main():
                     choices=["gpt2", "gpt2-moe", "vit", "flash-attn"])
     ap.add_argument("--experts", type=int, default=8,
                     help="expert count for --model gpt2-moe")
-    ap.add_argument("--block-q", type=int, default=128,
-                    help="flash kernel q tile (--model flash-attn)")
-    ap.add_argument("--block-k", type=int, default=128,
+    from quintnet_tpu.ops.flash_attention import (PALLAS_BLOCK_K,
+                                                  PALLAS_BLOCK_Q)
+
+    ap.add_argument("--block-q", type=int, default=PALLAS_BLOCK_Q,
+                    help="flash kernel q tile (--model flash-attn; "
+                         "default tracks the library's measured-best "
+                         "ops/flash_attention.PALLAS_BLOCK_Q)")
+    ap.add_argument("--block-k", type=int, default=PALLAS_BLOCK_K,
                     help="flash kernel k tile (--model flash-attn)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
@@ -206,6 +211,14 @@ def main():
                          "keeps the backward working set in VMEM/HBM "
                          "without spilling; the recompute FLOPs are "
                          "cheaper than the saved memory traffic)")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "dots"],
+                    help="remat granularity when --remat 1: 'full' "
+                         "recomputes the whole block in backward; "
+                         "'dots' keeps matmul outputs and recomputes "
+                         "only elementwise work (jax dots_saveable)")
+    ap.add_argument("--scan-unroll", type=int, default=1,
+                    help="lax.scan unroll factor over the layer stack")
     ap.add_argument("--vocab-parallel", action="store_true",
                     help="shard wte + sharded-CE over tp (multi-chip)")
     ap.add_argument("--loss-chunk", type=int, default=0,
@@ -264,8 +277,12 @@ def main():
                                        padded_vocab_size=50304)
         if args.loss_chunk:
             gcfg = dataclasses.replace(gcfg, loss_chunk=args.loss_chunk)
+        if args.scan_unroll != 1:
+            gcfg = dataclasses.replace(gcfg, scan_unroll=args.scan_unroll)
         compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
-        model = gpt2_model_spec(gcfg, remat=bool(args.remat),
+        remat = ("dots" if (args.remat and args.remat_policy == "dots")
+                 else bool(args.remat))
+        model = gpt2_model_spec(gcfg, remat=remat,
                                 use_flash=use_flash,
                                 compute_dtype=compute_dtype)
         ids = np.random.default_rng(0).integers(
@@ -342,6 +359,8 @@ def main():
             "batch_per_chip": args.batch,
             "dtype": args.dtype,
             "remat": bool(args.remat),
+            "remat_policy": args.remat_policy,
+            "scan_unroll": args.scan_unroll,
             "mfu": round(mfu, 4),
             "loss": loss_val,
             "baseline": baseline,

@@ -58,7 +58,15 @@ def main():
         "training": {"batch_size": args.batch, "sp_mode": args.sp_mode,
                      "optimizer": "adamw", "grad_clip_norm": 1.0},
     })
-    gcfg = GPT2Config.tiny(n_layer=2, n_head=4, n_positions=args.seq)
+    # ulysses scatters HEADS over sp (all-to-all), so it needs
+    # n_head % sp == 0; ring/zigzag shard the sequence only. Give the
+    # tiny model enough heads to cover the mesh.
+    n_head = max(4, sp) if args.sp_mode == "ulysses" else 4
+    if args.sp_mode == "ulysses" and n_head % sp:
+        ap.error(f"--sp-mode ulysses needs n_head ({n_head}) divisible "
+                 f"by the sp mesh size ({sp})")
+    gcfg = GPT2Config.tiny(n_layer=2, n_head=n_head,
+                           n_positions=args.seq)
     model = gpt2_model_spec(gcfg, sp_mode=args.sp_mode)
     strat = get_strategy("sp", cfg)
     print(f"mesh sp={sp}, seq {args.seq} -> {args.seq // sp}/device, "

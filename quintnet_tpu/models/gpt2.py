@@ -94,6 +94,10 @@ class GPT2Config:
     # under sp (clm_loss_sp) / vocab_parallel (clm_loss_vp), which
     # already avoid full logits their own way.
     loss_chunk: int = 0
+    # --- lax.scan unroll factor for the layer stack (>1 lets XLA
+    # software-pipeline adjacent layers; measured knob, see
+    # artifacts/remat_unroll_r04.json)
+    scan_unroll: int = 1
 
     @property
     def mlp_hidden(self) -> int:
@@ -252,7 +256,7 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
                 ep_axis: Optional[str] = None,
-                remat: bool = False, use_flash: bool = False, key=None):
+                remat: "bool | str" = False, use_flash: bool = False, key=None):
     """Returns ``h`` for dense configs, ``(h, moe_aux)`` when
     ``cfg.n_experts > 0``. ``key`` enables training dropout."""
     tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
@@ -272,6 +276,7 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
         attn_pdrop=attn_p,
         resid_pdrop=resid_p,
         key=key,
+        scan_unroll=cfg.scan_unroll,
     )
 
 
@@ -308,7 +313,7 @@ def gpt2_hidden(params, input_ids, cfg: GPT2Config, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
                 ep_axis: Optional[str] = None,
-                remat: bool = False, use_flash: bool = False, key=None):
+                remat: "bool | str" = False, use_flash: bool = False, key=None):
     """embed + blocks -> (final hidden states [B, T, D], moe_aux); the
     pre-lm-head half of :func:`gpt2_forward` (chunked-CE computes the
     loss straight from these, never building full logits)."""
@@ -328,7 +333,7 @@ def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
                  tp_axis: Optional[str] = None,
                  sp_axis: Optional[str] = None, sp_mode: str = "ring",
                  ep_axis: Optional[str] = None,
-                 remat: bool = False, use_flash: bool = False, key=None):
+                 remat: "bool | str" = False, use_flash: bool = False, key=None):
     """-> (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs.
     ``key``: training-dropout key (None -> deterministic/eval)."""
     h, aux = gpt2_hidden(params, input_ids, cfg, tp_axis=tp_axis,
@@ -341,7 +346,7 @@ def gpt2_apply(params, input_ids, cfg: GPT2Config, *,
                tp_axis: Optional[str] = None,
                sp_axis: Optional[str] = None, sp_mode: str = "ring",
                ep_axis: Optional[str] = None,
-               remat: bool = False, use_flash: bool = False):
+               remat: "bool | str" = False, use_flash: bool = False):
     logits, _ = gpt2_forward(params, input_ids, cfg, tp_axis=tp_axis,
                              sp_axis=sp_axis, sp_mode=sp_mode,
                              ep_axis=ep_axis, remat=remat,
@@ -572,7 +577,7 @@ def gpt2_from_tp_layout(params, cfg: GPT2Config, tp: int):
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None, sp_mode: str = "ring",
                       ep_axis: Optional[str] = None,
-                      remat: bool = False, use_flash: bool = False,
+                      remat: "bool | str" = False, use_flash: bool = False,
                       compute_dtype=None):
     """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py.
 
@@ -633,7 +638,7 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
     return embed_fn, stage_fn, head_loss_fn
 
 
-def gpt2_model_spec(cfg: GPT2Config, *, remat: bool = False,
+def gpt2_model_spec(cfg: GPT2Config, *, remat: "bool | str" = False,
                     use_flash: bool = False, sp_mode: str = "ring",
                     compute_dtype=None):
     from jax.sharding import PartitionSpec as P

@@ -113,19 +113,26 @@ def stacked_blocks_apply(
     sp_axis: Optional[str] = None,
     sp_mode: str = "ring",
     use_flash: bool = False,
-    remat: bool = False,
+    remat: "bool | str" = False,
     moe_args: Optional[MoEArgs] = None,
     ep_axis: Optional[str] = None,
     attn_pdrop: float = 0.0,
     resid_pdrop: float = 0.0,
     key=None,
+    scan_unroll: int = 1,
 ):
     """Run a [depth, ...]-stacked block pytree with lax.scan.
 
     Replaces the reference's Python loop over ``model.blocks``
     (utils/model.py:325-380) — one traced block body, depth iterations,
     constant compile time in depth. ``remat=True`` rematerialises each
-    block in backward (jax.checkpoint), trading FLOPs for HBM.
+    block in backward (jax.checkpoint), trading FLOPs for HBM;
+    ``remat="dots"`` checkpoints with the ``dots_saveable`` policy —
+    matmul outputs are kept, only elementwise work is recomputed
+    (more live memory than full remat, less backward recompute).
+
+    ``scan_unroll``: lax.scan unroll factor — >1 lets XLA software-
+    pipeline across adjacent layer iterations at the cost of code size.
 
     With ``moe_args`` every block's MLP is a MoE FFN and the return is
     ``(out, aux_total)`` — the summed load-balance loss across layers
@@ -150,7 +157,10 @@ def stacked_blocks_apply(
         attn_pdrop=attn_pdrop,
         resid_pdrop=resid_pdrop,
     )
-    if remat:
+    if remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_saveable)
+    elif remat:
         body = jax.checkpoint(body)
 
     layer_keys = (jax.random.split(key, depth)
@@ -163,7 +173,8 @@ def stacked_blocks_apply(
             h, aux = body(blk_p, h, key=lk if use_key else None)
             return h, aux
 
-        out, auxes = jax.lax.scan(scan_moe, x, (stacked_params, layer_keys))
+        out, auxes = jax.lax.scan(scan_moe, x, (stacked_params, layer_keys),
+                                  unroll=scan_unroll)
         aux = jnp.sum(auxes)
         if sp_axis is not None:
             aux = jax.lax.pmean(aux, sp_axis)
@@ -173,7 +184,8 @@ def stacked_blocks_apply(
         blk_p, lk = xs
         return body(blk_p, h, key=lk if use_key else None), None
 
-    out, _ = jax.lax.scan(scan_fn, x, (stacked_params, layer_keys))
+    out, _ = jax.lax.scan(scan_fn, x, (stacked_params, layer_keys),
+                          unroll=scan_unroll)
     return out
 
 

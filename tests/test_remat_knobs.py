@@ -1,0 +1,56 @@
+"""remat policy ("dots") and scan_unroll are pure perf knobs: loss and
+grads must be identical (up to float reassociation) to the plain path.
+
+The reference has no analogue (torch checkpointing is absent there);
+these guard the round-4 tuning surface (bench --remat-policy /
+--scan-unroll, GPT2Config.scan_unroll).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quintnet_tpu.models.gpt2 import (GPT2Config, clm_loss, gpt2_apply,
+                                      gpt2_init)
+
+pytestmark = pytest.mark.fast
+
+
+def _loss_fn(cfg, remat):
+    def f(params, ids):
+        logits = gpt2_apply(params, ids, cfg, remat=remat)
+        return clm_loss(logits, ids)
+
+    return jax.jit(jax.value_and_grad(f))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(2, 16), dtype=np.int32))
+    base_loss, base_grads = _loss_fn(cfg, False)(params, ids)
+    return cfg, params, ids, base_loss, base_grads
+
+
+@pytest.mark.parametrize("remat", [True, "dots"])
+def test_remat_policies_match_plain(setup, remat):
+    cfg, params, ids, base_loss, base_grads = setup
+    loss, grads = _loss_fn(cfg, remat)(params, ids)
+    assert jnp.allclose(loss, base_loss, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        grads, base_grads)
+
+
+@pytest.mark.parametrize("unroll", [2, 4])
+def test_scan_unroll_matches_unrolled(setup, unroll):
+    cfg, params, ids, base_loss, base_grads = setup
+    ucfg = GPT2Config.tiny(scan_unroll=unroll)
+    loss, grads = _loss_fn(ucfg, True)(params, ids)
+    assert jnp.allclose(loss, base_loss, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        grads, base_grads)
