@@ -134,7 +134,22 @@ class TrainingConfig:
     dtype: str = "float32"
     param_dtype: str = "float32"
     remat: bool = False
+    # remat granularity: "full" recomputes whole blocks in backward;
+    # "dots" keeps matmul outputs and recomputes elementwise only
+    # (jax dots_saveable policy — less recompute, more live memory)
+    remat_policy: str = "full"
+    # lax.scan unroll factor over the layer stack (>1 lets XLA
+    # software-pipeline adjacent layers at the cost of code size)
+    scan_unroll: int = 1
     log_every: int = 50
+
+    @property
+    def remat_mode(self):
+        """The ``remat`` argument for model specs: False, True, or
+        the policy string ("dots")."""
+        if not self.remat:
+            return False
+        return self.remat_policy if self.remat_policy != "full" else True
 
 
 @dataclass

@@ -79,7 +79,7 @@ def run_vit(args, strategy_name: str):
         cfg.training.epochs = args.epochs
 
     vcfg = ViTConfig.from_model_config(cfg.model)
-    model = vit_model_spec(vcfg, remat=cfg.training.remat)
+    model = vit_model_spec(vcfg, remat=cfg.training.remat_mode)
     strategy = get_strategy(strategy_name, cfg)
     print(f"strategy={strategy.name} mesh={dict(strategy.mesh.shape)}")
 

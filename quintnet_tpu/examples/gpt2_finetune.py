@@ -82,6 +82,10 @@ def main():
         import dataclasses as _dc
 
         gcfg = _dc.replace(gcfg, n_experts=args.experts)
+    if cfg.training.scan_unroll != 1 and gcfg.scan_unroll == 1:
+        import dataclasses as _dc
+
+        gcfg = _dc.replace(gcfg, scan_unroll=cfg.training.scan_unroll)
 
     max_len = int(cfg.data.get("max_seq_length", 512))
     if args.tiny:
@@ -109,7 +113,7 @@ def main():
             f"got {cfg.training.dtype!r}")
     compute_dtype = (jnp.bfloat16 if cfg.training.dtype == "bfloat16"
                      else None)
-    model = gpt2_model_spec(gcfg, remat=cfg.training.remat,
+    model = gpt2_model_spec(gcfg, remat=cfg.training.remat_mode,
                             sp_mode=cfg.training.sp_mode,
                             compute_dtype=compute_dtype)
     strategy = get_strategy(cfg.strategy_name, cfg)

@@ -73,3 +73,14 @@ def test_bad_micro_batch():
     )
     with pytest.raises(ValueError):
         cfg.micro_batch_size_resolved()
+
+
+def test_remat_mode_resolution():
+    """remat_mode folds (remat, remat_policy) into the model-spec arg."""
+    mk = lambda **t: Config.from_dict({"training": t}).training
+    assert mk().remat_mode is False
+    assert mk(remat=True).remat_mode is True
+    assert mk(remat=True, remat_policy="dots").remat_mode == "dots"
+    # policy without remat stays off
+    assert mk(remat=False, remat_policy="dots").remat_mode is False
+    assert mk(scan_unroll=4).scan_unroll == 4
