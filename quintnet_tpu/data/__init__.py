@@ -4,15 +4,19 @@ Strategy.shard_batch)."""
 from quintnet_tpu.data.datasets import (
     ArrayDataset,
     ByteTokenizer,
+    PackedLMDataset,
     SummarizationDataset,
     load_mnist,
     make_batches,
+    pack_documents,
 )
 
 __all__ = [
     "ArrayDataset",
     "ByteTokenizer",
+    "PackedLMDataset",
     "SummarizationDataset",
     "load_mnist",
     "make_batches",
+    "pack_documents",
 ]

@@ -219,6 +219,65 @@ class ByteTokenizer:
                                                             errors="replace")
 
 
+def pack_documents(docs: Sequence[Sequence[int]], seq_len: int,
+                   *, eos_id: int, drop_remainder: bool = True
+                   ) -> np.ndarray:
+    """Concat-and-chunk sequence packing: token docs are joined with an
+    EOS separator and chunked into [N, seq_len] rows with no padding —
+    every position carries training signal (vs the reference's per-row
+    right-padding where short rows waste most of the batch,
+    utils/Dataloader.py:263-319). Standard LM-pretraining packing;
+    cross-document attention is accepted (GPT-2 convention).
+
+    Returns int32 [N, seq_len]. The remainder tail is dropped by
+    default (set ``drop_remainder=False`` to keep it EOS-padded)."""
+    flat: List[int] = []
+    for d in docs:
+        flat.extend(int(t) for t in d)
+        flat.append(eos_id)
+    n = len(flat) // seq_len
+    rem = len(flat) - n * seq_len
+    if rem and not drop_remainder:
+        flat.extend([eos_id] * (seq_len - rem))
+        n += 1
+    return np.asarray(flat[: n * seq_len], np.int32).reshape(n, seq_len)
+
+
+class PackedLMDataset:
+    """Causal-LM dataset over packed rows: labels ARE the inputs (the
+    model's CLM loss does the shift; models/gpt2.py clm_loss), so there
+    is no -100 masking and no padding — maximal tokens/step.
+
+    Build from raw texts + any tokenizer with ``encode``/``eos_token_id``
+    (HF GPT2Tokenizer or the ByteTokenizer fallback)."""
+
+    def __init__(self, rows: np.ndarray):
+        assert rows.ndim == 2, rows.shape
+        self.rows = rows
+
+    @staticmethod
+    def from_texts(texts: Sequence[str], tokenizer, *, seq_len: int,
+                   drop_remainder: bool = True) -> "PackedLMDataset":
+        eos = getattr(tokenizer, "eos_token_id", 0) or 0
+        docs = [tokenizer.encode(t) for t in texts]
+        return PackedLMDataset(pack_documents(docs, seq_len, eos_id=eos,
+                                              drop_remainder=drop_remainder))
+
+    def __len__(self):
+        return len(self.rows)
+
+    def batches(self, batch_size: int, *, seed: int = 0,
+                shuffle: bool = True, drop_last: bool = True
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        idx = np.arange(len(self.rows))
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        end = len(idx) - (len(idx) % batch_size) if drop_last else len(idx)
+        for i in range(0, end, batch_size):
+            b = self.rows[idx[i:i + batch_size]]
+            yield b, b.copy()
+
+
 class SummarizationDataset:
     """CSV (article, highlights) pairs -> CLM tensors with the reference's
     prompt format: ``article + "\\n\\nTL;DR: " + summary`` and labels =

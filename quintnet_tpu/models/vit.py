@@ -52,6 +52,9 @@ class ViTConfig:
     # knob is wired, not silently ignored: vit_apply threads it to the
     # same block sites GPT-2 uses, gated by the train step's seed)
     dropout: float = 0.0
+    # lax.scan unroll factor over the block stack (perf knob, same
+    # semantics as GPT2Config.scan_unroll)
+    scan_unroll: int = 1
 
     @property
     def needs_dropout(self) -> bool:
@@ -168,6 +171,7 @@ def vit_apply(
         attn_pdrop=cfg.dropout,
         resid_pdrop=cfg.dropout,
         key=k_blocks,
+        scan_unroll=cfg.scan_unroll,
     )
     return vit_head(params["head"], x).astype(jnp.float32)
 

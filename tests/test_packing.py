@@ -1,0 +1,53 @@
+"""Sequence packing (concat-and-chunk): zero padding waste, EOS
+separators, exact row reconstruction. The reference right-pads every
+row instead (utils/Dataloader.py:263-319) — packing is an upgrade, so
+the contract is pinned here.
+"""
+
+import numpy as np
+import pytest
+
+from quintnet_tpu.data import ByteTokenizer, PackedLMDataset, pack_documents
+
+pytestmark = pytest.mark.fast
+
+EOS = 256
+
+
+def test_pack_documents_layout():
+    docs = [[1, 2, 3], [4, 5], [6]]
+    rows = pack_documents(docs, 4, eos_id=EOS)
+    # stream: 1 2 3 E 4 5 E 6 E  -> two full rows of 4, tail dropped
+    flat = [1, 2, 3, EOS, 4, 5, EOS, 6, EOS]
+    assert rows.shape == (2, 4)
+    np.testing.assert_array_equal(rows.ravel(), flat[:8])
+
+
+def test_pack_keep_remainder_pads_with_eos():
+    rows = pack_documents([[1, 2, 3]], 4, eos_id=EOS, drop_remainder=False)
+    np.testing.assert_array_equal(rows, [[1, 2, 3, EOS]])
+    rows = pack_documents([[1, 2, 3, 4]], 4, eos_id=EOS,
+                          drop_remainder=False)
+    # 5-token stream (ids + eos) -> row 2 is eos-padded
+    np.testing.assert_array_equal(rows, [[1, 2, 3, 4],
+                                         [EOS, EOS, EOS, EOS]])
+
+
+def test_packed_dataset_batches_are_label_identical():
+    tok = ByteTokenizer()
+    ds = PackedLMDataset.from_texts(["hello world"] * 8, tok, seq_len=16)
+    assert len(ds) >= 1
+    got = 0
+    for x, y in ds.batches(1, shuffle=False):
+        np.testing.assert_array_equal(x, y)
+        assert x.dtype == np.int32 and x.shape == (1, 16)
+        got += 1
+    assert got == len(ds)
+
+
+def test_packed_rows_contain_no_pad_waste():
+    tok = ByteTokenizer()
+    ds = PackedLMDataset.from_texts(["ab", "cd", "ef"], tok, seq_len=3)
+    # stream: a b E c d E e f E = 9 bytes -> 3 rows, every position real
+    assert ds.rows.shape == (3, 3)
+    assert (ds.rows >= 0).all()

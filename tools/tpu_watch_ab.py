@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Wait for the TPU tunnel to come back, then run the round-4 remat-policy /
+scan-unroll A/B grid and write artifacts/remat_unroll_r04.json.
+
+The tunnel's observed failure modes are UNAVAILABLE errors and silent
+hangs, so availability is probed in a subprocess with a hard timeout.
+Run under tmux: python tools/tpu_watch_ab.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GRID = [
+    ["--remat-policy", "dots"],
+    ["--remat-policy", "dots", "--scan-unroll", "2"],
+    ["--scan-unroll", "2"],
+    ["--scan-unroll", "3"],
+    [],  # default full/1 re-measured in the same session for a fair A/B
+]
+
+
+def tpu_up(timeout=90):
+    code = "import jax; print(len(jax.devices()))"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout)
+        return r.returncode == 0 and r.stdout.strip().isdigit()
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_bench(argv, timeout=1200):
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--steps", "20"] + argv
+    print("::", " ".join(argv) or "(default)", flush=True)
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                       timeout=timeout)
+    line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+    try:
+        d = json.loads(line)
+    except json.JSONDecodeError:
+        d = {"error": "unparseable", "stderr": r.stderr[-300:]}
+    d["argv"] = argv
+    d["rc"] = r.returncode
+    print("  ->", json.dumps({k: d.get(k) for k in
+                              ("value", "vs_baseline", "error")}), flush=True)
+    return d
+
+
+def main():
+    n = 0
+    while not tpu_up():
+        n += 1
+        print(f"tunnel down (probe {n}); sleeping 120s", flush=True)
+        time.sleep(120)
+    print("tunnel is UP — running A/B grid", flush=True)
+    out = []
+    for argv in GRID:
+        out.append(run_bench(argv))
+        with open(os.path.join(REPO, "artifacts/remat_unroll_r04.json"),
+                  "w") as f:
+            json.dump(out, f, indent=1)
+    print("A/B done -> artifacts/remat_unroll_r04.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()
