@@ -1,0 +1,202 @@
+"""Mesh planner: recommend (dp, tp, pp, sp) for a model + chip budget.
+
+The scaling-book recipe is "pick a mesh, annotate shardings, let XLA
+insert collectives, profile, iterate" — this tool automates the *first*
+pick. Given a GPT-2 config, global batch, sequence length and a chip
+budget, it enumerates every legal axis assignment, estimates per-chip
+memory from the framework's actual sharding rules
+(parallel/strategy.py / models/gpt2.py partition specs), rejects plans
+that blow HBM, and ranks survivors by a simple comm-volume heuristic
+(ICI-bytes moved per step — all estimates are order-of-magnitude
+planning aids, not measurements; profile the top pick).
+
+The reference has no planning tooling at all — mesh shapes are
+hand-written YAML (examples/config.yaml:16-24) and a bad pick fails at
+NCCL-init or OOM time. Here a bad pick is rejected on the host in
+milliseconds.
+
+CLI:
+    python -m quintnet_tpu.tools.plan_mesh --model gpt2-medium \
+        --devices 16 --batch 64 --seq 1024 [--hbm-gb 16] [--zero1] \
+        [--vocab-parallel] [--top 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from quintnet_tpu.models.gpt2 import GPT2Config
+
+GB = 1 << 30
+
+# v5e per-chip figures; overridable on the CLI. ICI bandwidth only sets
+# the relative weight of comm vs memory in ranking, so precision is not
+# critical.
+DEFAULT_HBM_GB = 16.0
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+@dataclass(frozen=True)
+class Plan:
+    mesh: Dict[str, int]              # {'dp':..,'tp':..,'pp':..,'sp':..}
+    bytes_per_chip: int               # peak-ish resident bytes
+    comm_bytes_per_step: int          # ICI traffic heuristic
+    breakdown: Dict[str, int]         # component -> bytes
+
+    def describe(self, hbm_bytes: float) -> str:
+        m = self.mesh
+        parts = ", ".join(f"{k}{v}" for k, v in m.items() if v > 1) or "1chip"
+        pct = 100.0 * self.bytes_per_chip / hbm_bytes
+        bd = " + ".join(f"{k} {v / GB:.2f}" for k, v in
+                        sorted(self.breakdown.items(),
+                               key=lambda kv: -kv[1]))
+        return (f"[{parts:>16}] mem {self.bytes_per_chip / GB:6.2f} GiB "
+                f"({pct:5.1f}% HBM) = {bd}; "
+                f"comm ~{self.comm_bytes_per_step / GB:.2f} GiB/step")
+
+
+def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
+             seq: int, zero1: bool = False,
+             remat: bool = True) -> Plan:
+    """Per-chip memory + per-step ICI-traffic estimate for one mesh.
+
+    Mirrors the real sharding rules: blocks are [tp column/row] x
+    [pp stacked-depth] sharded; embeddings/head replicate over tp
+    unless ``cfg.vocab_parallel`` (then wte and the CE shard over tp);
+    optimizer m/v shard over dp when ``zero1``; activations shard batch
+    over dp and sequence over sp. f32 master params + bf16 compute
+    (the shipped default), Adam m+v f32.
+    """
+    dp, tp, pp, sp = (mesh.get(a, 1) for a in ("dp", "tp", "pp", "sp"))
+    d, L, V, H = cfg.n_embd, cfg.n_layer, cfg.table_vocab_size, cfg.n_head
+
+    block_params = L * (12 * d * d + 13 * d) // (tp * pp)
+    embed_params = V * d // (tp if cfg.vocab_parallel else 1) \
+        + cfg.n_positions * d
+    local_params = block_params + embed_params + 2 * d
+
+    b_loc = max(batch // dp, 1)
+    s_loc = max(seq // sp, 1)
+
+    master = 4 * local_params                      # f32 master copy
+    compute = 2 * local_params                     # bf16 cast-at-use copy
+    opt = 8 * (local_params // dp if zero1 else local_params)  # adam m+v
+    grads = 4 * local_params                       # f32 grads at update
+    # activations: the scan stores one residual-stream tensor per layer
+    # (bf16) even under full remat (carry boundaries), plus the block
+    # working set; dense CE materialises f32 logits unless vp/sp/chunked
+    acts = (L // pp) * b_loc * s_loc * d * 2
+    if remat:
+        work = 4 * b_loc * s_loc * d * 2          # one block's live set
+    else:
+        work = (L // pp) * b_loc * s_loc * (13 * d) * 2  # qkv+mlp saved
+    logits = (0 if (cfg.vocab_parallel or cfg.loss_chunk or sp > 1)
+              else 4 * b_loc * s_loc * V)
+    breakdown = {"master": master, "opt": opt, "grads": grads,
+                 "compute": compute, "acts": acts + work, "logits": logits}
+    total = sum(breakdown.values())
+
+    # ICI bytes/step (order of magnitude): tp does 4 allreduces of the
+    # [b, s, d] residual per layer (2 fwd + 2 bwd); dp one grad
+    # allreduce (reduce-scatter+gather when zero1 — same volume); sp
+    # rotates K/V per layer (ring) or two all-to-alls (ulysses ~ same);
+    # pp passes boundaries per microbatch (small) — counted once.
+    act_bytes = b_loc * s_loc * d * 2
+    comm = 0
+    if tp > 1:
+        comm += 4 * (L // pp) * act_bytes * 2 * (tp - 1) // tp
+    if dp > 1:
+        comm += 2 * 4 * local_params * (dp - 1) // dp
+    if sp > 1:
+        comm += (L // pp) * 2 * act_bytes * 2 * (sp - 1) // sp
+    if pp > 1:
+        comm += 2 * act_bytes * pp
+    return Plan(mesh=dict(mesh), bytes_per_chip=total,
+                comm_bytes_per_step=comm, breakdown=breakdown)
+
+
+def plan(cfg: GPT2Config, *, n_devices: int, batch: int, seq: int,
+         hbm_gb: float = DEFAULT_HBM_GB, zero1: bool = False,
+         remat: bool = True, max_pp: Optional[int] = None,
+         use_sp: bool = True) -> List[Plan]:
+    """All legal meshes over ``n_devices``, fitting ones first, each
+    group sorted by the comm heuristic (less ICI traffic first)."""
+    hbm = hbm_gb * GB
+    out = []
+    for tp in _divisors(n_devices):
+        if cfg.n_head % tp:
+            continue
+        if cfg.vocab_parallel and cfg.table_vocab_size % tp:
+            continue
+        for pp in _divisors(n_devices // tp):
+            if cfg.n_layer % pp or (max_pp and pp > max_pp):
+                continue
+            for sp in _divisors(n_devices // (tp * pp)):
+                if not use_sp and sp > 1:
+                    continue
+                if seq % sp or (sp > 1 and (seq // sp) % 2):
+                    continue  # zigzag needs even local chunks
+                dp = n_devices // (tp * pp * sp)
+                if batch % (dp * max(1, pp)):  # pp needs microbatches
+                    continue
+                out.append(estimate(cfg, {"dp": dp, "tp": tp,
+                                          "pp": pp, "sp": sp},
+                                    batch=batch, seq=seq, zero1=zero1,
+                                    remat=remat))
+    out.sort(key=lambda p: (p.bytes_per_chip > hbm,
+                            p.comm_bytes_per_step, p.bytes_per_chip))
+    return out
+
+
+_PRESETS = {"gpt2": GPT2Config.base, "gpt2-base": GPT2Config.base,
+            "gpt2-medium": GPT2Config.medium, "gpt2-large": GPT2Config.large,
+            "gpt2-xl": GPT2Config.xl}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="gpt2",
+                    choices=sorted(_PRESETS))
+    ap.add_argument("--devices", type=int, required=True)
+    ap.add_argument("--batch", type=int, required=True,
+                    help="GLOBAL batch size")
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--hbm-gb", type=float, default=DEFAULT_HBM_GB)
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard adam m/v over dp (parallel/zero.py)")
+    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--vocab-parallel", action="store_true")
+    ap.add_argument("--top", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    cfg = _PRESETS[args.model]()
+    if args.seq > cfg.n_positions:
+        cfg = dataclasses.replace(cfg, n_positions=args.seq)
+    if args.vocab_parallel:
+        cfg = dataclasses.replace(cfg, vocab_parallel=True,
+                                  padded_vocab_size=50304)
+    plans = plan(cfg, n_devices=args.devices, batch=args.batch,
+                 seq=args.seq, hbm_gb=args.hbm_gb, zero1=args.zero1,
+                 remat=not args.no_remat)
+    hbm = args.hbm_gb * GB
+    fitting = [p for p in plans if p.bytes_per_chip <= hbm]
+    print(f"{args.model} | {args.devices} chips x {args.hbm_gb} GiB | "
+          f"global batch {args.batch} seq {args.seq} | "
+          f"{len(fitting)}/{len(plans)} legal meshes fit")
+    for p in plans[: args.top]:
+        tag = "  " if p.bytes_per_chip <= hbm else "✗ "
+        print(tag + p.describe(hbm))
+    if not fitting:
+        print("nothing fits — add chips, enable --zero1 / "
+              "--vocab-parallel, or shrink the batch")
+    return plans
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Mesh planner invariants: estimates must track the real sharding
+rules directionally (exact bytes are heuristic by design).
+"""
+
+import pytest
+
+from quintnet_tpu.models.gpt2 import GPT2Config
+from quintnet_tpu.tools.plan_mesh import GB, estimate, main, plan
+
+pytestmark = pytest.mark.fast
+
+CFG = GPT2Config.base()
+KW = dict(batch=32, seq=1024)
+
+
+def _mem(mesh, cfg=CFG, **kw):
+    return estimate(cfg, mesh, **{**KW, **kw})
+
+
+def test_tp_shards_blocks_not_embed():
+    m1 = _mem({"tp": 1})
+    m2 = _mem({"tp": 2})
+    assert m2.breakdown["master"] < m1.breakdown["master"]
+    # embeddings replicate over tp (no vocab_parallel): the shrink is
+    # strictly less than half
+    assert m2.breakdown["master"] > m1.breakdown["master"] // 2
+
+
+def test_vocab_parallel_shards_wte():
+    import dataclasses
+
+    vp = dataclasses.replace(CFG, vocab_parallel=True,
+                             padded_vocab_size=50304)
+    assert (_mem({"tp": 2}, cfg=vp).breakdown["master"]
+            < _mem({"tp": 2}).breakdown["master"])
+    assert _mem({"tp": 2}, cfg=vp).breakdown["logits"] == 0
+
+
+def test_zero1_divides_optimizer_by_dp():
+    m = _mem({"dp": 4})
+    z = _mem({"dp": 4}, zero1=True)
+    assert z.breakdown["opt"] * 4 == m.breakdown["opt"]
+    assert z.breakdown["master"] == m.breakdown["master"]
+
+
+def test_sp_shards_activations_and_kills_dense_logits():
+    m1, m2 = _mem({"sp": 1}), _mem({"sp": 2})
+    assert m2.breakdown["acts"] < m1.breakdown["acts"]
+    assert m1.breakdown["logits"] > 0 and m2.breakdown["logits"] == 0
+
+
+def test_remat_cuts_activation_memory():
+    assert (_mem({"dp": 1}, remat=True).breakdown["acts"]
+            < _mem({"dp": 1}, remat=False).breakdown["acts"])
+
+
+def test_plan_rejects_illegal_axes():
+    plans = plan(CFG, n_devices=8, **KW)
+    for p in plans:
+        assert CFG.n_head % p.mesh["tp"] == 0
+        assert CFG.n_layer % p.mesh["pp"] == 0
+        assert KW["seq"] % p.mesh["sp"] == 0
+        size = 1
+        for v in p.mesh.values():
+            size *= v
+        assert size == 8
+    # tp=8 is legal for 12 heads? no: 12 % 8 != 0
+    assert not any(p.mesh["tp"] == 8 for p in plans)
+
+
+def test_plan_sorts_fitting_first():
+    plans = plan(CFG, n_devices=8, batch=32, seq=1024, hbm_gb=0.9)
+    fits = [p.bytes_per_chip <= 0.9 * GB for p in plans]
+    assert fits == sorted(fits, reverse=True)
+
+
+def test_cli_smoke(capsys):
+    main(["--model", "gpt2-medium", "--devices", "8", "--batch", "32"])
+    out = capsys.readouterr().out
+    assert "legal meshes fit" in out and "GiB" in out
