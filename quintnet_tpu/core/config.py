@@ -111,6 +111,9 @@ class TrainingConfig:
     # GPT2Trainer value); an explicit 0.0 really means no decay
     weight_decay: Optional[float] = None
     optimizer: str = "adam"  # adam | adamw | zero1_adamw
+    # "bfloat16" stores Adam's FIRST moment in bf16 (halves that state;
+    # nu stays f32 — second moments span too many decades for bf16)
+    adam_mu_dtype: str = "float32"
     # LR schedule (the reference trains at a constant lr everywhere —
     # trainer.py:89, GPT2_Trainer.py:100-104; schedules are an upgrade):
     # constant | cosine | linear. warmup_steps prepends a linear 0->lr

@@ -42,6 +42,37 @@ from quintnet_tpu.nn.layers import gelu, layer_norm_apply
 from quintnet_tpu.nn.transformer import block_decode, block_prefill
 
 
+def sample_logits(logits, key, *, temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0):
+    """Sample next tokens from [B, V] logits: temperature, then top-k
+    truncation, then nucleus (top-p). ``temperature <= 0`` is greedy
+    argmax regardless of the filters (matches HF semantics; the
+    reference supports greedy only, utils/metrics.py:74-149).
+
+    Static-shape throughout: top-k thresholds against the k-th largest
+    logit; top-p sorts the full vocab once per step (eval-time cost,
+    fine off the training path)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    neg = jnp.finfo(logits.dtype).min
+    if top_k and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if 0.0 < top_p < 1.0:
+        srt, idx = lax.top_k(logits, logits.shape[-1])  # desc sort
+        probs = jax.nn.softmax(srt, axis=-1)
+        # drop tokens whose preceding cumulative mass already reached
+        # top_p (the first token crossing the threshold is KEPT)
+        drop = jnp.cumsum(probs, axis=-1) - probs > top_p
+        srt = jnp.where(drop, neg, srt)
+        # un-sort: position j of the sorted row goes back to column
+        # idx[j]; argsort(idx) inverts the permutation
+        inv = jnp.argsort(idx, axis=-1)
+        logits = jnp.take_along_axis(srt, inv, axis=-1)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def _local_heads(cfg: GPT2Config, tp_axis: Optional[str]) -> int:
     if tp_axis is None:
         return cfg.n_head
@@ -121,18 +152,18 @@ def gpt2_decode_step(params, tok, pos, caches, cfg: GPT2Config,
 
 def _generate_body(params, input_ids, key, cfg: GPT2Config,
                    max_new_tokens: int, eos_token_id: Optional[int],
-                   temperature: float, tp_axis: Optional[str] = None):
+                   temperature: float, tp_axis: Optional[str] = None,
+                   top_k: int = 0, top_p: float = 1.0):
     B, T0 = input_ids.shape
     cache_len = T0 + max_new_tokens
     logits0, caches = gpt2_prefill(params, input_ids, cfg,
                                    cache_len=cache_len, tp_axis=tp_axis)
 
     def pick(logits, k):
-        if temperature > 0.0:
-            # same key on every tp rank (replicated inputs) -> same
-            # sample; no cross-rank divergence to reconcile
-            return jax.random.categorical(k, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        # same key on every tp rank (replicated inputs) -> same
+        # sample; no cross-rank divergence to reconcile
+        return sample_logits(logits, k, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
 
     def step(carry, _):
         tok, pos, caches, done, k = carry
@@ -158,14 +189,17 @@ def _generate_body(params, input_ids, key, cfg: GPT2Config,
 
 
 _generate_jit = partial(jax.jit, static_argnames=(
-    "cfg", "max_new_tokens", "eos_token_id", "temperature"))(_generate_body)
+    "cfg", "max_new_tokens", "eos_token_id", "temperature",
+    "top_k", "top_p"))(_generate_body)
 
 
 def gpt2_generate(params, input_ids, cfg: GPT2Config, *,
                   max_new_tokens: int, eos_token_id: Optional[int] = None,
-                  temperature: float = 0.0, key=None) -> np.ndarray:
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0, key=None) -> np.ndarray:
     """input_ids [B, T0] -> [B, T0 + max_new_tokens] (greedy when
-    ``temperature == 0``). One jitted program: prefill + scan decode."""
+    ``temperature == 0``; ``top_k``/``top_p`` filter the sampling
+    distribution). One jitted program: prefill + scan decode."""
     if max_new_tokens < 1:
         return np.asarray(input_ids)
     if input_ids.shape[1] + max_new_tokens > cfg.n_positions:
@@ -175,14 +209,16 @@ def gpt2_generate(params, input_ids, cfg: GPT2Config, *,
     key = key if key is not None else jax.random.key(0)
     out = _generate_jit(params, jnp.asarray(input_ids, jnp.int32), key,
                         cfg, int(max_new_tokens), eos_token_id,
-                        float(temperature))
+                        float(temperature), top_k=int(top_k),
+                        top_p=float(top_p))
     return np.asarray(out)
 
 
 def gpt2_generate_tp(params, input_ids, cfg: GPT2Config, *, mesh,
                      tp_axis: str = "tp", max_new_tokens: int,
                      eos_token_id: Optional[int] = None,
-                     temperature: float = 0.0, key=None) -> np.ndarray:
+                     temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 1.0, key=None) -> np.ndarray:
     """TP-sharded generation over a live mesh.
 
     ``params`` must be in the tp layout (gpt2_to_tp_layout) and sharded
@@ -207,14 +243,16 @@ def gpt2_generate_tp(params, input_ids, cfg: GPT2Config, *, mesh,
             f"exceeds n_positions={cfg.n_positions}")
     key = key if key is not None else jax.random.key(0)
     fn = _tp_generate_fn(cfg, mesh, tp_axis, int(max_new_tokens),
-                         eos_token_id, float(temperature))
+                         eos_token_id, float(temperature), int(top_k),
+                         float(top_p))
     return np.asarray(fn(params, jnp.asarray(input_ids, jnp.int32), key))
 
 
 @functools.lru_cache(maxsize=32)
 def _tp_generate_fn(cfg: GPT2Config, mesh, tp_axis: str,
                     max_new_tokens: int, eos_token_id: Optional[int],
-                    temperature: float):
+                    temperature: float, top_k: int = 0,
+                    top_p: float = 1.0):
     """One cached jitted shard_map program per (cfg, mesh, decode
     params) — a fresh closure per call would defeat the jit cache and
     recompile the whole prefill+decode every generation batch."""
@@ -227,7 +265,8 @@ def _tp_generate_fn(cfg: GPT2Config, mesh, tp_axis: str,
 
     def local_gen(p, ids, k):
         return _generate_body(p, ids, k, cfg, max_new_tokens,
-                              eos_token_id, temperature, tp_axis=tp_axis)
+                              eos_token_id, temperature, tp_axis=tp_axis,
+                              top_k=top_k, top_p=top_p)
 
     return jax.jit(cc.shard_map_fn(
         local_gen, mesh,

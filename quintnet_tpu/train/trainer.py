@@ -117,11 +117,14 @@ def make_optimizer(cfg: Config) -> optax.GradientTransformation:
     if name.startswith("zero1_"):
         name = name[len("zero1_"):]
     lr = make_lr_schedule(cfg)
+    # mu_dtype=bfloat16 halves the first-moment memory (planner: 'opt'
+    # row); nu stays f32 (second moments span too many decades for bf16)
+    mu = jnp.bfloat16 if t.adam_mu_dtype == "bfloat16" else None
     if name == "adam":
-        return optax.adam(lr)
+        return optax.adam(lr, mu_dtype=mu)
     if name == "adamw":
         return optax.chain(
-            optax.scale_by_adam(),
+            optax.scale_by_adam(mu_dtype=mu),
             masked_decay(0.01 if t.weight_decay is None
                          else t.weight_decay),
             optax.scale_by_learning_rate(lr),

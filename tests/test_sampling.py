@@ -1,0 +1,104 @@
+"""top-k / top-p sampling filters (sample_logits) and the bf16
+first-moment optimizer option.
+
+The reference's generation is greedy-only (utils/metrics.py:74-149) and
+its optimizers are all-f32; both knobs here are upgrades whose contracts
+are pinned by these tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quintnet_tpu.models.gpt2_generate import sample_logits
+
+pytestmark = pytest.mark.fast
+
+
+def _logits():
+    # strongly ordered distribution over 8 tokens
+    return jnp.asarray([[8.0, 6.0, 5.0, 2.0, 1.0, 0.5, 0.2, 0.1]])
+
+
+def test_greedy_ignores_filters():
+    out = sample_logits(_logits(), jax.random.key(0), temperature=0.0,
+                        top_k=3, top_p=0.5)
+    assert int(out[0]) == 0
+
+
+def test_top_k_restricts_support():
+    ks = jax.random.split(jax.random.key(1), 200)
+    toks = {int(sample_logits(_logits(), k, temperature=5.0, top_k=3)[0])
+            for k in ks}
+    assert toks <= {0, 1, 2} and len(toks) > 1  # hot temp still samples
+
+
+def test_top_k_one_is_argmax():
+    for i in range(5):
+        out = sample_logits(_logits(), jax.random.key(i),
+                            temperature=1.0, top_k=1)
+        assert int(out[0]) == 0
+
+
+def test_top_p_keeps_first_crossing_token():
+    # probs ~ softmax: p0 dominates; tiny top_p must still keep token 0
+    for i in range(5):
+        out = sample_logits(_logits(), jax.random.key(i),
+                            temperature=1.0, top_p=1e-6)
+        assert int(out[0]) == 0
+
+
+def test_top_p_restricts_support():
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    ks = jax.random.split(jax.random.key(2), 300)
+    toks = {int(sample_logits(logits, k, temperature=1.0, top_p=0.8)[0])
+            for k in ks}
+    # cumulative: 0.5, 0.8, 0.95 -> token 1 crosses 0.8 and is kept,
+    # tokens 2/3 dropped
+    assert toks == {0, 1}
+
+
+def test_unsort_is_correct_per_row():
+    # two rows with different orderings; same filter must track each row
+    logits = jnp.asarray([[1.0, 9.0, 2.0, 0.0],
+                          [0.0, 2.0, 9.0, 1.0]])
+    ks = jax.random.split(jax.random.key(3), 100)
+    for k in ks[:50]:
+        out = sample_logits(logits, k, temperature=1.0, top_k=1)
+        assert int(out[0]) == 1 and int(out[1]) == 2
+
+
+def test_generate_with_filters_runs():
+    from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+    from quintnet_tpu.models.gpt2_generate import gpt2_generate
+
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    ids = np.zeros((2, 4), np.int32)
+    out = gpt2_generate(params, ids, cfg, max_new_tokens=3,
+                        temperature=0.8, top_k=10, top_p=0.9,
+                        key=jax.random.key(7))
+    assert out.shape == (2, 7)
+    assert (out[:, :4] == ids).all()
+
+
+def test_adam_mu_dtype_bf16():
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.train.trainer import make_optimizer
+
+    cfg = Config.from_dict(
+        {"training": {"optimizer": "adamw", "adam_mu_dtype": "bfloat16"}})
+    opt = make_optimizer(cfg)
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    state = opt.init(params)
+    mu = state[0].mu  # scale_by_adam state in the chain
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(mu))
+    nu = state[0].nu
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(nu))
+    # an update step still works and returns param-dtype updates
+    g = jax.tree.map(jnp.ones_like, params)
+    up, _ = opt.update(g, state, params)
+    assert jax.tree.leaves(up)[0].dtype == jnp.float32
