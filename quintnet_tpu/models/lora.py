@@ -177,3 +177,56 @@ def lora_upcast(lora, dtype=jnp.float32):
     adapters in f32 while the frozen base stays bf16 is the standard
     memory/stability split."""
     return jax.tree.map(lambda l: l.astype(dtype), lora)
+
+
+def _flatten(lora) -> Dict[str, jnp.ndarray]:
+    out = {}
+
+    def walk(node, prefix):
+        for k, v in node.items():
+            name = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                walk(v, name)
+            else:
+                out[name] = v
+
+    walk(lora, "")
+    return out
+
+
+def save_lora(lora, cfg: LoRAConfig, path: str):
+    """Adapters -> one safetensors file (dotted-path keys + the LoRA
+    hyperparams in the header metadata); pairs with :func:`load_lora`.
+    Tiny by construction — adapters ship separately from the base
+    checkpoint, HF-peft style."""
+    import numpy as np
+
+    from quintnet_tpu.utils.safetensors_io import save_file
+
+    meta = {"lora_rank": str(cfg.rank), "lora_alpha": str(cfg.alpha),
+            "lora_targets": ",".join(cfg.targets)}
+    save_file({k: np.asarray(v) for k, v in _flatten(lora).items()},
+              path, metadata=meta)
+
+
+def load_lora(path: str) -> Tuple[Dict, LoRAConfig]:
+    """(adapter tree, LoRAConfig) back from :func:`save_lora`."""
+    from quintnet_tpu.utils.safetensors_io import SafeTensorFile
+
+    with SafeTensorFile(path) as r:
+        meta = r.metadata or {}
+        tree: Dict = {}
+        for name in r.keys():
+            sub = tree
+            parts = name.split(".")
+            for k in parts[:-1]:
+                sub = sub.setdefault(k, {})
+            # materialised copy: the mmap closes at `with` exit, so no
+            # zero-copy views may outlive it
+            sub[parts[-1]] = jnp.asarray(r.tensor(name))
+    cfg = LoRAConfig(
+        rank=int(meta.get("lora_rank", 8)),
+        alpha=float(meta.get("lora_alpha", 16.0)),
+        targets=tuple(meta.get("lora_targets",
+                               ",".join(DEFAULT_TARGETS)).split(",")))
+    return tree, cfg

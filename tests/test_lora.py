@@ -97,3 +97,16 @@ def test_merged_model_generates(base):
     out = gpt2_generate(merged, np.zeros((1, 4), np.int32), CFG,
                         max_new_tokens=2)
     assert out.shape == (1, 6)
+
+
+def test_lora_save_load_roundtrip(base, tmp_path):
+    from quintnet_tpu.models.lora import load_lora, save_lora
+
+    params, _ = base
+    lora = lora_init(jax.random.key(3), params["blocks"], LCFG)
+    p = str(tmp_path / "adapters.safetensors")
+    save_lora(lora, LCFG, p)
+    back, cfg2 = load_lora(p)
+    assert cfg2 == LCFG
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 lora, back)
