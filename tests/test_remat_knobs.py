@@ -54,3 +54,39 @@ def test_scan_unroll_matches_unrolled(setup, unroll):
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
         grads, base_grads)
+
+
+def test_dots_policy_under_sharded_strategy():
+    """remat='dots' must survive the full shard_map train step (the
+    string rides through ModelSpec -> stacked_blocks_apply untouched)."""
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.models.vit import ViTConfig, vit_init, vit_model_spec
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    vcfg = ViTConfig(image_size=28, patch_size=7, in_channels=1,
+                     hidden_dim=16, depth=4, num_heads=2, num_classes=10)
+    cfg = Config.from_dict({
+        "mesh_dim": [2, 2], "mesh_name": ["dp", "tp"],
+        "training": {"batch_size": 8, "grad_clip_norm": None,
+                     "remat": True, "remat_policy": "dots"},
+    })
+    params = vit_init(jax.random.key(0), vcfg)
+    x = jax.random.normal(jax.random.key(1), (8, 28, 28, 1))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 10)
+    opt = optax.sgd(0.05)
+
+    losses = {}
+    for remat in (False, cfg.training.remat_mode):
+        strat = get_strategy("dp_tp", cfg)
+        model = vit_model_spec(vcfg, remat=remat)
+        # fresh copies: the train step donates its param buffers, and
+        # shard_params may alias the host tree's arrays
+        p = strat.shard_params(model, jax.tree.map(jnp.array, params))
+        s = strat.init_opt_state(model, opt, p)
+        b = strat.shard_batch((x, y))
+        p2, _, loss = strat.make_train_step(model, opt)(p, s, b)
+        losses[remat] = float(loss)
+    assert cfg.training.remat_mode == "dots"
+    np.testing.assert_allclose(losses[False], losses["dots"], rtol=1e-5)
