@@ -78,6 +78,7 @@ def test_dots_policy_under_sharded_strategy():
     opt = optax.sgd(0.05)
 
     losses = {}
+    updated = {}
     for remat in (False, cfg.training.remat_mode):
         strat = get_strategy("dp_tp", cfg)
         model = vit_model_spec(vcfg, remat=remat)
@@ -88,5 +89,12 @@ def test_dots_policy_under_sharded_strategy():
         b = strat.shard_batch((x, y))
         p2, _, loss = strat.make_train_step(model, opt)(p, s, b)
         losses[remat] = float(loss)
+        updated[remat] = jax.device_get(p2)
     assert cfg.training.remat_mode == "dots"
     np.testing.assert_allclose(losses[False], losses["dots"], rtol=1e-5)
+    # the post-update params pin the GRADIENTS equal too (loss alone is
+    # computed pre-update and could not catch a wrong dots backward)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-6),
+        updated[False], updated["dots"])
