@@ -36,9 +36,13 @@ def main():
                     help="n_experts: turn the model into a GPT-2-MoE "
                          "(shard them with an 'ep' mesh axis)")
     ap.add_argument("--gen-eval", type=int, default=0, metavar="N",
-                    help="after training, greedy-generate summaries for "
+                    help="after training, generate summaries for "
                          "N val samples (KV-cache decoder) and report "
-                         "ROUGE-1/2/L + BLEU")
+                         "ROUGE-1/2/L + BLEU (greedy unless --gen-temp)")
+    ap.add_argument("--gen-temp", type=float, default=0.0,
+                    help="sampling temperature for --gen-eval (0=greedy)")
+    ap.add_argument("--gen-top-k", type=int, default=0)
+    ap.add_argument("--gen-top-p", type=float, default=1.0)
     from quintnet_tpu.examples.common import add_multihost_args
 
     add_multihost_args(ap)
@@ -162,7 +166,11 @@ def main():
         scores = evaluate_generation(
             host, gcfg, prompts, tok,
             max_new_tokens=min(64, gcfg.n_positions - max_prompt),
-            eos_token_id=getattr(tok, "eos_token_id", None))
+            eos_token_id=getattr(tok, "eos_token_id", None),
+            temperature=args.gen_temp, top_k=args.gen_top_k,
+            top_p=args.gen_top_p,
+            key=jax.random.key(cfg.training.seed) if args.gen_temp
+            else None)
         print("generation eval:",
               {k: round(v, 4) for k, v in scores.items()})
     return hist

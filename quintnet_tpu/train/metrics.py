@@ -120,6 +120,8 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                         max_new_tokens: int = 64,
                         eos_token_id: int | None = None,
                         batch_size: int = 8,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 1.0, key=None,
                         mesh=None, tp_axis: str = "tp") -> Dict[str, float]:
     """Generate continuations with the KV-cache decoder and score
     ROUGE-1/2/L + BLEU against references (reference evaluate_generation:
@@ -153,15 +155,17 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                 # prefill+decode costs far more than the wasted rows
                 pad = np.repeat(batch[-1:], batch_size - len(grp), axis=0)
                 batch = np.concatenate([batch, pad], axis=0)
+            sample = dict(temperature=temperature, top_k=top_k,
+                          top_p=top_p, key=key)
             if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
                 out = gpt2_generate_tp(params, batch, cfg, mesh=mesh,
                                        tp_axis=tp_axis,
                                        max_new_tokens=max_new_tokens,
-                                       eos_token_id=eos_token_id)
+                                       eos_token_id=eos_token_id, **sample)
             else:
                 out = gpt2_generate(params, batch, cfg,
                                     max_new_tokens=max_new_tokens,
-                                    eos_token_id=eos_token_id)
+                                    eos_token_id=eos_token_id, **sample)
             for row, i in zip(out, grp):
                 new = row[n:]
                 if eos_token_id is not None:
