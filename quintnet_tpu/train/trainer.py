@@ -144,6 +144,26 @@ class History:
     best_val_loss: float = float("inf")
     best_epoch: int = -1
 
+    def to_jsonl(self, path: str):
+        """One JSON line per epoch (loss/metrics) + a final summary line
+        — greppable run record (the reference's only run record is
+        stdout scrollback)."""
+        import json
+
+        with open(path, "w") as f:
+            for i, tl in enumerate(self.train_loss):
+                row = {"epoch": i, "train_loss": tl}
+                for name, series in (("val_loss", self.val_loss),
+                                     ("train_metric", self.train_metric),
+                                     ("val_metric", self.val_metric)):
+                    if i < len(series):
+                        row[name] = series[i]
+                f.write(json.dumps(row) + "\n")
+            f.write(json.dumps({
+                "wall_time_s": round(self.wall_time_s, 2),
+                "best_val_loss": self.best_val_loss,
+                "best_epoch": self.best_epoch}) + "\n")
+
 
 class Trainer:
     """fit() over (x, y) batch iterables.
@@ -326,6 +346,7 @@ class Trainer:
             # .item() every step; so did round 1's float(loss)). Host
             # reads happen only at log boundaries and epoch end.
             losses = []
+            t_win = time.time()
             for i, (xb, yb) in enumerate(train_batches_fn(epoch)):
                 batch = self.strategy.shard_batch(
                     (jnp.asarray(xb), jnp.asarray(yb)), self.model)
@@ -337,9 +358,17 @@ class Trainer:
                                                        batch, seed)
                 losses.append(loss)
                 if log_every and (i + 1) % log_every == 0:
-                    window = jnp.mean(jnp.stack(losses[-log_every:]))
-                    self.log(f"epoch {epoch} step {i + 1}: "
-                             f"loss {float(window):.4f}")
+                    # the float() is the device sync for the window, so
+                    # the wall clock measured here is honest throughput
+                    window = float(jnp.mean(jnp.stack(losses[-log_every:])))
+                    dt = time.time() - t_win
+                    sps = log_every * len(xb) / max(dt, 1e-9)
+                    msg = (f"epoch {epoch} step {i + 1}: "
+                           f"loss {window:.4f} | {sps:.1f} samples/s")
+                    if self.task_type == "clm":
+                        msg += f" ({sps * xb.shape[1] / 1e3:.1f}k tok/s)"
+                    self.log(msg)
+                    t_win = time.time()
             train_loss = (float(jnp.mean(jnp.stack(losses)))
                           if losses else float("nan"))
             hist.train_loss.append(train_loss)

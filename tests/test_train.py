@@ -118,3 +118,20 @@ def test_trainer_resume(tmp_path):
                  log_fn=lambda s: None)
     params, opt_state, start = t2.resume_or_init()
     assert start == 1  # resumes after epoch 0
+
+
+def test_history_to_jsonl(tmp_path):
+    import json
+
+    from quintnet_tpu.train.trainer import History
+
+    h = History(train_loss=[2.0, 1.5], val_loss=[1.8],
+                val_metric=[0.5], wall_time_s=3.2,
+                best_val_loss=1.8, best_epoch=0)
+    p = str(tmp_path / "hist.jsonl")
+    h.to_jsonl(p)
+    rows = [json.loads(l) for l in open(p)]
+    assert rows[0] == {"epoch": 0, "train_loss": 2.0, "val_loss": 1.8,
+                       "val_metric": 0.5}
+    assert rows[1] == {"epoch": 1, "train_loss": 1.5}
+    assert rows[-1]["best_epoch"] == 0 and rows[-1]["wall_time_s"] == 3.2

@@ -25,6 +25,14 @@ GRID = [
     [],  # default full/1 re-measured in the same session for a fair A/B
 ]
 
+# After the A/B: re-confirm the new flash tile defaults and one MoE
+# point on the same session -> artifacts/confirm_r04.json
+CONFIRM = [
+    ["--model", "flash-attn", "--seq", "8192", "--steps", "30"],
+    ["--model", "flash-attn", "--seq", "4096", "--steps", "30"],
+    ["--model", "gpt2-moe", "--steps", "20"],
+]
+
 
 def tpu_up(timeout=90):
     code = "import jax; print(len(jax.devices()))"
@@ -68,6 +76,13 @@ def main():
                   "w") as f:
             json.dump(out, f, indent=1)
     print("A/B done -> artifacts/remat_unroll_r04.json", flush=True)
+    out = []
+    for argv in CONFIRM:
+        out.append(run_bench(argv))
+        with open(os.path.join(REPO, "artifacts/confirm_r04.json"),
+                  "w") as f:
+            json.dump(out, f, indent=1)
+    print("confirm done -> artifacts/confirm_r04.json", flush=True)
 
 
 if __name__ == "__main__":
