@@ -9,6 +9,7 @@ from quintnet_tpu.data.datasets import (
     load_mnist,
     make_batches,
     pack_documents,
+    prefetch_batches,
 )
 
 __all__ = [
@@ -19,4 +20,5 @@ __all__ = [
     "load_mnist",
     "make_batches",
     "pack_documents",
+    "prefetch_batches",
 ]

@@ -219,6 +219,38 @@ class ByteTokenizer:
                                                             errors="replace")
 
 
+def prefetch_batches(batches: Iterator, n: int = 2) -> Iterator:
+    """Run the host-side batch pipeline (tokenise/stack/shuffle) in a
+    background thread, keeping up to ``n`` batches ready. JAX's async
+    dispatch already overlaps device compute with the *next* Python
+    iteration; this additionally overlaps slow host data work (CSV
+    tokenisation, HF arrow reads) with the whole step, which matters
+    once datasets stop being synthetic. Exceptions re-raise at the
+    consuming site."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=n)
+    _END = object()
+
+    def feed():
+        try:
+            for b in batches:
+                q.put(b)
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            q.put(e)
+
+    threading.Thread(target=feed, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
 def pack_documents(docs: Sequence[Sequence[int]], seq_len: int,
                    *, eos_id: int, drop_remainder: bool = True
                    ) -> np.ndarray:

@@ -51,3 +51,18 @@ def test_packed_rows_contain_no_pad_waste():
     # stream: a b E c d E e f E = 9 bytes -> 3 rows, every position real
     assert ds.rows.shape == (3, 3)
     assert (ds.rows >= 0).all()
+
+
+def test_prefetch_batches_order_and_exception():
+    from quintnet_tpu.data import prefetch_batches
+
+    assert list(prefetch_batches(iter(range(7)), n=2)) == list(range(7))
+
+    def boom():
+        yield 1
+        raise ValueError("host pipeline died")
+
+    it = prefetch_batches(boom(), n=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="host pipeline died"):
+        next(it)
