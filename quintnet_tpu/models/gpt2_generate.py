@@ -214,6 +214,118 @@ def gpt2_generate(params, input_ids, cfg: GPT2Config, *,
     return np.asarray(out)
 
 
+def _beam_body(params, input_ids, cfg: GPT2Config, beams: int,
+               max_new_tokens: int, eos_token_id: Optional[int],
+               length_penalty: float):
+    B, T0 = input_ids.shape
+    K = beams
+    V = cfg.table_vocab_size if cfg.padded_vocab_size else cfg.vocab_size
+    cache_len = T0 + max_new_tokens
+    neg = jnp.float32(-1e30)
+
+    logits0, caches = gpt2_prefill(params, input_ids, cfg,
+                                   cache_len=cache_len)
+    # expand to B*K rows (beam-major inside each batch row)
+    caches = jax.tree.map(
+        lambda c: jnp.repeat(c, K, axis=1), caches)   # [L, B*K, H, T, Dh]
+    logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), axis=-1)
+
+    # first expansion: top-K distinct tokens seed the K beams (scoring
+    # all beams from identical states would return K copies of one beam)
+    s0, t0 = lax.top_k(logp0, K)                      # [B, K]
+    scores = s0
+    done = (jnp.zeros((B, K), bool) if eos_token_id is None
+            else t0 == eos_token_id)
+    toks = jnp.full((B, K, max_new_tokens), 0, jnp.int32)
+    toks = toks.at[:, :, 0].set(t0)
+
+    def step(carry, i):
+        scores, done, toks, caches = carry
+        tok = lax.dynamic_index_in_dim(toks, i - 1, axis=2,
+                                       keepdims=False)  # [B, K]
+        logits, caches = gpt2_decode_step(
+            params, tok.reshape(B * K), jnp.int32(T0) + i - 1, caches, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, K, V)
+        if eos_token_id is not None:
+            # finished beams may only re-emit EOS at zero cost, so their
+            # score freezes and they stay comparable to live beams
+            only_eos = jnp.full((V,), neg).at[eos_token_id].set(0.0)
+            logp = jnp.where(done[:, :, None], only_eos[None, None, :],
+                             logp)
+        total = scores[:, :, None] + logp               # [B, K, V]
+        flat_s, flat_i = lax.top_k(total.reshape(B, K * V), K)
+        parent = flat_i // V                             # [B, K]
+        token = (flat_i % V).astype(jnp.int32)
+
+        # reindex beam state to the selected parents
+        batch_idx = jnp.arange(B)[:, None]
+        toks = toks[batch_idx, parent]                   # [B, K, T_new]
+        toks = toks.at[:, :, i].set(token)
+        done = done[batch_idx, parent]
+        if eos_token_id is not None:
+            done = done | (token == eos_token_id)
+        flat_parent = (parent + jnp.arange(B)[:, None] * K).reshape(-1)
+        caches = jax.tree.map(lambda c: c[:, flat_parent], caches)
+        return (flat_s, done, toks, caches), None
+
+    (scores, done, toks, _), _ = lax.scan(
+        step, (scores, done, toks, caches),
+        jnp.arange(1, max_new_tokens))
+
+    # pick the best beam by length-normalised score (GNMT-style);
+    # length = tokens up to and including the first EOS
+    if eos_token_id is not None:
+        first_eos = jnp.argmax(toks == eos_token_id, axis=2)  # 0 if none
+        has_eos = jnp.any(toks == eos_token_id, axis=2)
+        lengths = jnp.where(has_eos, first_eos + 1, max_new_tokens)
+    else:
+        lengths = jnp.full((B, K), max_new_tokens)
+    norm = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    best = jnp.argmax(norm, axis=1)                      # [B]
+    best_toks = toks[jnp.arange(B), best]                # [B, T_new]
+    if eos_token_id is not None:
+        # pad everything after the first EOS with EOS (same observable
+        # convention as sampling/greedy decode)
+        pos = jnp.arange(max_new_tokens)[None, :]
+        cut = jnp.where(jnp.any(best_toks == eos_token_id, axis=1),
+                        jnp.argmax(best_toks == eos_token_id, axis=1),
+                        max_new_tokens)[:, None]
+        best_toks = jnp.where(pos > cut, eos_token_id, best_toks)
+    return jnp.concatenate([input_ids, best_toks], axis=1)
+
+
+_beam_jit = partial(jax.jit, static_argnames=(
+    "cfg", "beams", "max_new_tokens", "eos_token_id",
+    "length_penalty"))(_beam_body)
+
+
+def gpt2_beam_search(params, input_ids, cfg: GPT2Config, *, beams: int = 4,
+                     max_new_tokens: int,
+                     eos_token_id: Optional[int] = None,
+                     length_penalty: float = 1.0) -> np.ndarray:
+    """Beam-search decode with the KV cache: [B, T0] ->
+    [B, T0 + max_new_tokens], best of ``beams`` by length-normalised
+    log-probability (GNMT penalty).
+
+    One jitted program, static shapes: beams ride a B*K row dimension,
+    each step re-indexes the caches to the selected parents inside the
+    scan. ``beams=1`` reduces exactly to greedy decode
+    (tests/test_beam.py golden). The reference has greedy only
+    (utils/metrics.py:74-149).
+    """
+    if max_new_tokens < 1:
+        return np.asarray(input_ids)
+    if input_ids.shape[1] + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt {input_ids.shape[1]} + max_new {max_new_tokens} "
+            f"exceeds n_positions={cfg.n_positions}")
+    out = _beam_jit(params, jnp.asarray(input_ids, jnp.int32), cfg,
+                    int(beams), int(max_new_tokens), eos_token_id,
+                    float(length_penalty))
+    return np.asarray(out)
+
+
 def gpt2_generate_tp(params, input_ids, cfg: GPT2Config, *, mesh,
                      tp_axis: str = "tp", max_new_tokens: int,
                      eos_token_id: Optional[int] = None,
