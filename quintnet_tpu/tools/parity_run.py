@@ -34,6 +34,23 @@ VIT_EPOCHS = 10
 GPT2_EPOCHS = 3
 
 
+def _fingerprint(*arrays) -> str:
+    """Stable hash of the dataset tensors feeding a leg. The report
+    refuses to compare legs with different fingerprints — a stale
+    artifact from an older synthetic-data generation otherwise produces
+    a bogus parity verdict (this bit round 4: a timed-out 3d leg left a
+    round-2 file behind and the report happily diffed across dataset
+    versions)."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for a in arrays:
+        import numpy as np
+
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:12]
+
+
 def _setup(mode: str):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
@@ -84,6 +101,7 @@ def run_vit(mode: str) -> dict:
     )
     return {
         "task": "vit", "mode": mode, "mesh": dict(strategy.mesh.shape),
+        "data_fp": _fingerprint(xtr, ytr, xte, yte),
         "epochs": VIT_EPOCHS,
         "train_loss": hist.train_loss,
         "val_loss": hist.val_loss,
@@ -130,8 +148,10 @@ def run_gpt2(mode: str) -> dict:
         lambda ep: train.batches(16, seed=ep),
         val_batches_fn=lambda ep: val.batches(16, shuffle=False),
     )
+    xb0, yb0 = next(iter(train.batches(16, seed=0)))
     return {
         "task": "gpt2", "mode": mode, "mesh": dict(strategy.mesh.shape),
+        "data_fp": _fingerprint(xb0, yb0),
         "epochs": GPT2_EPOCHS,
         "train_loss": hist.train_loss,
         "val_loss": hist.val_loss,
@@ -166,6 +186,14 @@ def report() -> str:
             ("gpt2", "val_perplexity", "val ppl")):
         s = load(task, "single")
         d = load(task, "3d")
+        if s.get("data_fp") != d.get("data_fp"):
+            lines += [f"## {task.upper()}", "",
+                      f"**INCOMPARABLE** — dataset fingerprints differ "
+                      f"(single: {s.get('data_fp')}, 3d: "
+                      f"{d.get('data_fp')}); one leg is stale. Rerun "
+                      f"`python -m quintnet_tpu.tools.parity_run --task "
+                      f"{task} --mode <stale mode>`.", ""]
+            continue
         lines += [f"## {task.upper()} ({s['epochs']} epochs)", "",
                   f"| epoch | train loss (1 dev) | train loss (3D) | "
                   f"rel diff | {metric_name} (1 dev) | {metric_name} (3D) |",

@@ -135,3 +135,26 @@ def test_history_to_jsonl(tmp_path):
                        "val_metric": 0.5}
     assert rows[1] == {"epoch": 1, "train_loss": 1.5}
     assert rows[-1]["best_epoch"] == 0 and rows[-1]["wall_time_s"] == 3.2
+
+
+def test_parity_report_flags_stale_legs(tmp_path, monkeypatch):
+    import json
+
+    from quintnet_tpu.tools import parity_run
+
+    art = tmp_path / "parity"
+    art.mkdir()
+    base = {"epochs": 1, "train_loss": [1.0], "val_accuracy": [0.5],
+            "val_perplexity": [3.0], "wall_time_s": 1.0}
+    for task in ("vit", "gpt2"):
+        mkey = "val_accuracy" if task == "vit" else "val_perplexity"
+        single = {**base, "task": task, "mode": "single", "data_fp": "aaa"}
+        three = {**base, "task": task, "mode": "3d",
+                 "data_fp": "aaa" if task == "gpt2" else "bbb"}
+        for r in (single, three):
+            (art / f"{task}_{r['mode']}.json").write_text(json.dumps(r))
+    monkeypatch.setattr(parity_run, "ART_DIR", str(art))
+    md = parity_run.report()
+    assert "INCOMPARABLE" in md           # vit legs differ -> flagged
+    assert "GPT2 (1 epochs)" in md        # gpt2 legs match -> compared
+    assert md.count("PASS") == 1
