@@ -43,6 +43,9 @@ def main():
                     help="sampling temperature for --gen-eval (0=greedy)")
     ap.add_argument("--gen-top-k", type=int, default=0)
     ap.add_argument("--gen-top-p", type=float, default=1.0)
+    ap.add_argument("--gen-beams", type=int, default=1,
+                    help="beam width for --gen-eval (beats greedy on "
+                         "summary likelihood; single-device decode)")
     from quintnet_tpu.examples.common import add_multihost_args
 
     add_multihost_args(ap)
@@ -168,7 +171,7 @@ def main():
             max_new_tokens=min(64, gcfg.n_positions - max_prompt),
             eos_token_id=getattr(tok, "eos_token_id", None),
             temperature=args.gen_temp, top_k=args.gen_top_k,
-            top_p=args.gen_top_p,
+            top_p=args.gen_top_p, beams=args.gen_beams,
             key=jax.random.key(cfg.training.seed) if args.gen_temp
             else None)
         print("generation eval:",

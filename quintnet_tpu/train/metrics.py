@@ -121,7 +121,7 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                         eos_token_id: int | None = None,
                         batch_size: int = 8,
                         temperature: float = 0.0, top_k: int = 0,
-                        top_p: float = 1.0, key=None,
+                        top_p: float = 1.0, key=None, beams: int = 1,
                         mesh=None, tp_axis: str = "tp") -> Dict[str, float]:
     """Generate continuations with the KV-cache decoder and score
     ROUGE-1/2/L + BLEU against references (reference evaluate_generation:
@@ -137,7 +137,8 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
     The reference skips generation eval under any parallelism
     (GPT2_Trainer.py:509-555).
     """
-    from quintnet_tpu.models.gpt2_generate import (gpt2_generate,
+    from quintnet_tpu.models.gpt2_generate import (gpt2_beam_search,
+                                                   gpt2_generate,
                                                    gpt2_generate_tp)
 
     by_len: Dict[int, List[int]] = {}
@@ -157,7 +158,14 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                 batch = np.concatenate([batch, pad], axis=0)
             sample = dict(temperature=temperature, top_k=top_k,
                           top_p=top_p, key=key)
-            if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
+            if beams > 1 and (mesh is None
+                              or mesh.shape.get(tp_axis, 1) == 1):
+                # beam decode is single-device (deterministic, so no
+                # key); tp meshes fall through to sampling/greedy tp
+                out = gpt2_beam_search(params, batch, cfg, beams=beams,
+                                       max_new_tokens=max_new_tokens,
+                                       eos_token_id=eos_token_id)
+            elif mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
                 out = gpt2_generate_tp(params, batch, cfg, mesh=mesh,
                                        tp_axis=tp_axis,
                                        max_new_tokens=max_new_tokens,

@@ -68,3 +68,17 @@ def test_beam_shape_without_eos(setup):
     params, ids = setup
     out = gpt2_beam_search(params, ids, CFG, beams=2, max_new_tokens=1)
     assert out.shape == (2, 7)
+
+
+def test_evaluate_generation_with_beams(setup):
+    from quintnet_tpu.data import ByteTokenizer
+    from quintnet_tpu.train.metrics import evaluate_generation
+
+    params, _ = setup
+    tok = ByteTokenizer()
+    prompts = [([1, 2, 3, 4], "some reference"),
+               ([5, 6, 7, 8], "other reference")]
+    scores = evaluate_generation(params, CFG, prompts, tok,
+                                 max_new_tokens=4, batch_size=2,
+                                 beams=3)
+    assert set(scores) == {"rouge1", "rouge2", "rougeL", "bleu"}
