@@ -186,6 +186,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2",
                     choices=["gpt2", "gpt2-moe", "vit", "flash-attn"])
+    ap.add_argument("--preset", default="base",
+                    choices=["base", "medium", "large", "xl"],
+                    help="GPT-2 size preset (--model gpt2/gpt2-moe); "
+                         "bigger presets raise arithmetic intensity and "
+                         "MFU on one chip until HBM runs out")
     ap.add_argument("--experts", type=int, default=8,
                     help="expert count for --model gpt2-moe")
     from quintnet_tpu.ops.flash_attention import (PALLAS_BLOCK_K,
@@ -264,11 +269,12 @@ def main():
     if args.model in ("gpt2", "gpt2-moe"):
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
 
+        preset = getattr(GPT2Config, args.preset)()
         if args.model == "gpt2-moe":
-            gcfg = GPT2Config(n_experts=args.experts,
-                              expert_top_k=2)
+            gcfg = dataclasses.replace(preset, n_experts=args.experts,
+                                       expert_top_k=2)
         else:
-            gcfg = GPT2Config.base()
+            gcfg = preset
         use_flash = args.seq >= 4096
         if args.seq > gcfg.n_positions:
             gcfg = dataclasses.replace(gcfg, n_positions=args.seq)
@@ -291,7 +297,9 @@ def main():
         batch = (jnp.asarray(ids), jnp.asarray(ids))
         flops_per_step = (flops_per_token_gpt2(gcfg)
                           * args.batch * n_dev * args.seq)
-        name = "gpt2_124m" if args.model == "gpt2" else \
+        size = {"base": "124m", "medium": "355m", "large": "774m",
+                "xl": "1558m"}[args.preset]
+        name = f"gpt2_{size}" if args.model == "gpt2" else \
             f"gpt2_moe{args.experts}"
         metric = f"{name}_seq{args.seq}_train_samples_per_sec_per_chip"
     else:

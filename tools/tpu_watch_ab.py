@@ -31,6 +31,8 @@ CONFIRM = [
     ["--model", "flash-attn", "--seq", "8192", "--steps", "30"],
     ["--model", "flash-attn", "--seq", "4096", "--steps", "30"],
     ["--model", "gpt2-moe", "--steps", "20"],
+    ["--preset", "medium", "--steps", "10"],
+    ["--preset", "medium", "--steps", "10", "--remat-policy", "dots"],
 ]
 
 
