@@ -199,12 +199,18 @@ class Strategy:
 
     @property
     def zero1_axis(self) -> Optional[str]:
-        """ZeRO-1 shards optimizer state over dp when the config asks for
-        a zero1_* optimizer (reference stub: optimizers/zero.py)."""
-        if (self.config.training.optimizer.startswith("zero1")
+        """ZeRO-1/2 shard optimizer state over dp when the config asks
+        for a zero1_*/zero2_* optimizer (reference stub:
+        optimizers/zero.py)."""
+        if (self.config.training.optimizer.startswith(("zero1", "zero2"))
                 and self.mesh.shape.get("dp", 1) > 1):
             return "dp"
         return None
+
+    @property
+    def zero_stage(self) -> int:
+        """2 = also reduce-scatter gradients (parallel/zero.make_zero2)."""
+        return 2 if self.config.training.optimizer.startswith("zero2") else 1
 
     def init_opt_state(self, model: ModelSpec, optimizer, params):
         if self.zero1_axis is not None:
@@ -244,6 +250,7 @@ class Strategy:
                     grad_clip_norm=cfg.training.grad_clip_norm,
                     grad_fn=grad_fn,
                     zero1_axis=self.zero1_axis,
+                    zero_stage=self.zero_stage,
                     batch_specs=self.batch_partition_specs(model),
                     needs_rng=model.needs_rng,
                 )
@@ -255,6 +262,7 @@ class Strategy:
                 partial_axes=self.partial_axes,
                 grad_clip_norm=cfg.training.grad_clip_norm,
                 zero1_axis=self.zero1_axis,
+                zero_stage=self.zero_stage,
                 batch_specs=self.batch_partition_specs(model),
                 needs_rng=model.needs_rng,
             )
@@ -271,6 +279,7 @@ class Strategy:
             grad_accum_steps=cfg.training.gradient_accumulation_steps,
             grad_clip_norm=cfg.training.grad_clip_norm,
             zero1_axis=self.zero1_axis,
+            zero_stage=self.zero_stage,
             batch_specs=self.batch_partition_specs(model),
             needs_rng=model.needs_rng,
         )

@@ -185,6 +185,7 @@ def make_parallel_train_step(
     donate: bool = True,
     grad_fn: Optional[Callable] = None,
     zero1_axis: Optional[str] = None,
+    zero_stage: int = 1,
     batch_specs=None,
     needs_rng: bool = False,
 ):
@@ -220,18 +221,31 @@ def make_parallel_train_step(
             out, grads = accumulate_grads(loss_fn, params, batch,
                                           grad_accum_steps, has_aux,
                                           key=key)
-        grads = reduce_grads(grads, param_specs,
-                             data_axes=data_axes, model_axes=maxes,
-                             partial_axes=paxes)
+        zero2 = zero1_axis is not None and zero_stage == 2
+        grads = reduce_grads(
+            grads, param_specs,
+            # ZeRO-2: the zero-axis mean happens inside update_local as
+            # a reduce-scatter straight into the rank's chunk
+            data_axes=(tuple(a for a in data_axes if a != zero1_axis)
+                       if zero2 else data_axes),
+            model_axes=maxes, partial_axes=paxes)
         if data_axes:
             out = jax.tree.map(lambda x: lax.pmean(x, data_axes), out)
-        if grad_clip_norm is not None:
+        if grad_clip_norm is not None and not zero2:
             # pp-sharded leaves are partial across pp too, and MoE expert
             # leaves are sharded over a data axis (ep): include both so
-            # the global norm sums every shard exactly once
+            # the global norm sums every shard exactly once. (ZeRO-2
+            # clips inside update_local, in chunk space.)
             grads, _ = clip_sharded_grads(grads, param_specs, grad_clip_norm,
                                           model_axes=maxes + paxes + data_axes)
-        if zero1_axis is not None:
+        if zero2:
+            from quintnet_tpu.parallel import zero
+
+            _, update_local = zero.make_zero2(
+                optimizer, param_specs, axis=zero1_axis,
+                mesh_axes=mesh_axes, clip_norm=grad_clip_norm)
+            params, opt_state = update_local(grads, opt_state, params)
+        elif zero1_axis is not None:
             from quintnet_tpu.parallel import zero
 
             _, update_local = zero.make_zero1(optimizer, axis=zero1_axis)

@@ -1,4 +1,4 @@
-"""ZeRO-1: optimizer state sharded over the data-parallel axis.
+"""ZeRO-1/2: optimizer state (and gradient reduction) sharded over dp.
 
 The reference declares this and never implements it (optimizers/zero.py
 and optimizers/distributed_adamw.py are TODO stubs, 1-7; BASELINE.json's
@@ -11,10 +11,22 @@ optimizer (AdamW etc.) runs on the chunk only, so its state (m, v) costs
 1/dp of the replicated footprint. Updated chunks are re-assembled with
 one all-gather on the dp axis.
 
-Comm per step: grad allreduce (mean) + param all-gather — the classic
-ZeRO-1 exchange. Chunk contents differ across tp/pp coordinates as well,
-so globally the chunk state is sharded over EVERY mesh axis
-(:func:`state_specs` uses P((all mesh axes,)) on the flat dim).
+**ZeRO-1** (:func:`make_zero1`): grads arrive fully reduced (dp-pmean in
+reduce_grads); the rank slices its chunk. Comm: grad allreduce + param
+all-gather.
+
+**ZeRO-2** (:func:`make_zero2`): grads arrive reduced over model/partial
+axes but NOT over dp; the dp reduction IS a ``psum_scatter`` straight
+into the rank's chunk — half the gradient-reduction traffic of the
+allreduce, and the full dp-reduced gradient vector never exists on any
+rank. Global-norm clipping moves inside, computed in chunk space with a
+per-element replication weight (a LayerNorm grad replicated over tp
+contributes once, not tp times — :func:`grad_weights`). Same update
+math as ZeRO-1 + clip to float reassociation (tests/test_zero.py).
+
+Chunk contents differ across tp/pp coordinates as well, so globally the
+chunk state is sharded over EVERY mesh axis (:func:`state_specs` uses
+P((all mesh axes,)) on the flat dim).
 
 Requires a uniform param dtype (ravel_pytree concatenates into one
 vector); mixed-precision param trees should keep a uniform master dtype.
@@ -93,6 +105,77 @@ def make_zero1(
         flat_new = cc.all_gather(p_chunk, axis, gather_dim=0)  # [dp*chunk]
         flat_new = flat_new[: flat_p.shape[0]]
         return unravel(flat_new), opt_state
+
+    return init_local, update_local
+
+
+def grad_weights(params, param_specs, *, mesh_axes, skip_axis: str):
+    """Flat per-element weight = 1 / (replication factor over every mesh
+    axis except ``skip_axis``). Sum(w * g^2) psummed over ALL mesh axes
+    is then the exact global sum-of-squares: chunks are disjoint over
+    ``skip_axis``, sharded leaves count once per distinct shard, and
+    leaves replicated over an axis are down-weighted by its size.
+    Trace-time constant — XLA folds it."""
+    from quintnet_tpu.parallel.train_step import _spec_axes
+
+    def w(p, spec):
+        rep = 1
+        present = _spec_axes(spec)
+        for a in mesh_axes:
+            if a != skip_axis and a not in present:
+                rep *= lax.axis_size(a)
+        return jnp.full(p.shape, 1.0 / rep, jnp.float32)
+
+    flat, _ = ravel_pytree(jax.tree.map(w, params, param_specs))
+    return flat
+
+
+def make_zero2(
+    optimizer: optax.GradientTransformation,
+    param_specs,
+    *,
+    axis: str = "dp",
+    mesh_axes: Sequence[str],
+    clip_norm: Optional[float] = None,
+):
+    """(init_local, update_local) for ZeRO-2 inside shard_map.
+
+    ``update_local(grads_local, opt_state, params_local)``: ``grads``
+    must be reduced over model/partial axes and over data axes OTHER
+    than ``axis`` — the ``axis`` mean happens here via psum_scatter.
+    Clipping (when ``clip_norm``) runs on the reduced chunk with
+    replication-corrected weights, so it matches the full-tree
+    ``clip_sharded_grads`` exactly.
+    """
+    init_local, _ = make_zero1(optimizer, axis=axis)
+    opt_extra = optax.with_extra_args_support(optimizer)
+
+    def update_local(grads, opt_state, params):
+        flat_p, unravel = ravel_pytree(params)
+        flat_g, _ = ravel_pytree(grads)
+        dp = lax.axis_size(axis)
+        chunk = _chunk_size(flat_p.shape[0], dp)
+        r = lax.axis_index(axis)
+        padded_g = jnp.pad(flat_g, (0, chunk * dp - flat_g.shape[0]))
+        # the dp reduction: reduce-scatter straight into this rank's
+        # chunk (allreduce = this + the discarded other chunks)
+        g_chunk = cc.reduce_scatter(padded_g, axis, scatter_dim=0) / dp
+        if clip_norm is not None:
+            wflat = grad_weights(params, param_specs,
+                                 mesh_axes=mesh_axes, skip_axis=axis)
+            w_chunk = local_chunk(wflat, dp, r, chunk)
+            ss = jnp.sum(w_chunk * jnp.square(g_chunk.astype(jnp.float32)))
+            norm = jnp.sqrt(lax.psum(ss, tuple(mesh_axes)))
+            g_chunk = g_chunk * jnp.minimum(1.0, clip_norm / (norm + 1e-6))
+        p_chunk = local_chunk(flat_p, dp, r, chunk)
+        flat_m, _ = ravel_pytree(jax.tree.map(
+            lambda p: jnp.full(p.shape, p.ndim > 1, flat_p.dtype), params))
+        m_chunk = local_chunk(flat_m, dp, r, chunk)
+        updates, opt_state = opt_extra.update(g_chunk, opt_state, p_chunk,
+                                              decay_mask=m_chunk)
+        p_chunk = optax.apply_updates(p_chunk, updates)
+        flat_new = cc.all_gather(p_chunk, axis, gather_dim=0)
+        return unravel(flat_new[: flat_p.shape[0]]), opt_state
 
     return init_local, update_local
 

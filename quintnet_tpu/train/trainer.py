@@ -114,7 +114,7 @@ def make_optimizer(cfg: Config) -> optax.GradientTransformation:
     skipping LN/bias leaves."""
     t = cfg.training
     name = t.optimizer.lower()
-    if name.startswith("zero1_"):
+    if name.startswith(("zero1_", "zero2_")):
         name = name[len("zero1_"):]
     lr = make_lr_schedule(cfg)
     # mu_dtype=bfloat16 halves the first-moment memory (planner: 'opt'

@@ -102,3 +102,36 @@ def test_zero1_composes_with_3d():
     np.testing.assert_allclose(l_z, l_ref, rtol=1e-5)
     for a, b in zip(jax.tree.leaves(p_z), jax.tree.leaves(p_ref)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero2_matches_replicated_adamw_one_step():
+    """ZeRO-2 (reduce-scatter grads + chunk-space weighted clip) must
+    reproduce the replicated update: same math, different comm."""
+    p_ref, _, _ = _run("adamw", [4], ["dp"], n_steps=1)
+    p_z, _, _ = _run("zero2_adamw", [4], ["dp"], n_steps=1)
+    for a, b in zip(jax.tree.leaves(p_z), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_zero2_matches_zero1_under_dp_tp():
+    """dp x tp exercises the replication-weighted chunk-space norm: LN
+    grads are replicated over tp and must count ONCE in the clip norm
+    (grad_weights), or the clip scale — and every update — drifts."""
+    p_1, _, l_1 = _run("zero1_adamw", [2, 2], ["dp", "tp"], n_steps=2)
+    p_2, _, l_2 = _run("zero2_adamw", [2, 2], ["dp", "tp"], n_steps=2)
+    np.testing.assert_allclose(l_2, l_1, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_2), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_zero2_composes_with_3d():
+    p_1, _, l_1 = _run("zero1_adamw", [2, 2, 2], ["dp", "tp", "pp"],
+                       n_steps=2, schedule="1f1b", grad_acc=4)
+    p_2, _, l_2 = _run("zero2_adamw", [2, 2, 2], ["dp", "tp", "pp"],
+                       n_steps=2, schedule="1f1b", grad_acc=4)
+    np.testing.assert_allclose(l_2, l_1, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_2), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
