@@ -153,7 +153,7 @@ def lora_partition_specs(block_specs, cfg: LoRAConfig):
         for k, v in node.items():
             if (k in cfg.targets and isinstance(v, dict) and "w" in v
                     and not isinstance(v["w"], dict)):
-                wspec = tuple(v["w"]) if v["w"] else ()
+                wspec = tuple(v["w"])  # PartitionSpec() -> ()
                 lead = wspec[:-2] if len(wspec) >= 2 else ()
                 s_in = wspec[-2] if len(wspec) >= 2 else None
                 s_out = wspec[-1] if len(wspec) >= 1 else None
@@ -169,7 +169,9 @@ def lora_partition_specs(block_specs, cfg: LoRAConfig):
 
 
 def lora_param_count(lora) -> int:
-    return sum(int(jnp.size(l)) for l in jax.tree.leaves(lora))
+    from quintnet_tpu.core.pytree import tree_count_params
+
+    return tree_count_params(lora)
 
 
 def lora_upcast(lora, dtype=jnp.float32):

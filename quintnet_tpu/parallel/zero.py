@@ -60,6 +60,26 @@ def local_chunk(flat, dp: int, rank, chunk: int):
     return lax.dynamic_slice_in_dim(padded, rank * chunk, chunk)
 
 
+def _chunk_apply(opt_extra, g_chunk, opt_state, params, flat_p, unravel,
+                 axis: str, dp, r, chunk: int):
+    """Shared ZeRO chunk update: masked-decay mask, inner optimizer on
+    the chunk, all-gather of the updated params. The elementwise decay
+    mask (ndim>1 leaves) is raveled and chunked like the params:
+    per-leaf optax masks cannot see parameter boundaries inside the flat
+    chunk, so masked_decay (train/trainer.py) takes it via the
+    extra-args protocol; transforms without extra-args support ignore
+    it. Trace-time constant — XLA folds it."""
+    p_chunk = local_chunk(flat_p, dp, r, chunk)
+    flat_m, _ = ravel_pytree(jax.tree.map(
+        lambda p: jnp.full(p.shape, p.ndim > 1, flat_p.dtype), params))
+    m_chunk = local_chunk(flat_m, dp, r, chunk)
+    updates, opt_state = opt_extra.update(g_chunk, opt_state, p_chunk,
+                                          decay_mask=m_chunk)
+    p_chunk = optax.apply_updates(p_chunk, updates)
+    flat_new = cc.all_gather(p_chunk, axis, gather_dim=0)  # [dp*chunk]
+    return unravel(flat_new[: flat_p.shape[0]]), opt_state
+
+
 def make_zero1(
     optimizer: optax.GradientTransformation,
     *,
@@ -88,23 +108,9 @@ def make_zero1(
         dp = lax.axis_size(axis)
         chunk = _chunk_size(flat_p.shape[0], dp)
         r = lax.axis_index(axis)
-        p_chunk = local_chunk(flat_p, dp, r, chunk)
         g_chunk = local_chunk(flat_g, dp, r, chunk)
-        # Elementwise decay mask (ndim>1 leaves), raveled and chunked
-        # like the params: per-leaf optax masks cannot see parameter
-        # boundaries inside the flat chunk, so masked_decay
-        # (train/trainer.py) takes this via the extra-args protocol;
-        # transforms without extra-args support ignore it. Trace-time
-        # constant — XLA folds it.
-        flat_m, _ = ravel_pytree(jax.tree.map(
-            lambda p: jnp.full(p.shape, p.ndim > 1, flat_p.dtype), params))
-        m_chunk = local_chunk(flat_m, dp, r, chunk)
-        updates, opt_state = opt_extra.update(g_chunk, opt_state, p_chunk,
-                                              decay_mask=m_chunk)
-        p_chunk = optax.apply_updates(p_chunk, updates)
-        flat_new = cc.all_gather(p_chunk, axis, gather_dim=0)  # [dp*chunk]
-        flat_new = flat_new[: flat_p.shape[0]]
-        return unravel(flat_new), opt_state
+        return _chunk_apply(opt_extra, g_chunk, opt_state, params,
+                            flat_p, unravel, axis, dp, r, chunk)
 
     return init_local, update_local
 
@@ -167,15 +173,8 @@ def make_zero2(
             ss = jnp.sum(w_chunk * jnp.square(g_chunk.astype(jnp.float32)))
             norm = jnp.sqrt(lax.psum(ss, tuple(mesh_axes)))
             g_chunk = g_chunk * jnp.minimum(1.0, clip_norm / (norm + 1e-6))
-        p_chunk = local_chunk(flat_p, dp, r, chunk)
-        flat_m, _ = ravel_pytree(jax.tree.map(
-            lambda p: jnp.full(p.shape, p.ndim > 1, flat_p.dtype), params))
-        m_chunk = local_chunk(flat_m, dp, r, chunk)
-        updates, opt_state = opt_extra.update(g_chunk, opt_state, p_chunk,
-                                              decay_mask=m_chunk)
-        p_chunk = optax.apply_updates(p_chunk, updates)
-        flat_new = cc.all_gather(p_chunk, axis, gather_dim=0)
-        return unravel(flat_new[: flat_p.shape[0]]), opt_state
+        return _chunk_apply(opt_extra, g_chunk, opt_state, params,
+                            flat_p, unravel, axis, dp, r, chunk)
 
     return init_local, update_local
 
