@@ -51,6 +51,10 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def wait_until_finished(self) -> None:
+        """Barrier on any in-flight async save."""
+        self._mgr.wait_until_finished()
+
     def restore(self, template: Any = None, *, step: Optional[int] = None
                 ) -> Any:
         """``template=None`` restores as plain host numpy arrays with the

@@ -207,9 +207,7 @@ class Trainer:
         reference), else fresh init. Returns (params, opt_state, start_epoch)."""
         params, opt_state = self.init_state(seed)
         if self.checkpoint_dir:
-            from quintnet_tpu.train.checkpoint import CheckpointManager
-
-            mgr = CheckpointManager(self.checkpoint_dir)
+            mgr = self._manager()
             if mgr.latest_step() is not None:
                 restored = mgr.restore({"params": params, "opt": opt_state,
                                         "epoch": 0})
@@ -218,13 +216,31 @@ class Trainer:
                         int(restored["epoch"]) + 1)
         return params, opt_state, 0
 
+    def _manager(self, *, best: bool = False):
+        """Cached CheckpointManager(s) — one per directory, reused across
+        epochs (a fresh manager per save re-lists the directory and
+        resets orbax's async machinery)."""
+        from quintnet_tpu.train.checkpoint import CheckpointManager
+
+        if not hasattr(self, "_mgrs"):
+            self._mgrs = {}
+        key = "best" if best else "main"
+        if key not in self._mgrs:
+            self._mgrs[key] = (
+                CheckpointManager(self.checkpoint_dir.rstrip("/") + "-best",
+                                  max_to_keep=1) if best
+                else CheckpointManager(self.checkpoint_dir))
+        return self._mgrs[key]
+
     def save(self, epoch: int, params, opt_state):
         if not self.checkpoint_dir:
             return
-        from quintnet_tpu.train.checkpoint import CheckpointManager
-
-        mgr = CheckpointManager(self.checkpoint_dir)
-        mgr.save(epoch, {"params": params, "opt": opt_state, "epoch": epoch})
+        # async: orbax snapshots device arrays before returning, then
+        # writes in the background — the next epoch's compute overlaps
+        # the IO. fit() barriers at the end (wait_for_saves).
+        self._manager().save(
+            epoch, {"params": params, "opt": opt_state, "epoch": epoch},
+            wait=False)
 
     def save_best(self, epoch: int, params, opt_state, val_loss: float):
         """Best-by-val-loss retention in a sibling ``<dir>-best``
@@ -234,12 +250,14 @@ class Trainer:
         directory never sees a non-numeric entry."""
         if not self.checkpoint_dir:
             return
-        from quintnet_tpu.train.checkpoint import CheckpointManager
+        self._manager(best=True).save(
+            epoch, {"params": params, "opt": opt_state, "epoch": epoch,
+                    "val_loss": val_loss}, wait=False)
 
-        mgr = CheckpointManager(self.checkpoint_dir.rstrip("/") + "-best",
-                                max_to_keep=1)
-        mgr.save(epoch, {"params": params, "opt": opt_state, "epoch": epoch,
-                         "val_loss": val_loss})
+    def wait_for_saves(self):
+        """Barrier on in-flight async checkpoint writes."""
+        for mgr in getattr(self, "_mgrs", {}).values():
+            mgr.wait_until_finished()
 
     # -- evaluation --------------------------------------------------------
     def _build_eval(self):
@@ -393,6 +411,7 @@ class Trainer:
             self.log(msg)
             self.save(epoch, params, opt_state)
 
+        self.wait_for_saves()
         hist.wall_time_s = time.time() - t0
         self._final_state = (params, opt_state)
         return hist
