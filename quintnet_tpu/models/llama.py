@@ -1,0 +1,383 @@
+"""Llama-family causal LM: RMSNorm + rotary + SwiGLU + GQA.
+
+Beyond the reference (its model zoo is ViT + GPT-2 only,
+SURVEY.md §2.4) — this is the "another model family" extension, built to
+demonstrate that the framework's machinery is model-agnostic: the block
+plugs into the SAME stacked-scan runner (nn/transformer.py
+stacked_blocks_apply via ``body_fn``), the same strategies, trainers,
+LoRA, ZeRO and flash/ring attention paths GPT-2 uses.
+
+Weights are stored [in, out] (x @ w). HF Llama checkpoints store torch
+Linear [out, in]; the import path transposes
+(:func:`llama_from_hf_state`). Logits verified against HF
+``LlamaForCausalLM`` on identical weights (tests/test_llama.py).
+
+TP sharding: q/k/v column-sharded by (kv-)heads, o row-sharded with one
+psum; gate/up column- and down row-sharded (one psum) — the same
+Megatron pattern as GPT-2. Requires ``n_kv_heads % tp == 0``.
+
+SP: rope uses GLOBAL positions (sp-offset like gpt2_embed's wpe
+lookup), and since rope is applied to q/k BEFORE attention, the
+ring/zigzag/ulysses paths run unchanged on the rotated tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from quintnet_tpu.core.pytree import tree_stack
+from quintnet_tpu.nn.attention import (apply_rope, repeat_kv, rope_cos_sin,
+                                       sdpa)
+from quintnet_tpu.nn.layers import (cast_floating, linear_init,
+                                    rms_norm_apply, rms_norm_init,
+                                    swiglu_apply, swiglu_init)
+from quintnet_tpu.nn.transformer import stacked_blocks_apply
+
+from quintnet_tpu.models.gpt2 import clm_loss, clm_loss_sp  # shared CLM loss
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_positions: int = 2048          # max_position_embeddings
+    dim: int = 2048                  # hidden_size
+    n_layers: int = 16
+    n_heads: int = 32
+    n_kv_heads: int = 8              # GQA groups (== n_heads -> MHA)
+    intermediate_size: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = True      # Llama-3.2-1B ties; 7B+ do not
+    scan_unroll: int = 1
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama32_1b() -> "LlamaConfig":
+        return LlamaConfig()  # the defaults above are 3.2-1B geometry
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, n_positions=8192, dim=4096,
+                           n_layers=32, n_heads=32, n_kv_heads=8,
+                           intermediate_size=14336, rope_theta=500000.0,
+                           tie_embeddings=False)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        d = dict(vocab_size=128, n_positions=64, dim=32, n_layers=2,
+                 n_heads=4, n_kv_heads=2, intermediate_size=64,
+                 rope_theta=10000.0, tie_embeddings=False)
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @staticmethod
+    def from_hf_config(hf) -> "LlamaConfig":
+        """Map a transformers LlamaConfig."""
+        return LlamaConfig(
+            vocab_size=hf.vocab_size,
+            n_positions=hf.max_position_embeddings,
+            dim=hf.hidden_size,
+            n_layers=hf.num_hidden_layers,
+            n_heads=hf.num_attention_heads,
+            n_kv_heads=hf.num_key_value_heads,
+            intermediate_size=hf.intermediate_size,
+            rope_theta=hf.rope_theta,
+            rms_eps=hf.rms_norm_eps,
+            tie_embeddings=hf.tie_word_embeddings,
+        )
+
+
+def _block_init(key, cfg: LlamaConfig, dtype):
+    kq, kk, kv, ko, km = jax.random.split(key, 5)
+    d, hd = cfg.dim, cfg.head_dim
+    return {
+        "ln1": rms_norm_init(d, dtype),
+        "attn": {
+            "q": linear_init(kq, d, cfg.n_heads * hd, use_bias=False,
+                             dtype=dtype),
+            "k": linear_init(kk, d, cfg.n_kv_heads * hd, use_bias=False,
+                             dtype=dtype),
+            "v": linear_init(kv, d, cfg.n_kv_heads * hd, use_bias=False,
+                             dtype=dtype),
+            "o": linear_init(ko, cfg.n_heads * hd, d, use_bias=False,
+                             dtype=dtype),
+        },
+        "ln2": rms_norm_init(d, dtype),
+        "mlp": swiglu_init(km, d, cfg.intermediate_size, dtype=dtype),
+    }
+
+
+def llama_init(key, cfg: LlamaConfig, *, dtype=jnp.float32):
+    k_emb, k_blocks, k_head = jax.random.split(key, 3)
+    blocks = tree_stack([
+        _block_init(bk, cfg, dtype)
+        for bk in jax.random.split(k_blocks, cfg.n_layers)])
+    params: Dict[str, Any] = {
+        "embedding": {"tok": jax.random.normal(
+            k_emb, (cfg.vocab_size, cfg.dim), dtype) * 0.02},
+        "blocks": blocks,
+        "head": {"ln_f": rms_norm_init(cfg.dim, dtype)},
+    }
+    if not cfg.tie_embeddings:
+        params["head"]["lm"] = linear_init(
+            k_head, cfg.dim, cfg.vocab_size, use_bias=False, dtype=dtype)
+    return params
+
+
+def _attention(p, x, cfg: LlamaConfig, *, cos, sin,
+               tp_axis: Optional[str], sp_axis: Optional[str],
+               sp_mode: str, use_flash: bool):
+    b, s, _ = x.shape
+    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    hd = cfg.head_dim
+    n_q = cfg.n_heads // tp
+    n_kv = cfg.n_kv_heads // tp
+
+    def heads(w, n):
+        return jnp.dot(x, w).reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+
+    q = apply_rope(heads(p["q"]["w"], n_q), cos, sin)
+    k = apply_rope(heads(p["k"]["w"], n_kv), cos, sin)
+    v = heads(p["v"]["w"], n_kv)
+    k = repeat_kv(k, n_q // n_kv)
+    v = repeat_kv(v, n_q // n_kv)
+
+    if sp_axis is not None:
+        from quintnet_tpu.ops.ring_attention import (ring_attention,
+                                                     zigzag_ring_attention)
+        from quintnet_tpu.ops.ulysses_attention import ulysses_attention
+
+        if sp_mode == "ulysses":
+            o = ulysses_attention(q, k, v, axis=sp_axis, causal=True,
+                                  use_flash=use_flash)
+        elif sp_mode == "zigzag":
+            o = zigzag_ring_attention(q, k, v, axis=sp_axis, causal=True)
+        else:
+            o = ring_attention(q, k, v, axis=sp_axis, causal=True)
+    elif use_flash:
+        from quintnet_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(q, k, v, causal=True)
+    else:
+        o = sdpa(q, k, v, causal=True)
+
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, n_q * hd)
+    y = jnp.dot(o, p["o"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    return y
+
+
+def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
+                      tp_axis: Optional[str] = None,
+                      sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                      use_flash: bool = False, key=None):
+    del key  # llama has no dropout
+    x = x + _attention(p["attn"], rms_norm_apply(p["ln1"], x,
+                                                 eps=cfg.rms_eps),
+                       cfg, cos=cos, sin=sin, tp_axis=tp_axis,
+                       sp_axis=sp_axis, sp_mode=sp_mode,
+                       use_flash=use_flash)
+    return x + swiglu_apply(p["mlp"], rms_norm_apply(p["ln2"], x,
+                                                     eps=cfg.rms_eps),
+                            tp_axis=tp_axis)
+
+
+def _positions(b, s, sp_axis: Optional[str]):
+    """Global position ids for the local sequence shard (sp offsets the
+    shard like gpt2_embed's wpe lookup; rope must see global positions)."""
+    pos = jnp.arange(s)
+    if sp_axis is not None:
+        pos = pos + lax.axis_index(sp_axis) * s
+    return pos
+
+
+def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
+                 tp_axis: Optional[str] = None,
+                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                 remat: "bool | str" = False, use_flash: bool = False):
+    b, s = input_ids.shape
+    h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
+    cos, sin = rope_cos_sin(_positions(b, s, sp_axis), cfg.head_dim,
+                            theta=cfg.rope_theta)
+    import functools
+
+    body = functools.partial(llama_block_apply, cfg=cfg, cos=cos, sin=sin,
+                             tp_axis=tp_axis, sp_axis=sp_axis,
+                             sp_mode=sp_mode, use_flash=use_flash)
+    return stacked_blocks_apply(
+        params["blocks"], h, num_heads=0, body_fn=body, remat=remat,
+        scan_unroll=cfg.scan_unroll)
+
+
+def llama_logits(params, h, cfg: LlamaConfig):
+    h = rms_norm_apply(params["head"]["ln_f"], h, eps=cfg.rms_eps)
+    w = (params["embedding"]["tok"].T if cfg.tie_embeddings
+         else params["head"]["lm"]["w"])
+    return jnp.dot(h, w).astype(jnp.float32)
+
+
+def llama_apply(params, input_ids, cfg: LlamaConfig, *,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                remat: "bool | str" = False, use_flash: bool = False):
+    h = llama_hidden(params, input_ids, cfg, tp_axis=tp_axis,
+                     sp_axis=sp_axis, sp_mode=sp_mode, remat=remat,
+                     use_flash=use_flash)
+    return llama_logits(params, h, cfg)
+
+
+# ---------------------------------------------------------------------------
+# sharding / strategy integration
+
+def llama_partition_specs(cfg: Optional[LlamaConfig] = None, *,
+                          tp_axis: Optional[str] = "tp",
+                          pp_axis: Optional[str] = None,
+                          ep_axis: Optional[str] = None):
+    from jax.sharding import PartitionSpec as P
+
+    t = tp_axis
+    col = P(pp_axis, None, t)     # [L, in, out/tp]
+    row = P(pp_axis, t, None)     # [L, in/tp, out]
+    rep = P(pp_axis, None)
+    blocks = {
+        "ln1": {"scale": rep},
+        "attn": {"q": {"w": col}, "k": {"w": col}, "v": {"w": col},
+                 "o": {"w": row}},
+        "ln2": {"scale": rep},
+        "mlp": {"gate": {"w": col}, "up": {"w": col}, "down": {"w": row}},
+    }
+    specs = {
+        "embedding": {"tok": P()},
+        "blocks": blocks,
+        "head": {"ln_f": {"scale": P()}},
+    }
+    if cfg is None or not cfg.tie_embeddings:
+        specs["head"]["lm"] = {"w": P()}
+    return specs
+
+
+def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
+                     use_flash: bool = False, sp_mode: str = "ring",
+                     compute_dtype=None):
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.parallel.strategy import ModelSpec
+
+    def cast(p):
+        return cast_floating(p, compute_dtype) if compute_dtype else p
+
+    def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
+                key=None):
+        del ep_axis, key
+        input_ids, labels = batch
+        logits = llama_apply(cast(params), input_ids, cfg, tp_axis=tp_axis,
+                             sp_axis=sp_axis, sp_mode=sp_mode, remat=remat,
+                             use_flash=use_flash)
+        if sp_axis is not None:
+            return clm_loss_sp(logits, labels, sp_axis=sp_axis)
+        return clm_loss(logits, labels)
+
+    def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
+        del ep_axis
+
+        def embed_fn(params, input_ids, key=None):
+            del key
+            return jnp.take(cast(params)["embedding"]["tok"], input_ids,
+                            axis=0)
+
+        def stage_fn(blocks_local, h, key=None):
+            del key
+            b, s = h.shape[:2]
+            cos, sin = rope_cos_sin(_positions(b, s, sp_axis),
+                                    cfg.head_dim, theta=cfg.rope_theta)
+            import functools
+
+            body = functools.partial(
+                llama_block_apply, cfg=cfg, cos=cos, sin=sin,
+                tp_axis=tp_axis, sp_axis=sp_axis, sp_mode=sp_mode,
+                use_flash=use_flash)
+            return stacked_blocks_apply(cast(blocks_local), h, num_heads=0,
+                                        body_fn=body, remat=remat,
+                                        scan_unroll=cfg.scan_unroll)
+
+        if sp_axis is not None:
+            from quintnet_tpu.parallel.pp import SplitHead
+
+            return embed_fn, stage_fn, SplitHead(
+                lambda params, h, labels: llama_logits(cast(params), h, cfg),
+                lambda logits, labels, valid: jnp.where(
+                    valid, clm_loss_sp(logits, labels, sp_axis=sp_axis),
+                    0.0))
+
+        def head_loss_fn(params, h, labels):
+            return clm_loss(llama_logits(cast(params), h, cfg), labels)
+
+        return embed_fn, stage_fn, head_loss_fn
+
+    def batch_specs(batch_axes, sp_axis=None):
+        spec = P(tuple(batch_axes) if batch_axes else None, sp_axis)
+        return (spec, spec)
+
+    return ModelSpec(
+        init=lambda key: llama_init(key, cfg),
+        loss_fn=loss_fn,
+        partition_specs=lambda tp_axis=None, pp_axis=None, ep_axis=None:
+            llama_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis),
+        pipeline_fns=pipeline_fns,
+        to_tp_layout=lambda p, tp: p,  # separate q/k/v: no qkv re-blocking
+        depth=cfg.n_layers,
+        batch_specs=batch_specs,
+        needs_rng=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF interop
+
+def llama_from_hf_state(state: Dict[str, Any], cfg: LlamaConfig):
+    """HF LlamaForCausalLM state dict (torch tensors or arrays, Linear
+    weights [out, in]) -> this layout ([in, out], stacked blocks)."""
+    import numpy as np
+
+    def t(name):
+        return np.asarray(state[name].detach().cpu().numpy()
+                          if hasattr(state[name], "detach")
+                          else state[name])
+
+    blocks = []
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        blocks.append({
+            "ln1": {"scale": t(pre + "input_layernorm.weight")},
+            "attn": {
+                "q": {"w": t(pre + "self_attn.q_proj.weight").T},
+                "k": {"w": t(pre + "self_attn.k_proj.weight").T},
+                "v": {"w": t(pre + "self_attn.v_proj.weight").T},
+                "o": {"w": t(pre + "self_attn.o_proj.weight").T},
+            },
+            "ln2": {"scale": t(pre + "post_attention_layernorm.weight")},
+            "mlp": {
+                "gate": {"w": t(pre + "mlp.gate_proj.weight").T},
+                "up": {"w": t(pre + "mlp.up_proj.weight").T},
+                "down": {"w": t(pre + "mlp.down_proj.weight").T},
+            },
+        })
+    params = {
+        "embedding": {"tok": t("model.embed_tokens.weight")},
+        "blocks": tree_stack([jax.tree.map(jnp.asarray, b)
+                              for b in blocks]),
+        "head": {"ln_f": {"scale": t("model.norm.weight")}},
+    }
+    if not cfg.tie_embeddings:
+        params["head"]["lm"] = {"w": t("lm_head.weight").T}
+    return jax.tree.map(jnp.asarray, params)

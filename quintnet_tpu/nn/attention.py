@@ -34,6 +34,37 @@ def mha_init(key, dim: int, *, qkv_bias: bool = True, dtype=jnp.float32):
     }
 
 
+def rope_cos_sin(positions, head_dim: int, *, theta: float = 10000.0):
+    """Rotary tables for integer ``positions`` [...]: (cos, sin), each
+    [..., head_dim] with the half-dim frequencies duplicated (HF Llama
+    layout: the i-th and (i+d/2)-th lanes share a frequency)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32)
+                           / head_dim))                     # [d/2]
+    ang = positions.astype(jnp.float32)[..., None] * inv    # [..., d/2]
+    ang = jnp.concatenate([ang, ang], axis=-1)              # [..., d]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate [B, H, S, Dh] by per-position tables [S, Dh] (or any
+    broadcastable shape). HF rotate_half convention."""
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x.astype(jnp.float32) * cos + rotated.astype(jnp.float32)
+            * sin).astype(x.dtype)
+
+
+def repeat_kv(x, n_rep: int):
+    """[B, Hkv, S, Dh] -> [B, Hkv*n_rep, S, Dh] (GQA: share each kv head
+    across n_rep query heads; groups stay contiguous, HF order)."""
+    if n_rep == 1:
+        return x
+    b, h, s, d = x.shape
+    return jnp.broadcast_to(x[:, :, None], (b, h, n_rep, s, d)
+                            ).reshape(b, h * n_rep, s, d)
+
+
 def sdpa(q, k, v, *, causal: bool, softmax_dtype=jnp.float32,
          pdrop: float = 0.0, key=None):
     """Plain scaled-dot-product attention: [B, H, S, Dh] -> [B, H, S, Dh].

@@ -94,6 +94,43 @@ def layer_norm_apply(p, x, *, eps: float = 1e-5):
     return (y * p["scale"] + p["bias"]).astype(dtype)
 
 
+def rms_norm_init(dim: int, dtype=jnp.float32):
+    """RMSNorm (Llama-family): scale only, no bias/centering."""
+    return {"scale": jnp.ones((dim,), dtype)}
+
+
+def rms_norm_apply(p, x, *, eps: float = 1e-6):
+    """x * rsqrt(mean(x^2)+eps) * scale — f32 accumulation, HF Llama
+    semantics (scale multiplies AFTER the cast back in HF; kept in f32
+    here then cast once, equivalent to float tolerance)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1,
+                                 keepdims=True) + eps)
+    return (y * p["scale"]).astype(dtype)
+
+
+def swiglu_init(key, dim: int, hidden: int, *, dtype=jnp.float32):
+    """Llama MLP: gate/up column-shardable [D, H/tp], down row-shardable
+    [H/tp, D]; no biases."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "gate": linear_init(k1, dim, hidden, use_bias=False, dtype=dtype),
+        "up": linear_init(k2, dim, hidden, use_bias=False, dtype=dtype),
+        "down": linear_init(k3, hidden, dim, use_bias=False, dtype=dtype),
+    }
+
+
+def swiglu_apply(p, x, *, tp_axis: Optional[str] = None):
+    """silu(x@gate) * (x@up) @ down, one psum after down under tp
+    (same ColumnParallel->RowParallel shape as mlp_apply)."""
+    h = jax.nn.silu(jnp.dot(x, p["gate"]["w"])) * jnp.dot(x, p["up"]["w"])
+    y = jnp.dot(h, p["down"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    return y
+
+
 def embedding_init(key, num_embeddings: int, features: int, *,
                    scale: float = 0.02, dtype=jnp.float32):
     return {"table": jax.random.normal(key, (num_embeddings, features), dtype) * scale}

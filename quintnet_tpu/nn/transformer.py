@@ -120,8 +120,14 @@ def stacked_blocks_apply(
     resid_pdrop: float = 0.0,
     key=None,
     scan_unroll: int = 1,
+    body_fn: Optional[Callable] = None,
 ):
     """Run a [depth, ...]-stacked block pytree with lax.scan.
+
+    ``body_fn(block_params, h, key=...)``: override the per-layer body
+    (models/llama.py plugs its RMSNorm/rope/SwiGLU block in here and
+    inherits the scan/remat/unroll machinery); default is the GPT-2/ViT
+    pre-LN ``block_apply`` configured by the kwargs below.
 
     Replaces the reference's Python loop over ``model.blocks``
     (utils/model.py:325-380) — one traced block body, depth iterations,
@@ -143,7 +149,7 @@ def stacked_blocks_apply(
     scan alongside the params). None -> deterministic.
     """
     depth = jax.tree.leaves(stacked_params)[0].shape[0]
-    body = partial(
+    body = body_fn if body_fn is not None else partial(
         block_apply,
         num_heads=num_heads,
         causal=causal,
