@@ -150,14 +150,15 @@ def gpt2_decode_step(params, tok, pos, caches, cfg: GPT2Config,
     return _logits(params, h, cfg, tp_axis)[:, 0, :], (ks, vs)
 
 
-def _generate_body(params, input_ids, key, cfg: GPT2Config,
-                   max_new_tokens: int, eos_token_id: Optional[int],
-                   temperature: float, tp_axis: Optional[str] = None,
-                   top_k: int = 0, top_p: float = 1.0):
+def autoregress(prefill_fn, decode_fn, input_ids, key, *,
+                max_new_tokens: int, eos_token_id: Optional[int],
+                temperature: float, top_k: int = 0, top_p: float = 1.0):
+    """Model-agnostic jittable decode loop: ``prefill_fn(ids) ->
+    (last-pos logits [B, V], caches)``; ``decode_fn(tok [B], pos,
+    caches) -> (logits, caches)``. Sampling/EOS semantics shared by
+    every family (GPT-2 here, Llama in models/llama_generate.py)."""
     B, T0 = input_ids.shape
-    cache_len = T0 + max_new_tokens
-    logits0, caches = gpt2_prefill(params, input_ids, cfg,
-                                   cache_len=cache_len, tp_axis=tp_axis)
+    logits0, caches = prefill_fn(input_ids)
 
     def pick(logits, k):
         # same key on every tp rank (replicated inputs) -> same
@@ -168,8 +169,7 @@ def _generate_body(params, input_ids, key, cfg: GPT2Config,
     def step(carry, _):
         tok, pos, caches, done, k = carry
         k, sub = jax.random.split(k)
-        logits, caches = gpt2_decode_step(params, tok, pos, caches, cfg,
-                                          tp_axis=tp_axis)
+        logits, caches = decode_fn(tok, pos, caches)
         nxt = pick(logits, sub).astype(jnp.int32)
         if eos_token_id is not None:
             nxt = jnp.where(done, eos_token_id, nxt)
@@ -186,6 +186,22 @@ def _generate_body(params, input_ids, key, cfg: GPT2Config,
         None, length=max_new_tokens - 1)
     return jnp.concatenate(
         [input_ids, first[:, None], rest.T.astype(jnp.int32)], axis=1)
+
+
+def _generate_body(params, input_ids, key, cfg: GPT2Config,
+                   max_new_tokens: int, eos_token_id: Optional[int],
+                   temperature: float, tp_axis: Optional[str] = None,
+                   top_k: int = 0, top_p: float = 1.0):
+    cache_len = input_ids.shape[1] + max_new_tokens
+    return autoregress(
+        lambda ids: gpt2_prefill(params, ids, cfg, cache_len=cache_len,
+                                 tp_axis=tp_axis),
+        lambda tok, pos, caches: gpt2_decode_step(params, tok, pos,
+                                                  caches, cfg,
+                                                  tp_axis=tp_axis),
+        input_ids, key, max_new_tokens=max_new_tokens,
+        eos_token_id=eos_token_id, temperature=temperature,
+        top_k=top_k, top_p=top_p)
 
 
 _generate_jit = partial(jax.jit, static_argnames=(

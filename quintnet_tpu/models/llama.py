@@ -23,9 +23,9 @@ ring/zigzag/ulysses paths run unchanged on the rotated tensors.
 
 from __future__ import annotations
 
-import dataclasses
+import math
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +55,13 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     tie_embeddings: bool = True      # Llama-3.2-1B ties; 7B+ do not
     scan_unroll: int = 1
+    # llama3-style rope scaling (None = unscaled). Tuple (hashable — the
+    # config is a jit static arg): (factor, low_freq_factor,
+    # high_freq_factor, original_max_position). HF applies this when
+    # config.rope_scaling["rope_type"] == "llama3"; real 3.1/3.2
+    # checkpoints SHIP with it, so ignoring it silently rotates q/k by
+    # wrong angles (round-4 review finding).
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
 
     @property
     def head_dim(self) -> int:
@@ -62,7 +69,8 @@ class LlamaConfig:
 
     @staticmethod
     def llama32_1b() -> "LlamaConfig":
-        return LlamaConfig()  # the defaults above are 3.2-1B geometry
+        return LlamaConfig(n_positions=131072,
+                           rope_scaling=(32.0, 1.0, 4.0, 8192))
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -81,7 +89,22 @@ class LlamaConfig:
 
     @staticmethod
     def from_hf_config(hf) -> "LlamaConfig":
-        """Map a transformers LlamaConfig."""
+        """Map a transformers LlamaConfig (incl. llama3 rope scaling;
+        other rope_type values are rejected loudly rather than silently
+        producing wrong rotations)."""
+        scaling = None
+        rs = getattr(hf, "rope_scaling", None)
+        if rs:
+            kind = rs.get("rope_type", rs.get("type"))
+            if kind != "llama3":
+                raise NotImplementedError(
+                    f"rope_scaling type {kind!r} not supported "
+                    "(llama3 only)")
+            scaling = (float(rs["factor"]),
+                       float(rs.get("low_freq_factor", 1.0)),
+                       float(rs.get("high_freq_factor", 4.0)),
+                       int(rs.get("original_max_position_embeddings",
+                                  8192)))
         return LlamaConfig(
             vocab_size=hf.vocab_size,
             n_positions=hf.max_position_embeddings,
@@ -93,7 +116,38 @@ class LlamaConfig:
             rope_theta=hf.rope_theta,
             rms_eps=hf.rms_norm_eps,
             tie_embeddings=hf.tie_word_embeddings,
+            rope_scaling=scaling,
         )
+
+
+def llama3_scaled_inv_freq(cfg: LlamaConfig):
+    """Rope inverse frequencies with the llama3 wavelength-dependent
+    scaling (HF _compute_llama3_parameters): high-frequency lanes keep
+    their period, low-frequency lanes stretch by ``factor``, the band in
+    between interpolates smoothly. None scaling -> plain 1/theta^(2i/d).
+    Trace-time constant."""
+    hd = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta
+                 ** (jnp.arange(0, hd, 2, jnp.float32) / hd))
+    if cfg.rope_scaling is None:
+        return inv
+    factor, low_f, high_f, orig_max = cfg.rope_scaling
+    low_wavelen = orig_max / low_f
+    high_wavelen = orig_max / high_f
+    wavelen = 2.0 * math.pi / inv
+    smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = (1.0 - smooth) * inv / factor + smooth * inv
+    out = jnp.where(wavelen > low_wavelen, inv / factor, inv)
+    return jnp.where((wavelen <= low_wavelen) & (wavelen >= high_wavelen),
+                     scaled, out)
+
+
+def llama_rope_tables(positions, cfg: LlamaConfig):
+    """(cos, sin) for this config at ``positions`` — the single place
+    every path (training forward, prefill, decode) gets rope from."""
+    return rope_cos_sin(positions, cfg.head_dim, theta=cfg.rope_theta,
+                        inv_freq=llama3_scaled_inv_freq(cfg))
 
 
 def _block_init(key, cfg: LlamaConfig, dtype):
@@ -133,23 +187,48 @@ def llama_init(key, cfg: LlamaConfig, *, dtype=jnp.float32):
     return params
 
 
-def _attention(p, x, cfg: LlamaConfig, *, cos, sin,
-               tp_axis: Optional[str], sp_axis: Optional[str],
-               sp_mode: str, use_flash: bool):
-    b, s, _ = x.shape
-    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+def llama_qkv(p_attn, a_in, cfg: LlamaConfig, cos, sin, *, tp: int = 1):
+    """Projections + rope, shared by training forward, prefill and
+    decode: normalized input [B, S, D] -> (q [B, Hq/tp, S, hd] rotated,
+    k [B, Hkv/tp, S, hd] rotated, v) — k/v UNrepeated (GQA)."""
+    b, s, _ = a_in.shape
     hd = cfg.head_dim
-    n_q = cfg.n_heads // tp
-    n_kv = cfg.n_kv_heads // tp
 
     def heads(w, n):
-        return jnp.dot(x, w).reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+        return jnp.dot(a_in, w).reshape(b, s, n, hd).transpose(0, 2, 1, 3)
 
-    q = apply_rope(heads(p["q"]["w"], n_q), cos, sin)
-    k = apply_rope(heads(p["k"]["w"], n_kv), cos, sin)
-    v = heads(p["v"]["w"], n_kv)
-    k = repeat_kv(k, n_q // n_kv)
-    v = repeat_kv(v, n_q // n_kv)
+    q = apply_rope(heads(p_attn["q"]["w"], cfg.n_heads // tp), cos, sin)
+    k = apply_rope(heads(p_attn["k"]["w"], cfg.n_kv_heads // tp), cos, sin)
+    return q, k, heads(p_attn["v"]["w"], cfg.n_kv_heads // tp)
+
+
+def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None):
+    """[B, H, S, hd] attention output -> o-proj (+tp psum) + residual."""
+    b = o.shape[0]
+    o = o.transpose(0, 2, 1, 3).reshape(b, o.shape[2], -1)
+    y = jnp.dot(o, p_attn["o"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    return x + y
+
+
+def llama_mlp_residual(p, x, cfg: LlamaConfig, *,
+                       tp_axis: Optional[str] = None):
+    return x + swiglu_apply(p["mlp"], rms_norm_apply(p["ln2"], x,
+                                                     eps=cfg.rms_eps),
+                            tp_axis=tp_axis)
+
+
+def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
+                      tp_axis: Optional[str] = None,
+                      sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                      use_flash: bool = False, key=None):
+    del key  # llama has no dropout
+    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
+    rep = q.shape[1] // k.shape[1]
+    k, v = repeat_kv(k, rep), repeat_kv(v, rep)
 
     if sp_axis is not None:
         from quintnet_tpu.ops.ring_attention import (ring_attention,
@@ -170,26 +249,38 @@ def _attention(p, x, cfg: LlamaConfig, *, cos, sin,
     else:
         o = sdpa(q, k, v, causal=True)
 
-    o = o.transpose(0, 2, 1, 3).reshape(b, s, n_q * hd)
-    y = jnp.dot(o, p["o"]["w"])
-    if tp_axis is not None:
-        y = lax.psum(y, tp_axis)
-    return y
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
+    return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
 
 
-def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
-                      tp_axis: Optional[str] = None,
-                      sp_axis: Optional[str] = None, sp_mode: str = "ring",
-                      use_flash: bool = False, key=None):
-    del key  # llama has no dropout
-    x = x + _attention(p["attn"], rms_norm_apply(p["ln1"], x,
-                                                 eps=cfg.rms_eps),
-                       cfg, cos=cos, sin=sin, tp_axis=tp_axis,
-                       sp_axis=sp_axis, sp_mode=sp_mode,
-                       use_flash=use_flash)
-    return x + swiglu_apply(p["mlp"], rms_norm_apply(p["ln2"], x,
-                                                     eps=cfg.rms_eps),
-                            tp_axis=tp_axis)
+def llama_block_prefill(p, x, cfg: LlamaConfig, cos, sin):
+    """Single-device causal block forward that also returns this layer's
+    UNrepeated (k, v) [B, Hkv, S, hd] for the decode cache."""
+    a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    o = sdpa(q, repeat_kv(k, rep), repeat_kv(v, rep), causal=True)
+    x = llama_attn_residual(p["attn"], x, o)
+    return llama_mlp_residual(p, x, cfg), (k, v)
+
+
+def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin):
+    """One cached token: x [B, 1, D], caches [B, Hkv, T, hd] ->
+    (x, updated caches). Masked attention over cache[:pos]."""
+    a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin)
+    kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos, axis=2)
+    vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos, axis=2)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    kf, vf = repeat_kv(kc, rep), repeat_kv(vc, rep)
+    scores = (jnp.einsum("bhqd,bhtd->bhqt", q, kf).astype(jnp.float32)
+              / math.sqrt(cfg.head_dim))
+    valid = jnp.arange(kf.shape[2])[None, None, None, :] <= pos
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    o = jnp.einsum("bhqt,bhtd->bhqd",
+                   jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
+    x = llama_attn_residual(p["attn"], x, o)
+    return llama_mlp_residual(p, x, cfg), (kc, vc)
 
 
 def _positions(b, s, sp_axis: Optional[str]):
@@ -207,8 +298,7 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
                  remat: "bool | str" = False, use_flash: bool = False):
     b, s = input_ids.shape
     h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
-    cos, sin = rope_cos_sin(_positions(b, s, sp_axis), cfg.head_dim,
-                            theta=cfg.rope_theta)
+    cos, sin = llama_rope_tables(_positions(b, s, sp_axis), cfg)
     import functools
 
     body = functools.partial(llama_block_apply, cfg=cfg, cos=cos, sin=sin,
@@ -298,8 +388,8 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
         def stage_fn(blocks_local, h, key=None):
             del key
             b, s = h.shape[:2]
-            cos, sin = rope_cos_sin(_positions(b, s, sp_axis),
-                                    cfg.head_dim, theta=cfg.rope_theta)
+            cos, sin = llama_rope_tables(_positions(b, s, sp_axis),
+                                         cfg)
             import functools
 
             body = functools.partial(

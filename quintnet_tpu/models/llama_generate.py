@@ -1,0 +1,98 @@
+"""Llama KV-cache generation: jitted prefill + lax.scan decode.
+
+Same decode-loop machinery as GPT-2 (models/gpt2_generate.autoregress —
+sampling, EOS, one compiled program); the per-layer math lives in
+models/llama.py (llama_block_prefill / llama_block_decode — the SAME
+helpers the training block is built from, so a fix there fixes decode
+too). GQA caches are stored UNrepeated ([L, B, H_kv, T, Dh] —
+1/(H/H_kv) the memory of a repeated cache; kv-head repeat happens at
+use).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from quintnet_tpu.models.gpt2_generate import autoregress
+from quintnet_tpu.models.llama import (LlamaConfig, llama_block_decode,
+                                       llama_block_prefill, llama_logits,
+                                       llama_rope_tables)
+
+
+def llama_prefill(params, input_ids, cfg: LlamaConfig, *, cache_len: int):
+    """[B, T0] -> (last-pos logits [B, V], (k, v) caches
+    [L, B, H_kv, cache_len, Dh])."""
+    B, T0 = input_ids.shape
+    h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
+    cos, sin = llama_rope_tables(jnp.arange(T0), cfg)
+
+    def body(x, blk):
+        x, kv = llama_block_prefill(blk, x, cfg, cos, sin)
+        return x, kv
+
+    h, (ks, vs) = lax.scan(body, h, params["blocks"])
+    pad = [(0, 0), (0, 0), (0, 0), (0, cache_len - T0), (0, 0)]
+    return (llama_logits(params, h[:, -1:, :], cfg)[:, 0, :],
+            (jnp.pad(ks, pad), jnp.pad(vs, pad)))
+
+
+def llama_decode_step(params, tok, pos, caches, cfg: LlamaConfig):
+    """One cached step: tok [B], pos scalar -> (logits [B, V], caches)."""
+    x = jnp.take(params["embedding"]["tok"], tok[:, None], axis=0)  # [B,1,D]
+    cos, sin = llama_rope_tables(
+        pos[None] if jnp.ndim(pos) == 0 else pos, cfg)
+    ks, vs = caches
+
+    def body(x, layer):
+        blk, kc, vc = layer
+        x, (kc, vc) = llama_block_decode(blk, x, kc, vc, pos, cfg, cos, sin)
+        return x, (kc, vc)
+
+    h, (ks, vs) = lax.scan(body, x, (params["blocks"], ks, vs))
+    return llama_logits(params, h, cfg)[:, 0, :], (ks, vs)
+
+
+def _llama_generate_body(params, input_ids, key, cfg: LlamaConfig,
+                         max_new_tokens: int, eos_token_id: Optional[int],
+                         temperature: float, top_k: int = 0,
+                         top_p: float = 1.0):
+    cache_len = input_ids.shape[1] + max_new_tokens
+    return autoregress(
+        lambda ids: llama_prefill(params, ids, cfg, cache_len=cache_len),
+        lambda tok, pos, caches: llama_decode_step(params, tok, pos,
+                                                   caches, cfg),
+        input_ids, key, max_new_tokens=max_new_tokens,
+        eos_token_id=eos_token_id, temperature=temperature,
+        top_k=top_k, top_p=top_p)
+
+
+_llama_generate_jit = partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "eos_token_id", "temperature",
+    "top_k", "top_p"))(_llama_generate_body)
+
+
+def llama_generate(params, input_ids, cfg: LlamaConfig, *,
+                   max_new_tokens: int, eos_token_id: Optional[int] = None,
+                   temperature: float = 0.0, top_k: int = 0,
+                   top_p: float = 1.0, key=None) -> np.ndarray:
+    """[B, T0] -> [B, T0 + max_new_tokens]; greedy when temperature==0,
+    temperature/top-k/top-p otherwise. One jitted prefill+decode
+    program per (shape, knobs)."""
+    if max_new_tokens < 1:
+        return np.asarray(input_ids)
+    if input_ids.shape[1] + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt {input_ids.shape[1]} + max_new {max_new_tokens} "
+            f"exceeds n_positions={cfg.n_positions}")
+    key = key if key is not None else jax.random.key(0)
+    out = _llama_generate_jit(params, jnp.asarray(input_ids, jnp.int32),
+                              key, cfg, int(max_new_tokens), eos_token_id,
+                              float(temperature), top_k=int(top_k),
+                              top_p=float(top_p))
+    return np.asarray(out)

@@ -34,13 +34,17 @@ def mha_init(key, dim: int, *, qkv_bias: bool = True, dtype=jnp.float32):
     }
 
 
-def rope_cos_sin(positions, head_dim: int, *, theta: float = 10000.0):
+def rope_cos_sin(positions, head_dim: int, *, theta: float = 10000.0,
+                 inv_freq=None):
     """Rotary tables for integer ``positions`` [...]: (cos, sin), each
     [..., head_dim] with the half-dim frequencies duplicated (HF Llama
-    layout: the i-th and (i+d/2)-th lanes share a frequency)."""
-    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32)
-                           / head_dim))                     # [d/2]
-    ang = positions.astype(jnp.float32)[..., None] * inv    # [..., d/2]
+    layout: the i-th and (i+d/2)-th lanes share a frequency).
+    ``inv_freq`` overrides the plain 1/theta^(2i/d) frequencies (rope
+    scaling — models/llama.py llama3_scaled_inv_freq)."""
+    if inv_freq is None:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32)
+                                    / head_dim))            # [d/2]
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
     ang = jnp.concatenate([ang, ang], axis=-1)              # [..., d]
     return jnp.cos(ang), jnp.sin(ang)
 

@@ -97,15 +97,69 @@ def test_strategy_loss_matches_single_device(name, mesh_dim, mesh_name):
     np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
 
 
-def test_gqa_repeat_matches_mha_when_kv_equals_heads():
-    """n_kv == n_heads must behave exactly as plain MHA (repeat_kv is
-    the identity)."""
-    mha = LlamaConfig.tiny(n_kv_heads=4)
-    params = llama_init(jax.random.key(0), mha)
+def test_gqa_equals_mha_with_repeated_kv_weights():
+    """A GQA model must equal an MHA model whose k/v projection columns
+    are the GQA columns repeated per group — pins repeat_kv's head
+    ORDER (group-contiguous, HF convention), not just shapes."""
+    import dataclasses
+
+    gqa = CFG  # n_heads=4, n_kv_heads=2
+    params = llama_init(jax.random.key(0), gqa)
+    rep = gqa.n_heads // gqa.n_kv_heads
+    hd = gqa.head_dim
+
+    def widen(w):  # [L, D, n_kv*hd] -> [L, D, n_heads*hd], group order
+        L, D, _ = w.shape
+        w = w.reshape(L, D, gqa.n_kv_heads, hd)
+        w = jnp.repeat(w, rep, axis=2)
+        return w.reshape(L, D, gqa.n_heads * hd)
+
+    mha_params = jax.tree.map(lambda x: x, params)
+    mha_params["blocks"] = dict(params["blocks"])
+    attn = dict(params["blocks"]["attn"])
+    attn["k"] = {"w": widen(attn["k"]["w"])}
+    attn["v"] = {"w": widen(attn["v"]["w"])}
+    mha_params["blocks"]["attn"] = attn
+
+    mha_cfg = dataclasses.replace(gqa, n_kv_heads=gqa.n_heads)
     ids = jnp.asarray(_ids())
-    out = llama_apply(params, ids, mha)
-    assert out.shape == (2, 16, mha.vocab_size)
-    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(
+        np.asarray(llama_apply(params, ids, gqa)),
+        np.asarray(llama_apply(mha_params, ids, mha_cfg)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_rope_scaling_matches_hf():
+    """llama3 rope scaling (the thing real 3.1/3.2 checkpoints ship
+    with) — logits vs HF with rope_scaling enabled."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from quintnet_tpu.models.llama import LlamaConfig as LC
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=CFG.vocab_size, hidden_size=CFG.dim,
+        intermediate_size=CFG.intermediate_size,
+        num_hidden_layers=CFG.n_layers, num_attention_heads=CFG.n_heads,
+        num_key_value_heads=CFG.n_kv_heads,
+        max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=CFG.rms_eps,
+        tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = LC.from_hf_config(hf_cfg)
+    assert cfg.rope_scaling == (8.0, 1.0, 4.0, 32)
+
+    params = llama_from_hf_state(hf.state_dict(), cfg)
+    ids = _ids(s=48)  # past original_max/2 so scaled lanes matter
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = np.asarray(llama_apply(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
 def test_tied_embeddings_variant():
@@ -114,3 +168,40 @@ def test_tied_embeddings_variant():
     assert "lm" not in params["head"]
     out = llama_apply(params, jnp.asarray(_ids(v=tied.vocab_size)), tied)
     assert out.shape == (2, 16, tied.vocab_size)
+
+
+@pytest.mark.fast
+def test_llama_generate_matches_full_forward_greedy():
+    """KV-cache decode == argmax over a full forward recompute per step
+    (the reference-style O(T^2) oracle), token for token."""
+    from quintnet_tpu.models.llama_generate import llama_generate
+
+    params = llama_init(jax.random.key(0), CFG)
+    ids = _ids(b=2, s=5, seed=3)
+    new = 6
+
+    # oracle: full forward each step
+    cur = np.asarray(ids)
+    for _ in range(new):
+        logits = llama_apply(params, jnp.asarray(cur), CFG)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1),
+                         np.int32)[:, None]
+        cur = np.concatenate([cur, nxt], axis=1)
+
+    fast = llama_generate(params, ids, CFG, max_new_tokens=new)
+    np.testing.assert_array_equal(fast, cur)
+
+
+def test_llama_generate_eos_and_sampling():
+    from quintnet_tpu.models.llama_generate import llama_generate
+
+    params = llama_init(jax.random.key(0), CFG)
+    ids = _ids(b=2, s=4, seed=4)
+    out = llama_generate(params, ids, CFG, max_new_tokens=5,
+                         eos_token_id=3, temperature=0.8, top_p=0.9,
+                         key=jax.random.key(1))
+    assert out.shape == (2, 9)
+    for row in out[:, 4:]:
+        hits = np.where(row == 3)[0]
+        if hits.size:
+            assert (row[hits[0]:] == 3).all()
