@@ -185,7 +185,8 @@ def bench_flash_attn(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2",
-                    choices=["gpt2", "gpt2-moe", "vit", "flash-attn"])
+                    choices=["gpt2", "gpt2-moe", "vit", "flash-attn",
+                             "llama"])
     ap.add_argument("--preset", default="base",
                     choices=["base", "medium", "large", "xl"],
                     help="GPT-2 size preset (--model gpt2/gpt2-moe); "
@@ -302,6 +303,33 @@ def main():
         name = f"gpt2_{size}" if args.model == "gpt2" else \
             f"gpt2_moe{args.experts}"
         metric = f"{name}_seq{args.seq}_train_samples_per_sec_per_chip"
+    elif args.model == "llama":
+        from quintnet_tpu.models.llama import LlamaConfig, llama_init, \
+            llama_model_spec
+
+        lmap = {"base": LlamaConfig.llama_160m, "medium": None,
+                "large": None, "xl": LlamaConfig.llama32_1b}
+        mk = lmap.get(args.preset) or LlamaConfig.llama_160m
+        lcfg = mk()
+        if args.seq > lcfg.n_positions:
+            lcfg = dataclasses.replace(lcfg, n_positions=args.seq)
+        if args.scan_unroll != 1:
+            lcfg = dataclasses.replace(lcfg, scan_unroll=args.scan_unroll)
+        compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
+        remat = ("dots" if (args.remat and args.remat_policy == "dots")
+                 else bool(args.remat))
+        model = llama_model_spec(lcfg, remat=remat,
+                                 use_flash=args.seq >= 4096,
+                                 compute_dtype=compute_dtype)
+        ids = np.random.default_rng(0).integers(
+            0, lcfg.vocab_size, size=(args.batch * n_dev, args.seq),
+            dtype=np.int32)
+        batch = (jnp.asarray(ids), jnp.asarray(ids))
+        n_params = sum(int(np.prod(l.shape)) for l in
+                       jax.tree.leaves(llama_init(jax.random.key(0), lcfg)))
+        flops_per_step = 6.0 * n_params * args.batch * n_dev * args.seq
+        metric = (f"llama_{round(n_params / 1e6)}m_seq{args.seq}"
+                  "_train_samples_per_sec_per_chip")
     else:
         from quintnet_tpu.models.vit import (ViTConfig, vit_init,
                                              vit_model_spec)

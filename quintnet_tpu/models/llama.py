@@ -80,6 +80,15 @@ class LlamaConfig:
                            tie_embeddings=False)
 
     @staticmethod
+    def llama_160m() -> "LlamaConfig":
+        """GPT-2-base-comparable geometry for cross-family benchmarking
+        (not a released Llama size)."""
+        return LlamaConfig(vocab_size=32000, n_positions=2048, dim=768,
+                           n_layers=12, n_heads=12, n_kv_heads=4,
+                           intermediate_size=2048, rope_theta=10000.0,
+                           tie_embeddings=True)
+
+    @staticmethod
     def tiny(**kw) -> "LlamaConfig":
         d = dict(vocab_size=128, n_positions=64, dim=32, n_layers=2,
                  n_heads=4, n_kv_heads=2, intermediate_size=64,
