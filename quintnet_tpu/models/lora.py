@@ -35,7 +35,9 @@ from typing import Dict, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-DEFAULT_TARGETS = ("qkv", "proj", "fc")
+DEFAULT_TARGETS = ("qkv", "proj", "fc")          # GPT-2 / ViT blocks
+LLAMA_TARGETS = ("q", "k", "v", "o", "gate", "up", "down")
+LLAMA_ATTN_TARGETS = ("q", "v")                  # the classic LoRA subset
 
 
 @dataclass(frozen=True)

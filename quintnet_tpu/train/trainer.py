@@ -365,6 +365,7 @@ class Trainer:
             # reads happen only at log boundaries and epoch end.
             losses = []
             t_win = time.time()
+            sync_every = self.config.training.sync_every
             for i, (xb, yb) in enumerate(train_batches_fn(epoch)):
                 batch = self.strategy.shard_batch(
                     (jnp.asarray(xb), jnp.asarray(yb)), self.model)
@@ -375,6 +376,9 @@ class Trainer:
                 params, opt_state, loss = self.step_fn(params, opt_state,
                                                        batch, seed)
                 losses.append(loss)
+                if sync_every and (i + 1) % sync_every == 0:
+                    # bound async run-ahead (training.sync_every docs)
+                    float(loss)
                 if log_every and (i + 1) % log_every == 0:
                     # the float() is the device sync for the window, so
                     # the wall clock measured here is honest throughput

@@ -153,3 +153,45 @@ def test_tp_shard_local_merge_matches_single_device(base):
     out = fwd(base_tp, lora, jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=1e-5)
+
+
+def test_lora_on_llama_family():
+    """The adapter walker is name-based, so the same LoRA machinery
+    trains Llama blocks (q/v targets, classic LoRA) untouched."""
+    from quintnet_tpu.models.llama import (LlamaConfig, llama_apply,
+                                           llama_init)
+    from quintnet_tpu.models.lora import LLAMA_ATTN_TARGETS
+
+    lcfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(0), lcfg)
+    lora_cfg = LoRAConfig(rank=2, alpha=4.0, targets=LLAMA_ATTN_TARGETS)
+    lora = lora_init(jax.random.key(1), params["blocks"], lora_cfg)
+    assert set(lora["attn"]) == {"q", "v"}
+
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, lcfg.vocab_size, (2, 8), dtype=np.int32))
+    merged = lora_merge_tree(params, lora, lora_cfg)
+    np.testing.assert_allclose(  # b zero-init -> identity
+        np.asarray(llama_apply(merged, ids, lcfg)),
+        np.asarray(llama_apply(params, ids, lcfg)), rtol=1e-6, atol=1e-6)
+
+    import optax
+
+    fwd = lora_wrap(lambda p, i: llama_apply(p, i, lcfg), params, lora_cfg)
+    from quintnet_tpu.models.gpt2 import clm_loss
+
+    opt = optax.adam(1e-2)
+    state = opt.init(lora)
+
+    @jax.jit
+    def step(lora, state):
+        loss, g = jax.value_and_grad(
+            lambda l: clm_loss(fwd(l, ids), ids))(lora)
+        up, state = opt.update(g, state, lora)
+        return optax.apply_updates(lora, up), state, loss
+
+    l0 = None
+    for _ in range(8):
+        lora, state, loss = step(lora, state)
+        l0 = l0 if l0 is not None else float(loss)
+    assert float(loss) < l0
