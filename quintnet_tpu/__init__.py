@@ -1,4 +1,4 @@
-"""QuintNet-TPU: a TPU-native 3D+-parallel training framework.
+"""QuintNet-TPU: a TPU-native 5D-parallel training framework.
 
 A from-scratch JAX/XLA re-design of the capabilities of the reference
 QuintNet library (pure-Python PyTorch + NCCL 3D parallelism; see
@@ -24,7 +24,7 @@ optimizers/zero.py), Pallas TPU kernels, profiling, and a simulated
 multi-device test story that needs no real multi-host hardware.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from quintnet_tpu.core.config import Config, load_config
 from quintnet_tpu.core.mesh import MeshSpec, build_mesh
