@@ -267,6 +267,10 @@ def main():
     })
     strat = get_strategy("auto" if n_dev > 1 else "dp", cfg)
 
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
+    remat = ("dots" if (args.remat and args.remat_policy == "dots")
+             else bool(args.remat))
+
     if args.model in ("gpt2", "gpt2-moe"):
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
 
@@ -286,9 +290,6 @@ def main():
             gcfg = dataclasses.replace(gcfg, loss_chunk=args.loss_chunk)
         if args.scan_unroll != 1:
             gcfg = dataclasses.replace(gcfg, scan_unroll=args.scan_unroll)
-        compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
-        remat = ("dots" if (args.remat and args.remat_policy == "dots")
-                 else bool(args.remat))
         model = gpt2_model_spec(gcfg, remat=remat,
                                 use_flash=use_flash,
                                 compute_dtype=compute_dtype)
@@ -307,17 +308,16 @@ def main():
         from quintnet_tpu.models.llama import LlamaConfig, llama_init, \
             llama_model_spec
 
-        lmap = {"base": LlamaConfig.llama_160m, "medium": None,
-                "large": None, "xl": LlamaConfig.llama32_1b}
-        mk = lmap.get(args.preset) or LlamaConfig.llama_160m
-        lcfg = mk()
+        lmap = {"base": LlamaConfig.llama_160m,
+                "xl": LlamaConfig.llama32_1b}
+        if args.preset not in lmap:
+            ap.error(f"--model llama supports --preset base (160M) or "
+                     f"xl (3.2-1B); got {args.preset!r}")
+        lcfg = lmap[args.preset]()
         if args.seq > lcfg.n_positions:
             lcfg = dataclasses.replace(lcfg, n_positions=args.seq)
         if args.scan_unroll != 1:
             lcfg = dataclasses.replace(lcfg, scan_unroll=args.scan_unroll)
-        compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
-        remat = ("dots" if (args.remat and args.remat_policy == "dots")
-                 else bool(args.remat))
         model = llama_model_spec(lcfg, remat=remat,
                                  use_flash=args.seq >= 4096,
                                  compute_dtype=compute_dtype)

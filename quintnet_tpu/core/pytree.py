@@ -13,6 +13,31 @@ def tree_count_params(tree) -> int:
     return sum(x.size for x in jax.tree.leaves(tree))
 
 
+# Dict keys naming weight matrices / embedding tables — the leaves that
+# AdamW weight decay applies to. Everything else (biases, LayerNorm/
+# RMSNorm scales and shifts, cls/pos tokens) is skipped. NAME-based on
+# purpose: an ndim test misclassifies stacked-block leaves (a stacked
+# bias is [L, out] = ndim 2 — the round-4 review caught exactly that
+# bug in the previous ndim>1 mask).
+DECAY_KEYS = frozenset({
+    "w", "w1", "w2",                # linear / MoE expert matrices
+    "wte", "wpe", "tok", "table",   # embedding tables
+})
+
+
+def decay_mask(params):
+    """Boolean pytree: True on leaves whose dict key is in DECAY_KEYS
+    (full-shape masks so the ZeRO flat-chunk path can ravel them)."""
+    from jax.tree_util import DictKey, tree_map_with_path
+
+    def m(path, p):
+        key = next((k.key for k in reversed(path)
+                    if isinstance(k, DictKey)), "")
+        return jnp.full(p.shape, key in DECAY_KEYS, jnp.bool_)
+
+    return tree_map_with_path(m, params)
+
+
 def tree_bytes(tree) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
 

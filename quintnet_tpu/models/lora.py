@@ -141,33 +141,42 @@ def lora_wrap(apply_fn, base_params, cfg: LoRAConfig, *,
     return fn
 
 
-def lora_partition_specs(block_specs, cfg: LoRAConfig):
+def lora_partition_specs(block_specs, cfg: LoRAConfig, *, blocks=None):
     """PartitionSpec tree for an adapter tree, derived from the weight
     specs: a inherits the in-dim sharding, b the out-dim sharding, rank
     unsharded (see module docstring for why the local merge is then
-    exact)."""
+    exact).
+
+    PartitionSpec omits trailing Nones (P('tp') on a 2-D weight shards
+    dim 0), so short specs are right-padded before splitting off the
+    (in, out) dims. Pass ``blocks`` (the param tree) to pad to each
+    weight's true rank; without it, specs shorter than 2 pad to length
+    2 — correct for unstacked weights, ambiguous for stacked weights
+    with rank-deficient specs (supply ``blocks`` there)."""
     from jax.sharding import PartitionSpec as P
 
-    def walk(node):
+    def walk(node, bnode):
         if not isinstance(node, dict):
             return None
         out = {}
         for k, v in node.items():
+            bv = bnode.get(k) if isinstance(bnode, dict) else None
             if (k in cfg.targets and isinstance(v, dict) and "w" in v
                     and not isinstance(v["w"], dict)):
                 wspec = tuple(v["w"])  # PartitionSpec() -> ()
-                lead = wspec[:-2] if len(wspec) >= 2 else ()
-                s_in = wspec[-2] if len(wspec) >= 2 else None
-                s_out = wspec[-1] if len(wspec) >= 1 else None
-                out[k] = {"a": P(*lead, s_in, None),
-                          "b": P(*lead, None, s_out)}
+                rank = (bv["w"].ndim if isinstance(bv, dict)
+                        and hasattr(bv.get("w"), "ndim")
+                        else max(len(wspec), 2))
+                wspec = wspec + (None,) * (rank - len(wspec))
+                out[k] = {"a": P(*wspec[:-2], wspec[-2], None),
+                          "b": P(*wspec[:-2], None, wspec[-1])}
             else:
-                sub = walk(v)
+                sub = walk(v, bv)
                 if sub:
                     out[k] = sub
         return out
 
-    return walk(block_specs) or {}
+    return walk(block_specs, blocks) or {}
 
 
 def lora_param_count(lora) -> int:

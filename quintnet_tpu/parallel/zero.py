@@ -64,14 +64,16 @@ def _chunk_apply(opt_extra, g_chunk, opt_state, params, flat_p, unravel,
                  axis: str, dp, r, chunk: int):
     """Shared ZeRO chunk update: masked-decay mask, inner optimizer on
     the chunk, all-gather of the updated params. The elementwise decay
-    mask (ndim>1 leaves) is raveled and chunked like the params:
-    per-leaf optax masks cannot see parameter boundaries inside the flat
-    chunk, so masked_decay (train/trainer.py) takes it via the
-    extra-args protocol; transforms without extra-args support ignore
-    it. Trace-time constant — XLA folds it."""
+    mask (core/pytree.decay_mask — name-based) is raveled and chunked
+    like the params: per-leaf optax masks cannot see parameter
+    boundaries inside the flat chunk, so masked_decay (train/trainer.py)
+    takes it via the extra-args protocol; transforms without extra-args
+    support ignore it. Trace-time constant — XLA folds it."""
+    from quintnet_tpu.core.pytree import decay_mask
+
     p_chunk = local_chunk(flat_p, dp, r, chunk)
     flat_m, _ = ravel_pytree(jax.tree.map(
-        lambda p: jnp.full(p.shape, p.ndim > 1, flat_p.dtype), params))
+        lambda m: m.astype(flat_p.dtype), decay_mask(params)))
     m_chunk = local_chunk(flat_m, dp, r, chunk)
     updates, opt_state = opt_extra.update(g_chunk, opt_state, p_chunk,
                                           decay_mask=m_chunk)

@@ -71,20 +71,23 @@ def make_lr_schedule(cfg: Config):
 
 
 def masked_decay(weight_decay: float):
-    """Decoupled weight decay skipping LayerNorm scales and biases.
+    """Decoupled weight decay skipping biases and norm scales/shifts.
 
     Standard practice (and what torch AdamW users hand-configure via
     param groups); the reference decays everything (GPT2_Trainer.py:100).
-    Default mask: decay only leaves with ndim > 1 (matrices/embeddings);
-    1-D leaves (biases, LN scale/shift) are skipped.
+    Default mask: NAME-based — dict keys in core/pytree.DECAY_KEYS
+    (weight matrices, embedding tables) decay; everything else is
+    skipped. Name-based because an ndim test misclassifies
+    stacked-block leaves (a stacked bias is [L, out] = ndim 2).
 
-    Under ZeRO-1 the optimizer runs on a flat chunk where per-leaf
+    Under ZeRO the optimizer runs on a flat chunk where per-leaf
     masking cannot see parameter boundaries, so the transform also
     accepts an ELEMENTWISE ``decay_mask`` extra arg (optax extra-args
-    protocol); parallel/zero.py ravels the ndim>1 mask alongside the
+    protocol); parallel/zero.py ravels the SAME mask alongside the
     params and passes its chunk — the two paths are bit-identical
-    (tests/test_zero.py).
+    (tests/test_optimizer.py).
     """
+    from quintnet_tpu.core.pytree import decay_mask as default_mask
 
     def init_fn(params):
         del params
@@ -95,8 +98,7 @@ def masked_decay(weight_decay: float):
         if params is None:
             raise ValueError("masked_decay requires params")
         if decay_mask is None:
-            decay_mask = jax.tree.map(
-                lambda p: jnp.asarray(p.ndim > 1, p.dtype), params)
+            decay_mask = default_mask(params)
         updates = jax.tree.map(
             lambda u, p, m: u + weight_decay * m.astype(u.dtype) * p,
             updates, params, decay_mask)

@@ -130,3 +130,36 @@ def test_zero1_masked_decay_matches_replicated():
     p_z = _train("zero1_adamw", n_steps=1)
     for a, b in zip(jax.tree.leaves(p_z), jax.tree.leaves(p_ref)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_decay_mask_skips_stacked_biases_and_norms():
+    """Round-4 review regression: stacked-block leaves (leading depth
+    dim) made biases/LN ndim-2, so the old ndim>1 mask decayed them.
+    The name-based mask must not."""
+    from quintnet_tpu.core.pytree import decay_mask
+    from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    params = gpt2_init(jax.random.key(0), GPT2Config.tiny())
+    mask = decay_mask(params)
+    blocks = mask["blocks"]
+    assert bool(blocks["attn"]["qkv"]["w"].all())        # [L, D, 3D]
+    assert not bool(blocks["attn"]["qkv"]["b"].any())    # [L, 3D] bias!
+    assert not bool(blocks["ln1"]["scale"].any())        # [L, D] LN!
+    assert not bool(blocks["ln1"]["bias"].any())
+    assert bool(mask["embedding"]["wte"].all())
+    assert not bool(mask["head"]["ln_f"]["scale"].any())
+
+    # end-to-end: zero grads -> update is pure decay; stacked biases
+    # and LN leaves must receive exactly zero update
+    lr, wd = 0.1, 0.5
+    opt = make_optimizer(_cfg(optimizer="adamw", learning_rate=lr,
+                              weight_decay=wd))
+    grads = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = opt.update(grads, opt.init(params), params)
+    np.testing.assert_array_equal(updates["blocks"]["attn"]["qkv"]["b"],
+                                  jnp.zeros_like(params["blocks"]["attn"]["qkv"]["b"]))
+    np.testing.assert_array_equal(updates["blocks"]["ln1"]["scale"],
+                                  jnp.zeros_like(params["blocks"]["ln1"]["scale"]))
+    np.testing.assert_allclose(
+        updates["blocks"]["attn"]["qkv"]["w"],
+        -lr * wd * params["blocks"]["attn"]["qkv"]["w"], rtol=1e-6)
