@@ -262,25 +262,31 @@ def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
     return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
 
 
-def llama_block_prefill(p, x, cfg: LlamaConfig, cos, sin):
-    """Single-device causal block forward that also returns this layer's
-    UNrepeated (k, v) [B, Hkv, S, hd] for the decode cache."""
+def llama_block_prefill(p, x, cfg: LlamaConfig, cos, sin,
+                        tp_axis: Optional[str] = None):
+    """Causal block forward that also returns this layer's UNrepeated
+    (k, v) [B, Hkv(/tp), S, hd] for the decode cache. Under ``tp_axis``
+    heads are LOCAL (head-sharded cache) with the RowParallel psum in
+    the residual."""
+    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
-    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin)
-    rep = cfg.n_heads // cfg.n_kv_heads
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
+    rep = q.shape[1] // k.shape[1]
     o = sdpa(q, repeat_kv(k, rep), repeat_kv(v, rep), causal=True)
-    x = llama_attn_residual(p["attn"], x, o)
-    return llama_mlp_residual(p, x, cfg), (k, v)
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
+    return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis), (k, v)
 
 
-def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin):
-    """One cached token: x [B, 1, D], caches [B, Hkv, T, hd] ->
+def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
+                       tp_axis: Optional[str] = None):
+    """One cached token: x [B, 1, D], caches [B, Hkv(/tp), T, hd] ->
     (x, updated caches). Masked attention over cache[:pos]."""
+    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
-    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
     kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos, axis=2)
     vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos, axis=2)
-    rep = cfg.n_heads // cfg.n_kv_heads
+    rep = q.shape[1] // kc.shape[1]
     kf, vf = repeat_kv(kc, rep), repeat_kv(vc, rep)
     scores = (jnp.einsum("bhqd,bhtd->bhqt", q, kf).astype(jnp.float32)
               / math.sqrt(cfg.head_dim))
@@ -288,8 +294,8 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin):
     scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
     o = jnp.einsum("bhqt,bhtd->bhqd",
                    jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
-    x = llama_attn_residual(p["attn"], x, o)
-    return llama_mlp_residual(p, x, cfg), (kc, vc)
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
+    return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis), (kc, vc)
 
 
 def _positions(b, s, sp_axis: Optional[str]):

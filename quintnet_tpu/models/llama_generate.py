@@ -25,15 +25,16 @@ from quintnet_tpu.models.llama import (LlamaConfig, llama_block_decode,
                                        llama_rope_tables)
 
 
-def llama_prefill(params, input_ids, cfg: LlamaConfig, *, cache_len: int):
+def llama_prefill(params, input_ids, cfg: LlamaConfig, *, cache_len: int,
+                  tp_axis=None):
     """[B, T0] -> (last-pos logits [B, V], (k, v) caches
-    [L, B, H_kv, cache_len, Dh])."""
+    [L, B, H_kv(/tp), cache_len, Dh])."""
     B, T0 = input_ids.shape
     h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
     cos, sin = llama_rope_tables(jnp.arange(T0), cfg)
 
     def body(x, blk):
-        x, kv = llama_block_prefill(blk, x, cfg, cos, sin)
+        x, kv = llama_block_prefill(blk, x, cfg, cos, sin, tp_axis=tp_axis)
         return x, kv
 
     h, (ks, vs) = lax.scan(body, h, params["blocks"])
@@ -42,7 +43,8 @@ def llama_prefill(params, input_ids, cfg: LlamaConfig, *, cache_len: int):
             (jnp.pad(ks, pad), jnp.pad(vs, pad)))
 
 
-def llama_decode_step(params, tok, pos, caches, cfg: LlamaConfig):
+def llama_decode_step(params, tok, pos, caches, cfg: LlamaConfig,
+                      tp_axis=None):
     """One cached step: tok [B], pos scalar -> (logits [B, V], caches)."""
     x = jnp.take(params["embedding"]["tok"], tok[:, None], axis=0)  # [B,1,D]
     cos, sin = llama_rope_tables(
@@ -51,7 +53,8 @@ def llama_decode_step(params, tok, pos, caches, cfg: LlamaConfig):
 
     def body(x, layer):
         blk, kc, vc = layer
-        x, (kc, vc) = llama_block_decode(blk, x, kc, vc, pos, cfg, cos, sin)
+        x, (kc, vc) = llama_block_decode(blk, x, kc, vc, pos, cfg, cos, sin,
+                                         tp_axis=tp_axis)
         return x, (kc, vc)
 
     h, (ks, vs) = lax.scan(body, x, (params["blocks"], ks, vs))
@@ -61,12 +64,14 @@ def llama_decode_step(params, tok, pos, caches, cfg: LlamaConfig):
 def _llama_generate_body(params, input_ids, key, cfg: LlamaConfig,
                          max_new_tokens: int, eos_token_id: Optional[int],
                          temperature: float, top_k: int = 0,
-                         top_p: float = 1.0):
+                         top_p: float = 1.0, tp_axis=None):
     cache_len = input_ids.shape[1] + max_new_tokens
     return autoregress(
-        lambda ids: llama_prefill(params, ids, cfg, cache_len=cache_len),
+        lambda ids: llama_prefill(params, ids, cfg, cache_len=cache_len,
+                                  tp_axis=tp_axis),
         lambda tok, pos, caches: llama_decode_step(params, tok, pos,
-                                                   caches, cfg),
+                                                   caches, cfg,
+                                                   tp_axis=tp_axis),
         input_ids, key, max_new_tokens=max_new_tokens,
         eos_token_id=eos_token_id, temperature=temperature,
         top_k=top_k, top_p=top_p)
@@ -96,3 +101,56 @@ def llama_generate(params, input_ids, cfg: LlamaConfig, *,
                               float(temperature), top_k=int(top_k),
                               top_p=float(top_p))
     return np.asarray(out)
+
+
+def llama_generate_tp(params, input_ids, cfg: LlamaConfig, *, mesh,
+                      tp_axis: str = "tp", max_new_tokens: int,
+                      eos_token_id: Optional[int] = None,
+                      temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, key=None) -> np.ndarray:
+    """TP-sharded Llama decode on a live mesh: params stay in their
+    training layout (llama_partition_specs), whole prefill + decode
+    scan under one shard_map — head-sharded GQA caches with the
+    RowParallel psum per cached step. Output tokens replicated,
+    token-for-token equal to single-device decode
+    (tests/test_llama.py golden). Same capability gpt2_generate_tp
+    gives GPT-2; the reference skips generation under any parallelism
+    (GPT2_Trainer.py:509-555)."""
+    if max_new_tokens < 1:
+        return np.asarray(input_ids)
+    if input_ids.shape[1] + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt {input_ids.shape[1]} + max_new {max_new_tokens} "
+            f"exceeds n_positions={cfg.n_positions}")
+    key = key if key is not None else jax.random.key(0)
+    fn = _llama_tp_generate_fn(cfg, mesh, tp_axis, int(max_new_tokens),
+                               eos_token_id, float(temperature),
+                               int(top_k), float(top_p))
+    return np.asarray(fn(params, jnp.asarray(input_ids, jnp.int32), key))
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _llama_tp_generate_fn(cfg: LlamaConfig, mesh, tp_axis: str,
+                          max_new_tokens: int, eos_token_id: Optional[int],
+                          temperature: float, top_k: int, top_p: float):
+    """One cached jitted shard_map program per (cfg, mesh, knobs)."""
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.core import collectives as cc
+    from quintnet_tpu.models.llama import llama_partition_specs
+
+    specs = llama_partition_specs(cfg, tp_axis=tp_axis)
+
+    def local_gen(p, ids, k):
+        return _llama_generate_body(p, ids, k, cfg, max_new_tokens,
+                                    eos_token_id, temperature,
+                                    top_k=top_k, top_p=top_p,
+                                    tp_axis=tp_axis)
+
+    return jax.jit(cc.shard_map_fn(
+        local_gen, mesh,
+        in_specs=(specs, P(), P()),
+        out_specs=P()))

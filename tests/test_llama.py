@@ -205,3 +205,24 @@ def test_llama_generate_eos_and_sampling():
         hits = np.where(row == 3)[0]
         if hits.size:
             assert (row[hits[0]:] == 3).all()
+
+
+def test_llama_tp_generate_matches_single_device():
+    """tp=2 decode on the training layout == single-device decode,
+    token for token (greedy)."""
+    from quintnet_tpu.core.mesh import mesh_from_sizes
+    from quintnet_tpu.models.llama_generate import (llama_generate,
+                                                    llama_generate_tp)
+    from quintnet_tpu.parallel.train_step import shard_pytree
+    from quintnet_tpu.models.llama import llama_partition_specs
+
+    params = llama_init(jax.random.key(0), CFG)
+    ids = _ids(b=2, s=5, seed=7)
+    ref = llama_generate(params, ids, CFG, max_new_tokens=5)
+
+    mesh = mesh_from_sizes(tp=2)
+    specs = llama_partition_specs(CFG, tp_axis="tp")
+    sharded = shard_pytree(mesh, params, specs)
+    out = llama_generate_tp(sharded, ids, CFG, mesh=mesh,
+                            max_new_tokens=5)
+    np.testing.assert_array_equal(out, ref)
