@@ -66,7 +66,8 @@ def test_remat_and_flashpath_match_plain():
      ("tp", [2], ["tp"]),
      ("dp_tp", [2, 2], ["dp", "tp"]),
      ("sp", [2], ["sp"]),
-     ("pp", [2], ["pp"])])
+     ("pp", [2], ["pp"]),
+     ("3d", [2, 2, 2], ["dp", "tp", "pp"])])
 def test_strategy_loss_matches_single_device(name, mesh_dim, mesh_name):
     import optax
 
