@@ -21,6 +21,7 @@ def tree_count_params(tree) -> int:
 # bug in the previous ndim>1 mask).
 DECAY_KEYS = frozenset({
     "w", "w1", "w2",                # linear / MoE expert matrices
+    "wg", "wu", "wd",               # SwiGLU MoE expert matrices
     "wte", "wpe", "tok", "table",   # embedding tables
 })
 

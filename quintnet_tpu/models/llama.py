@@ -37,6 +37,7 @@ from quintnet_tpu.nn.attention import (apply_rope, repeat_kv, rope_cos_sin,
 from quintnet_tpu.nn.layers import (cast_floating, linear_init,
                                     rms_norm_apply, rms_norm_init,
                                     swiglu_apply, swiglu_init)
+from quintnet_tpu.nn.moe import moe_apply, moe_init, moe_specs
 from quintnet_tpu.nn.transformer import stacked_blocks_apply
 
 from quintnet_tpu.models.gpt2 import clm_loss, clm_loss_sp  # shared CLM loss
@@ -55,6 +56,14 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     tie_embeddings: bool = True      # Llama-3.2-1B ties; 7B+ do not
     scan_unroll: int = 1
+    # --- MoE (0 = dense): every block's SwiGLU becomes a top-k routed
+    # mixture of SwiGLU experts (Mixtral-style; nn/moe.py swiglu expert
+    # type), shardable over the ``ep`` mesh axis
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    expert_capacity: Optional[int] = None
+    aux_loss_weight: float = 1e-2
     # llama3-style rope scaling (None = unscaled). Tuple (hashable — the
     # config is a jit static arg): (factor, low_freq_factor,
     # high_freq_factor, original_max_position). HF applies this when
@@ -66,6 +75,17 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def moe_args(self):
+        if self.n_experts <= 0:
+            return None
+        from quintnet_tpu.nn.moe import MoEArgs
+
+        return MoEArgs(n_experts=self.n_experts, top_k=self.expert_top_k,
+                       capacity_factor=self.capacity_factor,
+                       capacity=self.expert_capacity,
+                       aux_weight=self.aux_loss_weight)
 
     @staticmethod
     def llama32_1b() -> "LlamaConfig":
@@ -175,7 +195,10 @@ def _block_init(key, cfg: LlamaConfig, dtype):
                              dtype=dtype),
         },
         "ln2": rms_norm_init(d, dtype),
-        "mlp": swiglu_init(km, d, cfg.intermediate_size, dtype=dtype),
+        **({"moe": moe_init(km, d, cfg.intermediate_size, cfg.n_experts,
+                            dtype=dtype, expert_type="swiglu")}
+           if cfg.n_experts > 0 else
+           {"mlp": swiglu_init(km, d, cfg.intermediate_size, dtype=dtype)}),
     }
 
 
@@ -223,15 +246,20 @@ def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None):
 
 def llama_mlp_residual(p, x, cfg: LlamaConfig, *,
                        tp_axis: Optional[str] = None):
-    return x + swiglu_apply(p["mlp"], rms_norm_apply(p["ln2"], x,
-                                                     eps=cfg.rms_eps),
-                            tp_axis=tp_axis)
+    h = rms_norm_apply(p["ln2"], x, eps=cfg.rms_eps)
+    if "moe" in p:  # aux discarded (eval/decode path)
+        y, _aux = moe_apply(p["moe"], h, cfg.moe_args, tp_axis=tp_axis)
+        return x + y
+    return x + swiglu_apply(p["mlp"], h, tp_axis=tp_axis)
 
 
 def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
                       tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None, sp_mode: str = "ring",
-                      use_flash: bool = False, key=None):
+                      use_flash: bool = False, ep_axis: Optional[str] = None,
+                      key=None):
+    """Returns ``x`` for dense configs, ``(x, aux)`` for MoE (the
+    stacked-scan runner's moe path accumulates aux per layer)."""
     del key  # llama has no dropout
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
@@ -259,6 +287,11 @@ def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
         o = sdpa(q, k, v, causal=True)
 
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
+    if cfg.n_experts > 0:
+        h = rms_norm_apply(p["ln2"], x, eps=cfg.rms_eps)
+        y, aux = moe_apply(p["moe"], h, cfg.moe_args, ep_axis=ep_axis,
+                           tp_axis=tp_axis)
+        return x + y, aux  # runner pmeans the aux sum over sp
     return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
 
 
@@ -310,7 +343,9 @@ def _positions(b, s, sp_axis: Optional[str]):
 def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
                  tp_axis: Optional[str] = None,
                  sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                 ep_axis: Optional[str] = None,
                  remat: "bool | str" = False, use_flash: bool = False):
+    """-> (final hidden states, moe aux total — 0.0 for dense)."""
     b, s = input_ids.shape
     h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
     cos, sin = llama_rope_tables(_positions(b, s, sp_axis), cfg)
@@ -318,10 +353,13 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
 
     body = functools.partial(llama_block_apply, cfg=cfg, cos=cos, sin=sin,
                              tp_axis=tp_axis, sp_axis=sp_axis,
-                             sp_mode=sp_mode, use_flash=use_flash)
-    return stacked_blocks_apply(
+                             sp_mode=sp_mode, use_flash=use_flash,
+                             ep_axis=ep_axis)
+    out = stacked_blocks_apply(
         params["blocks"], h, num_heads=0, body_fn=body, remat=remat,
+        moe_args=cfg.moe_args, sp_axis=sp_axis,
         scan_unroll=cfg.scan_unroll)
+    return out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
 
 
 def llama_logits(params, h, cfg: LlamaConfig):
@@ -334,10 +372,12 @@ def llama_logits(params, h, cfg: LlamaConfig):
 def llama_apply(params, input_ids, cfg: LlamaConfig, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
+                ep_axis: Optional[str] = None,
                 remat: "bool | str" = False, use_flash: bool = False):
-    h = llama_hidden(params, input_ids, cfg, tp_axis=tp_axis,
-                     sp_axis=sp_axis, sp_mode=sp_mode, remat=remat,
-                     use_flash=use_flash)
+    h, _aux = llama_hidden(params, input_ids, cfg, tp_axis=tp_axis,
+                           sp_axis=sp_axis, sp_mode=sp_mode,
+                           ep_axis=ep_axis, remat=remat,
+                           use_flash=use_flash)
     return llama_logits(params, h, cfg)
 
 
@@ -359,8 +399,16 @@ def llama_partition_specs(cfg: Optional[LlamaConfig] = None, *,
         "attn": {"q": {"w": col}, "k": {"w": col}, "v": {"w": col},
                  "o": {"w": row}},
         "ln2": {"scale": rep},
-        "mlp": {"gate": {"w": col}, "up": {"w": col}, "down": {"w": row}},
     }
+    if cfg is not None and cfg.n_experts > 0:
+        from quintnet_tpu.nn.moe import moe_specs
+
+        blocks["moe"] = moe_specs(ep_axis=ep_axis, tp_axis=t,
+                                  stacked=True, pp_axis=pp_axis,
+                                  expert_type="swiglu")
+    else:
+        blocks["mlp"] = {"gate": {"w": col}, "up": {"w": col},
+                         "down": {"w": row}}
     specs = {
         "embedding": {"tok": P()},
         "blocks": blocks,
@@ -383,17 +431,18 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
 
     def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
                 key=None):
-        del ep_axis, key
+        del key
         input_ids, labels = batch
-        logits = llama_apply(cast(params), input_ids, cfg, tp_axis=tp_axis,
-                             sp_axis=sp_axis, sp_mode=sp_mode, remat=remat,
-                             use_flash=use_flash)
+        h, aux = llama_hidden(cast(params), input_ids, cfg,
+                              tp_axis=tp_axis, sp_axis=sp_axis,
+                              sp_mode=sp_mode, ep_axis=ep_axis,
+                              remat=remat, use_flash=use_flash)
+        logits = llama_logits(cast(params), h, cfg)
         if sp_axis is not None:
-            return clm_loss_sp(logits, labels, sp_axis=sp_axis)
-        return clm_loss(logits, labels)
+            return clm_loss_sp(logits, labels, sp_axis=sp_axis) + aux
+        return clm_loss(logits, labels) + aux
 
     def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
-        del ep_axis
 
         def embed_fn(params, input_ids, key=None):
             del key
@@ -410,9 +459,11 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
             body = functools.partial(
                 llama_block_apply, cfg=cfg, cos=cos, sin=sin,
                 tp_axis=tp_axis, sp_axis=sp_axis, sp_mode=sp_mode,
-                use_flash=use_flash)
+                use_flash=use_flash, ep_axis=ep_axis)
             return stacked_blocks_apply(cast(blocks_local), h, num_heads=0,
                                         body_fn=body, remat=remat,
+                                        moe_args=cfg.moe_args,
+                                        sp_axis=sp_axis,
                                         scan_unroll=cfg.scan_unroll)
 
         if sp_axis is not None:
@@ -437,7 +488,8 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
         init=lambda key: llama_init(key, cfg),
         loss_fn=loss_fn,
         partition_specs=lambda tp_axis=None, pp_axis=None, ep_axis=None:
-            llama_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis),
+            llama_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
+                                  ep_axis=ep_axis),
         pipeline_fns=pipeline_fns,
         to_tp_layout=lambda p, tp: p,  # separate q/k/v: no qkv re-blocking
         depth=cfg.n_layers,

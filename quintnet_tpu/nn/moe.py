@@ -56,12 +56,14 @@ class MoEArgs(NamedTuple):
 
 
 def moe_init(key, dim: int, hidden: int, n_experts: int, *,
-             dtype=jnp.float32):
+             dtype=jnp.float32, expert_type: str = "mlp"):
     """Router + per-expert FFN params with GLOBAL expert dim E leading.
 
+    ``expert_type``: "mlp" (fc->act->proj with biases, GPT-2/ViT style)
+    or "swiglu" (gate/up/down, no biases — Llama/Mixtral style).
     Expert weights follow the same fan-in uniform init as
-    nn/layers.py:linear_init so a 1-expert MoE matches a dense MLP's
-    statistics."""
+    nn/layers.py:linear_init so a 1-expert MoE matches a dense
+    MLP/SwiGLU's statistics."""
     kr, kw1, kb1, kw2, kb2 = jax.random.split(key, 5)
     s1 = 1.0 / math.sqrt(dim)
     s2 = 1.0 / math.sqrt(hidden)
@@ -69,10 +71,18 @@ def moe_init(key, dim: int, hidden: int, n_experts: int, *,
     def u(k, shape, s):
         return jax.random.uniform(k, shape, dtype, minval=-s, maxval=s)
 
+    # router kept/computed in f32: tiny, and gate ordering is
+    # precision-sensitive (cast_floating exempts it — layers.py)
+    router = {"w": u(kr, (dim, n_experts), s1).astype(jnp.float32)}
+    if expert_type == "swiglu":
+        return {
+            "router": router,
+            "wg": u(kw1, (n_experts, dim, hidden), s1),
+            "wu": u(kb1, (n_experts, dim, hidden), s1),
+            "wd": u(kw2, (n_experts, hidden, dim), s2),
+        }
     return {
-        # router kept/computed in f32: tiny, and gate ordering is
-        # precision-sensitive (cast_floating exempts it — layers.py)
-        "router": {"w": u(kr, (dim, n_experts), s1).astype(jnp.float32)},
+        "router": router,
         "w1": u(kw1, (n_experts, dim, hidden), s1),
         "b1": u(kb1, (n_experts, hidden), s1),
         "w2": u(kw2, (n_experts, hidden, dim), s2),
@@ -82,7 +92,8 @@ def moe_init(key, dim: int, hidden: int, n_experts: int, *,
 
 def moe_specs(*, ep_axis: Optional[str] = "ep",
               tp_axis: Optional[str] = None,
-              stacked: bool = False, pp_axis: Optional[str] = None):
+              stacked: bool = False, pp_axis: Optional[str] = None,
+              expert_type: str = "mlp"):
     """PartitionSpecs: experts sharded over ``ep``; inside each expert the
     FFN is column/row sharded over ``tp`` (parallel/tp.py convention);
     router replicated."""
@@ -90,6 +101,13 @@ def moe_specs(*, ep_axis: Optional[str] = "ep",
     def lead(*tail):
         return P(pp_axis, *tail) if stacked else P(*tail)
 
+    if expert_type == "swiglu":
+        return {
+            "router": {"w": lead(None, None)},
+            "wg": lead(ep_axis, None, tp_axis),
+            "wu": lead(ep_axis, None, tp_axis),
+            "wd": lead(ep_axis, tp_axis, None),
+        }
     return {
         "router": {"w": lead(None, None)},
         "w1": lead(ep_axis, None, tp_axis),
@@ -156,14 +174,22 @@ def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
         xe = cc.all_to_all(xe, ep_axis, split_dim=0, concat_dim=1)
 
     # ---- expert FFN (batched einsum -> MXU) ------------------------------
-    w1, b1 = p["w1"], p["b1"]
-    w2, b2 = p["w2"], p["b2"]
-    h = jnp.einsum("ecd,edh->ech", xe, w1.astype(xe.dtype))
-    h = act(h + b1.astype(h.dtype)[:, None, :])
-    y = jnp.einsum("ech,ehd->ecd", h, w2.astype(h.dtype))
-    if tp_axis is not None:
-        y = lax.psum(y, tp_axis)
-    y = y + b2.astype(y.dtype)[:, None, :]
+    if "wg" in p:  # SwiGLU experts (Llama/Mixtral style, no biases)
+        h = (jax.nn.silu(jnp.einsum("ecd,edh->ech", xe,
+                                    p["wg"].astype(xe.dtype)))
+             * jnp.einsum("ecd,edh->ech", xe, p["wu"].astype(xe.dtype)))
+        y = jnp.einsum("ech,ehd->ecd", h, p["wd"].astype(h.dtype))
+        if tp_axis is not None:
+            y = lax.psum(y, tp_axis)
+    else:
+        w1, b1 = p["w1"], p["b1"]
+        w2, b2 = p["w2"], p["b2"]
+        h = jnp.einsum("ecd,edh->ech", xe, w1.astype(xe.dtype))
+        h = act(h + b1.astype(h.dtype)[:, None, :])
+        y = jnp.einsum("ech,ehd->ecd", h, w2.astype(h.dtype))
+        if tp_axis is not None:
+            y = lax.psum(y, tp_axis)
+        y = y + b2.astype(y.dtype)[:, None, :]
 
     if ep_axis is not None:
         # route outputs back to the token-owning ranks
