@@ -43,6 +43,13 @@ SLOW_FILES = {
     "test_dp.py",         # replica-identity/grad-accum goldens (~1.5 min)
     "test_strategy.py",   # full strategy x schedule matrix (~2 min)
     "test_flash.py",      # pallas interpret-mode kernels (~1.5 min)
+    "test_llama.py",      # HF goldens + strategy matrix (~3 min; the
+                          # HF-logits golden is promoted fast)
+    "test_lora.py",       # adapter goldens (~1.5 min; identity +
+                          # save/load promoted fast)
+    "test_beam.py",       # beam-search goldens (~1 min)
+    "test_remat_knobs.py",  # remat policy matrix (~1.5 min; plain
+                            # policy goldens promoted fast)
 }
 
 
@@ -50,7 +57,10 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         fname = os.path.basename(str(item.fspath))
         explicit_slow = item.get_closest_marker("slow") is not None
-        if explicit_slow or fname in SLOW_FILES:
+        # an explicit @pytest.mark.fast inside a slow FILE promotes that
+        # test into the smoke subset
+        explicit_fast = item.get_closest_marker("fast") is not None
+        if explicit_slow or (fname in SLOW_FILES and not explicit_fast):
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.fast)

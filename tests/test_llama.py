@@ -50,7 +50,6 @@ def test_logits_match_hf_llama():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.fast
 def test_remat_and_flashpath_match_plain():
     params = llama_init(jax.random.key(0), CFG)
     ids = jnp.asarray(_ids())
@@ -171,7 +170,6 @@ def test_tied_embeddings_variant():
     assert out.shape == (2, 16, tied.vocab_size)
 
 
-@pytest.mark.fast
 def test_llama_generate_matches_full_forward_greedy():
     """KV-cache decode == argmax over a full forward recompute per step
     (the reference-style O(T^2) oracle), token for token."""

@@ -15,8 +15,6 @@ from quintnet_tpu.models.lora import (LoRAConfig, lora_init,
                                       lora_merge_tree, lora_param_count,
                                       lora_partition_specs, lora_wrap)
 
-pytestmark = pytest.mark.fast
-
 CFG = GPT2Config.tiny()
 LCFG = LoRAConfig(rank=4, alpha=8.0)
 
@@ -29,6 +27,7 @@ def base():
     return params, ids
 
 
+@pytest.mark.fast
 def test_zero_init_is_identity(base):
     params, ids = base
     lora = lora_init(jax.random.key(1), params["blocks"], LCFG)
@@ -99,6 +98,7 @@ def test_merged_model_generates(base):
     assert out.shape == (1, 6)
 
 
+@pytest.mark.fast
 def test_lora_save_load_roundtrip(base, tmp_path):
     from quintnet_tpu.models.lora import load_lora, save_lora
 

@@ -14,8 +14,6 @@ import pytest
 from quintnet_tpu.models.gpt2 import (GPT2Config, clm_loss, gpt2_apply,
                                       gpt2_init)
 
-pytestmark = pytest.mark.fast
-
 
 def _loss_fn(cfg, remat):
     def f(params, ids):
@@ -35,6 +33,7 @@ def setup():
     return cfg, params, ids, base_loss, base_grads
 
 
+@pytest.mark.fast
 @pytest.mark.parametrize("remat", [True, "dots"])
 def test_remat_policies_match_plain(setup, remat):
     cfg, params, ids, base_loss, base_grads = setup

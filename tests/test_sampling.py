@@ -13,20 +13,20 @@ import pytest
 
 from quintnet_tpu.models.gpt2_generate import sample_logits
 
-pytestmark = pytest.mark.fast
-
 
 def _logits():
     # strongly ordered distribution over 8 tokens
     return jnp.asarray([[8.0, 6.0, 5.0, 2.0, 1.0, 0.5, 0.2, 0.1]])
 
 
+@pytest.mark.fast
 def test_greedy_ignores_filters():
     out = sample_logits(_logits(), jax.random.key(0), temperature=0.0,
                         top_k=3, top_p=0.5)
     assert int(out[0]) == 0
 
 
+@pytest.mark.fast
 def test_top_k_restricts_support():
     ks = jax.random.split(jax.random.key(1), 200)
     toks = {int(sample_logits(_logits(), k, temperature=5.0, top_k=3)[0])
@@ -34,6 +34,7 @@ def test_top_k_restricts_support():
     assert toks <= {0, 1, 2} and len(toks) > 1  # hot temp still samples
 
 
+@pytest.mark.fast
 def test_top_k_one_is_argmax():
     for i in range(5):
         out = sample_logits(_logits(), jax.random.key(i),
@@ -41,6 +42,7 @@ def test_top_k_one_is_argmax():
         assert int(out[0]) == 0
 
 
+@pytest.mark.fast
 def test_top_p_keeps_first_crossing_token():
     # probs ~ softmax: p0 dominates; tiny top_p must still keep token 0
     for i in range(5):
@@ -49,6 +51,7 @@ def test_top_p_keeps_first_crossing_token():
         assert int(out[0]) == 0
 
 
+@pytest.mark.fast
 def test_top_p_restricts_support():
     logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
     ks = jax.random.split(jax.random.key(2), 300)
@@ -59,6 +62,7 @@ def test_top_p_restricts_support():
     assert toks == {0, 1}
 
 
+@pytest.mark.fast
 def test_unsort_is_correct_per_row():
     # two rows with different orderings; same filter must track each row
     logits = jnp.asarray([[1.0, 9.0, 2.0, 0.0],
@@ -83,6 +87,7 @@ def test_generate_with_filters_runs():
     assert (out[:, :4] == ids).all()
 
 
+@pytest.mark.fast
 def test_adam_mu_dtype_bf16():
     import optax
 

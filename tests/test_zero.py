@@ -114,6 +114,7 @@ def test_zero2_matches_replicated_adamw_one_step():
                                    rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_zero2_matches_zero1_under_dp_tp():
     """dp x tp exercises the replication-weighted chunk-space norm: LN
     grads are replicated over tp and must count ONCE in the clip norm
@@ -126,6 +127,7 @@ def test_zero2_matches_zero1_under_dp_tp():
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_zero2_composes_with_3d():
     p_1, _, l_1 = _run("zero1_adamw", [2, 2, 2], ["dp", "tp", "pp"],
                        n_steps=2, schedule="1f1b", grad_acc=4)
