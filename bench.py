@@ -186,7 +186,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2",
                     choices=["gpt2", "gpt2-moe", "vit", "flash-attn",
-                             "llama"])
+                             "llama", "llama-moe"])
     ap.add_argument("--preset", default="base",
                     choices=["base", "medium", "large", "xl"],
                     help="GPT-2 size preset (--model gpt2/gpt2-moe); "
@@ -304,7 +304,7 @@ def main():
         name = f"gpt2_{size}" if args.model == "gpt2" else \
             f"gpt2_moe{args.experts}"
         metric = f"{name}_seq{args.seq}_train_samples_per_sec_per_chip"
-    elif args.model == "llama":
+    elif args.model in ("llama", "llama-moe"):
         from quintnet_tpu.models.llama import LlamaConfig, llama_init, \
             llama_model_spec
 
@@ -314,6 +314,9 @@ def main():
             ap.error(f"--model llama supports --preset base (160M) or "
                      f"xl (3.2-1B); got {args.preset!r}")
         lcfg = lmap[args.preset]()
+        if args.model == "llama-moe":
+            lcfg = dataclasses.replace(lcfg, n_experts=args.experts,
+                                       expert_top_k=2)
         if args.seq > lcfg.n_positions:
             lcfg = dataclasses.replace(lcfg, n_positions=args.seq)
         if args.scan_unroll != 1:
@@ -328,7 +331,9 @@ def main():
         n_params = sum(int(np.prod(l.shape)) for l in
                        jax.tree.leaves(llama_init(jax.random.key(0), lcfg)))
         flops_per_step = 6.0 * n_params * args.batch * n_dev * args.seq
-        metric = (f"llama_{round(n_params / 1e6)}m_seq{args.seq}"
+        tag = ("llama" if args.model == "llama"
+               else f"llama_moe{args.experts}")
+        metric = (f"{tag}_{round(n_params / 1e6)}m_seq{args.seq}"
                   "_train_samples_per_sec_per_chip")
     else:
         from quintnet_tpu.models.vit import (ViTConfig, vit_init,

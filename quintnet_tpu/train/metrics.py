@@ -122,6 +122,7 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                         batch_size: int = 8,
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 1.0, key=None, beams: int = 1,
+                        generate_fn=None,
                         mesh=None, tp_axis: str = "tp") -> Dict[str, float]:
     """Generate continuations with the KV-cache decoder and score
     ROUGE-1/2/L + BLEU against references (reference evaluate_generation:
@@ -136,6 +137,12 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
     their tp training layout (models/gpt2_generate.py gpt2_generate_tp).
     The reference skips generation eval under any parallelism
     (GPT2_Trainer.py:509-555).
+
+    ``generate_fn(params, batch_ids, cfg, max_new_tokens=...,
+    eos_token_id=..., temperature=..., top_k=..., top_p=..., key=...)``:
+    override the decoder — e.g. models/llama_generate.llama_generate
+    scores a Llama model with the same ROUGE/BLEU harness. Default:
+    the GPT-2 decoders (+beams/tp routing below).
     """
     from quintnet_tpu.models.gpt2_generate import (gpt2_beam_search,
                                                    gpt2_generate,
@@ -158,7 +165,11 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
                 batch = np.concatenate([batch, pad], axis=0)
             sample = dict(temperature=temperature, top_k=top_k,
                           top_p=top_p, key=key)
-            if beams > 1 and (mesh is None
+            if generate_fn is not None:
+                out = generate_fn(params, batch, cfg,
+                                  max_new_tokens=max_new_tokens,
+                                  eos_token_id=eos_token_id, **sample)
+            elif beams > 1 and (mesh is None
                               or mesh.shape.get(tp_axis, 1) == 1):
                 # beam decode is single-device (deterministic, so no
                 # key); tp meshes fall through to sampling/greedy tp

@@ -286,3 +286,18 @@ def test_llama_moe_strategy_matches_single_device(name, mesh_dim,
     b = strat.shard_batch((jnp.asarray(ids), jnp.asarray(ids)), model)
     _, _, loss = strat.make_train_step(model, optax.sgd(0.05))(p, st, b)
     np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4)
+
+
+def test_llama_generation_eval_harness():
+    """The ROUGE/BLEU harness scores a Llama model via generate_fn."""
+    from quintnet_tpu.data import ByteTokenizer
+    from quintnet_tpu.models.llama_generate import llama_generate
+    from quintnet_tpu.train.metrics import evaluate_generation
+
+    params = llama_init(jax.random.key(0), CFG)
+    tok = ByteTokenizer()
+    prompts = [([1, 2, 3, 4], "ref one"), ([5, 6, 7, 8], "ref two")]
+    scores = evaluate_generation(params, CFG, prompts, tok,
+                                 max_new_tokens=4, batch_size=2,
+                                 generate_fn=llama_generate)
+    assert set(scores) == {"rouge1", "rouge2", "rougeL", "bleu"}
