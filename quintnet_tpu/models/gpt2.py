@@ -75,6 +75,9 @@ class GPT2Config:
     expert_capacity: Optional[int] = None
     aux_loss_weight: float = 1e-2
     router_z_weight: float = 0.0
+    # "topk" (Switch/Mixtral) | "expert_choice" (perfect load balance,
+    # no aux loss — nn/moe.py)
+    router_type: str = "topk"
     # --- vocab parallelism: shard wte over tp (the reference DEFINES
     # VocabParallelEmbedding but never uses it, layers.py:224-297 —
     # GPT-2 replicates embeddings there). With it on, the lm-head loss
@@ -134,6 +137,7 @@ class GPT2Config:
             capacity=self.expert_capacity,
             aux_weight=self.aux_loss_weight,
             z_weight=self.router_z_weight,
+            router=self.router_type,
         )
 
     @staticmethod

@@ -64,6 +64,7 @@ class LlamaConfig:
     capacity_factor: float = 1.25
     expert_capacity: Optional[int] = None
     aux_loss_weight: float = 1e-2
+    router_type: str = "topk"  # or "expert_choice" (nn/moe.py)
     # llama3-style rope scaling (None = unscaled). Tuple (hashable — the
     # config is a jit static arg): (factor, low_freq_factor,
     # high_freq_factor, original_max_position). HF applies this when
@@ -85,7 +86,8 @@ class LlamaConfig:
         return MoEArgs(n_experts=self.n_experts, top_k=self.expert_top_k,
                        capacity_factor=self.capacity_factor,
                        capacity=self.expert_capacity,
-                       aux_weight=self.aux_loss_weight)
+                       aux_weight=self.aux_loss_weight,
+                       router=self.router_type)
 
     @staticmethod
     def llama32_1b() -> "LlamaConfig":

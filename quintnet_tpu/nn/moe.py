@@ -53,6 +53,13 @@ class MoEArgs(NamedTuple):
     aux_weight: float = 1e-2
     z_weight: float = 0.0
     normalize_gates: bool = True
+    # "topk": tokens choose experts (Switch/Mixtral; needs the aux
+    # load-balance loss, may drop tokens at capacity).
+    # "expert_choice": experts choose their top-C tokens (Zhou et al.
+    # 2022) — perfectly load-balanced by construction, no aux loss, no
+    # drops (a token may instead be served by 0..E experts; the
+    # residual path covers unserved tokens).
+    router: str = "topk"
 
 
 def moe_init(key, dim: int, hidden: int, n_experts: int, *,
@@ -150,6 +157,11 @@ def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
     # ---- routing (f32) ---------------------------------------------------
     logits = jnp.dot(xt.astype(jnp.float32), p["router"]["w"])  # [S, E]
     probs = jax.nn.softmax(logits, axis=-1)
+
+    if args.router == "expert_choice":
+        return _moe_expert_choice(p, xt, probs, logits, (B, T, D), C,
+                                  args, ep_axis=ep_axis, tp_axis=tp_axis)
+
     gate_v, gate_i = lax.top_k(probs, k)  # [S, k]
     if args.normalize_gates:
         gate_v = gate_v / jnp.sum(gate_v, axis=-1, keepdims=True)
@@ -174,22 +186,7 @@ def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
         xe = cc.all_to_all(xe, ep_axis, split_dim=0, concat_dim=1)
 
     # ---- expert FFN (batched einsum -> MXU) ------------------------------
-    if "wg" in p:  # SwiGLU experts (Llama/Mixtral style, no biases)
-        h = (jax.nn.silu(jnp.einsum("ecd,edh->ech", xe,
-                                    p["wg"].astype(xe.dtype)))
-             * jnp.einsum("ecd,edh->ech", xe, p["wu"].astype(xe.dtype)))
-        y = jnp.einsum("ech,ehd->ecd", h, p["wd"].astype(h.dtype))
-        if tp_axis is not None:
-            y = lax.psum(y, tp_axis)
-    else:
-        w1, b1 = p["w1"], p["b1"]
-        w2, b2 = p["w2"], p["b2"]
-        h = jnp.einsum("ecd,edh->ech", xe, w1.astype(xe.dtype))
-        h = act(h + b1.astype(h.dtype)[:, None, :])
-        y = jnp.einsum("ech,ehd->ecd", h, w2.astype(h.dtype))
-        if tp_axis is not None:
-            y = lax.psum(y, tp_axis)
-        y = y + b2.astype(y.dtype)[:, None, :]
+    y = _expert_ffn(p, xe, act=act, tp_axis=tp_axis)
 
     if ep_axis is not None:
         # route outputs back to the token-owning ranks
@@ -209,4 +206,57 @@ def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
         z = jax.scipy.special.logsumexp(logits, axis=-1)
         aux = aux + args.z_weight * jnp.mean(jnp.square(z))
 
+    return yt.reshape(B, T, D), aux
+
+
+def _expert_ffn(p, xe, *, act, tp_axis):
+    """Batched per-expert FFN on [E, C, D] rows (mlp or swiglu experts;
+    shared by both routers)."""
+    if "wg" in p:
+        h = (jax.nn.silu(jnp.einsum("ecd,edh->ech", xe,
+                                    p["wg"].astype(xe.dtype)))
+             * jnp.einsum("ecd,edh->ech", xe, p["wu"].astype(xe.dtype)))
+        y = jnp.einsum("ech,ehd->ecd", h, p["wd"].astype(h.dtype))
+        if tp_axis is not None:
+            y = lax.psum(y, tp_axis)
+        return y
+    h = jnp.einsum("ecd,edh->ech", xe, p["w1"].astype(xe.dtype))
+    h = act(h + p["b1"].astype(h.dtype)[:, None, :])
+    y = jnp.einsum("ech,ehd->ecd", h, p["w2"].astype(h.dtype))
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    return y + p["b2"].astype(y.dtype)[:, None, :]
+
+
+def _moe_expert_choice(p, xt, probs, logits, btd, C, args: MoEArgs, *,
+                       ep_axis, tp_axis):
+    """Expert-choice routing: expert e takes the C tokens with the
+    highest affinity probs[:, e]; combine weight = that affinity.
+    Every expert buffer is exactly full (no drops, no load imbalance),
+    so no aux loss — only the optional router z-loss survives."""
+    B, T, D = btd
+    S = xt.shape[0]
+    gate, idx = lax.top_k(probs.T, min(C, S))      # each [E, C']
+    if C > S:  # capacity above token count: pad with repeats at 0 gate
+        pad = C - S
+        idx = jnp.pad(idx, ((0, 0), (0, pad)))
+        gate = jnp.pad(gate, ((0, 0), (0, pad)))
+    xe = xt[idx.reshape(-1)].reshape(idx.shape[0], C, D)     # [E, C, D]
+
+    if ep_axis is not None:
+        xe = cc.all_to_all(xe, ep_axis, split_dim=0, concat_dim=1)
+
+    y = _expert_ffn(p, xe, act=gelu, tp_axis=tp_axis)
+
+    if ep_axis is not None:
+        y = cc.all_to_all(y, ep_axis, split_dim=1, concat_dim=0)
+
+    yw = y * gate.astype(y.dtype)[:, :, None]                # [E, C, D]
+    yt = (jnp.zeros((S, D), y.dtype)
+          .at[idx.reshape(-1)].add(yw.reshape(-1, D)))
+
+    aux = jnp.zeros((), jnp.float32)
+    if args.z_weight:
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        aux = args.z_weight * jnp.mean(jnp.square(z))
     return yt.reshape(B, T, D), aux

@@ -309,3 +309,85 @@ def test_gpt2_moe_zero1_dp_ep(rng):
     np.testing.assert_allclose(losses_z, losses_ref, rtol=1e-5)
     np.testing.assert_allclose(losses_z, losses_plain, rtol=1e-6)
     _assert_trees_close(p_z, p_plain, rtol=1e-6, atol=1e-7)
+
+
+# -- expert-choice routing ---------------------------------------------------
+
+def test_expert_choice_one_expert_full_capacity_is_weighted_dense(rng):
+    """E=1, C=S: the expert takes every token; softmax over one expert
+    gives affinity 1.0, so EC == dense FFN exactly."""
+    from quintnet_tpu.nn.layers import mlp_apply
+
+    key = jax.random.key(0)
+    p = moe_init(key, 16, 32, 1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+    args = MoEArgs(n_experts=1, top_k=1, capacity=16,
+                   router="expert_choice", aux_weight=0.0)
+    y, aux = moe_apply(p, x, args)
+    dense = {"fc": {"w": p["w1"][0], "b": p["b1"][0]},
+             "proj": {"w": p["w2"][0], "b": p["b2"][0]}}
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(mlp_apply(dense, x)),
+                               rtol=1e-5, atol=1e-6)
+    assert float(aux) == 0.0  # EC needs no load-balance loss
+
+
+def test_expert_choice_ep_matches_single_device(rng):
+    """EC dispatch over an ep mesh == single-device EC (deterministic
+    expert-side top-C)."""
+    from quintnet_tpu.core.mesh import mesh_from_sizes
+    from quintnet_tpu.core import collectives as cc
+    from jax.sharding import PartitionSpec as P
+
+    E, D, H, C = 4, 16, 32, 8
+    p = moe_init(jax.random.key(1), D, H, E)
+    x = jnp.asarray(rng.normal(size=(2, 16, D)), jnp.float32)
+    args = MoEArgs(n_experts=E, top_k=2, capacity=C,
+                   router="expert_choice", aux_weight=0.0)
+    ref, _ = moe_apply(p, x, args)
+
+    mesh = mesh_from_sizes(ep=2)
+    specs = {"router": {"w": P()},
+             "w1": P("ep"), "b1": P("ep"), "w2": P("ep"), "b2": P("ep")}
+
+    def local(p, x):
+        y, aux = moe_apply(p, x, args, ep_axis="ep")
+        return y
+
+    fn = jax.jit(cc.shard_map_fn(local, mesh, in_specs=(specs, P()),
+                                 out_specs=P()))
+    from quintnet_tpu.parallel.train_step import shard_pytree
+
+    ps = shard_pytree(mesh, p, specs)
+    np.testing.assert_allclose(np.asarray(fn(ps, x)), np.asarray(ref),
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_expert_choice_trains(rng):
+    """Gradients flow through the EC gather/scatter + gates: a tiny
+    llama-moe with expert_choice routing reduces its loss."""
+    import optax
+
+    from quintnet_tpu.models.gpt2 import clm_loss
+    from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
+                                           llama_model_spec)
+
+    cfg = LlamaConfig.tiny(n_experts=4, router_type="expert_choice")
+    model = llama_model_spec(cfg)
+    params = model.init(jax.random.key(0))
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, g = jax.value_and_grad(
+            lambda p: model.loss_fn(p, (ids, ids)))(params)
+        up, state = opt.update(g, state, params)
+        return optax.apply_updates(params, up), state, loss
+
+    l0 = None
+    for _ in range(10):
+        params, state, loss = step(params, state)
+        l0 = l0 if l0 is not None else float(loss)
+    assert float(loss) < l0
