@@ -311,8 +311,8 @@ def main():
         lmap = {"base": LlamaConfig.llama_160m,
                 "xl": LlamaConfig.llama32_1b}
         if args.preset not in lmap:
-            ap.error(f"--model llama supports --preset base (160M) or "
-                     f"xl (3.2-1B); got {args.preset!r}")
+            ap.error(f"--model {args.model} supports --preset base "
+                     f"(160M) or xl (3.2-1B); got {args.preset!r}")
         lcfg = lmap[args.preset]()
         if args.model == "llama-moe":
             lcfg = dataclasses.replace(lcfg, n_experts=args.experts,

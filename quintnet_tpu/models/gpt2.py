@@ -128,6 +128,14 @@ class GPT2Config:
         """nn/moe.py MoEArgs for this config, or None when dense."""
         if self.n_experts <= 0:
             return None
+        if self.router_type == "expert_choice":
+            # EC selects over the whole flattened sequence — position t
+            # would see later positions (nn/moe.py MoEArgs.router docs).
+            raise ValueError(
+                "expert_choice routing is non-causal and unsupported "
+                "for the causal LM families; use router_type='topk' "
+                "(expert_choice remains available at the nn/moe.py "
+                "layer for non-autoregressive models)")
         from quintnet_tpu.nn.moe import MoEArgs
 
         return MoEArgs(

@@ -81,6 +81,11 @@ class LlamaConfig:
     def moe_args(self):
         if self.n_experts <= 0:
             return None
+        if self.router_type == "expert_choice":
+            raise ValueError(
+                "expert_choice routing is non-causal and unsupported "
+                "for the causal LM families; use router_type='topk' "
+                "(see nn/moe.py MoEArgs.router)")
         from quintnet_tpu.nn.moe import MoEArgs
 
         return MoEArgs(n_experts=self.n_experts, top_k=self.expert_top_k,
@@ -247,12 +252,18 @@ def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None):
 
 
 def llama_mlp_residual(p, x, cfg: LlamaConfig, *,
-                       tp_axis: Optional[str] = None):
+                       tp_axis: Optional[str] = None,
+                       ep_axis: Optional[str] = None):
+    """-> (x + FFN(ln2(x)), moe_aux) — aux is 0.0 for dense blocks.
+    THE one FFN-residual implementation for training forward, prefill
+    and decode (a fix here fixes all three)."""
     h = rms_norm_apply(p["ln2"], x, eps=cfg.rms_eps)
-    if "moe" in p:  # aux discarded (eval/decode path)
-        y, _aux = moe_apply(p["moe"], h, cfg.moe_args, tp_axis=tp_axis)
-        return x + y
-    return x + swiglu_apply(p["mlp"], h, tp_axis=tp_axis)
+    if "moe" in p:
+        y, aux = moe_apply(p["moe"], h, cfg.moe_args, ep_axis=ep_axis,
+                           tp_axis=tp_axis)
+        return x + y, aux
+    return x + swiglu_apply(p["mlp"], h, tp_axis=tp_axis), \
+        jnp.zeros((), jnp.float32)
 
 
 def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
@@ -289,12 +300,10 @@ def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
         o = sdpa(q, k, v, causal=True)
 
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
-    if cfg.n_experts > 0:
-        h = rms_norm_apply(p["ln2"], x, eps=cfg.rms_eps)
-        y, aux = moe_apply(p["moe"], h, cfg.moe_args, ep_axis=ep_axis,
-                           tp_axis=tp_axis)
-        return x + y, aux  # runner pmeans the aux sum over sp
-    return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    x, aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis,
+                                ep_axis=ep_axis)
+    # runner pmeans the aux sum over sp (stacked_blocks_apply moe path)
+    return (x, aux) if cfg.n_experts > 0 else x
 
 
 def llama_block_prefill(p, x, cfg: LlamaConfig, cos, sin,
@@ -309,7 +318,8 @@ def llama_block_prefill(p, x, cfg: LlamaConfig, cos, sin,
     rep = q.shape[1] // k.shape[1]
     o = sdpa(q, repeat_kv(k, rep), repeat_kv(v, rep), causal=True)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
-    return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis), (k, v)
+    x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    return x, (k, v)
 
 
 def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
@@ -330,7 +340,8 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
     o = jnp.einsum("bhqt,bhtd->bhqd",
                    jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
-    return llama_mlp_residual(p, x, cfg, tp_axis=tp_axis), (kc, vc)
+    x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    return x, (kc, vc)
 
 
 def _positions(b, s, sp_axis: Optional[str]):
@@ -403,8 +414,6 @@ def llama_partition_specs(cfg: Optional[LlamaConfig] = None, *,
         "ln2": {"scale": rep},
     }
     if cfg is not None and cfg.n_experts > 0:
-        from quintnet_tpu.nn.moe import moe_specs
-
         blocks["moe"] = moe_specs(ep_axis=ep_axis, tp_axis=t,
                                   stacked=True, pp_axis=pp_axis,
                                   expert_type="swiglu")

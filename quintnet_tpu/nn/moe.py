@@ -58,7 +58,11 @@ class MoEArgs(NamedTuple):
     # "expert_choice": experts choose their top-C tokens (Zhou et al.
     # 2022) — perfectly load-balanced by construction, no aux loss, no
     # drops (a token may instead be served by 0..E experts; the
-    # residual path covers unserved tokens).
+    # residual path covers unserved tokens). NON-CAUSAL: selection runs
+    # over the whole flattened [B*T] token set, so position t's output
+    # depends on later positions — fine for encoders (ViT-MoE etc.),
+    # WRONG for autoregressive LMs (the causal model configs reject it;
+    # GPT2Config/LlamaConfig.moe_args).
     router: str = "topk"
 
 
@@ -160,7 +164,8 @@ def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
 
     if args.router == "expert_choice":
         return _moe_expert_choice(p, xt, probs, logits, (B, T, D), C,
-                                  args, ep_axis=ep_axis, tp_axis=tp_axis)
+                                  args, ep_axis=ep_axis, tp_axis=tp_axis,
+                                  act=act)
 
     gate_v, gate_i = lax.top_k(probs, k)  # [S, k]
     if args.normalize_gates:
@@ -229,7 +234,7 @@ def _expert_ffn(p, xe, *, act, tp_axis):
 
 
 def _moe_expert_choice(p, xt, probs, logits, btd, C, args: MoEArgs, *,
-                       ep_axis, tp_axis):
+                       ep_axis, tp_axis, act=gelu):
     """Expert-choice routing: expert e takes the C tokens with the
     highest affinity probs[:, e]; combine weight = that affinity.
     Every expert buffer is exactly full (no drops, no load imbalance),
@@ -246,7 +251,7 @@ def _moe_expert_choice(p, xt, probs, logits, btd, C, args: MoEArgs, *,
     if ep_axis is not None:
         xe = cc.all_to_all(xe, ep_axis, split_dim=0, concat_dim=1)
 
-    y = _expert_ffn(p, xe, act=gelu, tp_axis=tp_axis)
+    y = _expert_ffn(p, xe, act=act, tp_axis=tp_axis)
 
     if ep_axis is not None:
         y = cc.all_to_all(y, ep_axis, split_dim=1, concat_dim=0)

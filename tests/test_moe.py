@@ -364,30 +364,45 @@ def test_expert_choice_ep_matches_single_device(rng):
 
 
 def test_expert_choice_trains(rng):
-    """Gradients flow through the EC gather/scatter + gates: a tiny
-    llama-moe with expert_choice routing reduces its loss."""
+    """Gradients flow through the EC gather/scatter + gates (nn-level:
+    the causal LM configs REJECT expert_choice — see below)."""
     import optax
 
-    from quintnet_tpu.models.gpt2 import clm_loss
-    from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
-                                           llama_model_spec)
-
-    cfg = LlamaConfig.tiny(n_experts=4, router_type="expert_choice")
-    model = llama_model_spec(cfg)
-    params = model.init(jax.random.key(0))
-    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+    E, D, H = 4, 16, 32
+    p = moe_init(jax.random.key(0), D, H, E)
+    x = jnp.asarray(rng.normal(size=(4, 8, D)), jnp.float32)
+    target = jnp.asarray(rng.normal(size=(4, 8, D)), jnp.float32)
+    args = MoEArgs(n_experts=E, top_k=2, router="expert_choice",
+                   aux_weight=0.0)
     opt = optax.adam(1e-2)
-    state = opt.init(params)
+    state = opt.init(p)
 
     @jax.jit
-    def step(params, state):
-        loss, g = jax.value_and_grad(
-            lambda p: model.loss_fn(p, (ids, ids)))(params)
-        up, state = opt.update(g, state, params)
-        return optax.apply_updates(params, up), state, loss
+    def step(p, state):
+        def loss_fn(p):
+            y, aux = moe_apply(p, x, args)
+            return jnp.mean(jnp.square(y - target)) + aux
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        up, state = opt.update(g, state, p)
+        return optax.apply_updates(p, up), state, loss
 
     l0 = None
-    for _ in range(10):
-        params, state, loss = step(params, state)
+    for _ in range(15):
+        p, state, loss = step(p, state)
         l0 = l0 if l0 is not None else float(loss)
     assert float(loss) < l0
+
+
+def test_expert_choice_rejected_by_causal_configs():
+    """EC selection is non-causal (runs over the whole flattened token
+    set) — both causal LM configs must refuse it loudly."""
+    from quintnet_tpu.models.gpt2 import GPT2Config
+    from quintnet_tpu.models.llama import LlamaConfig
+
+    for cfg in (GPT2Config.tiny(n_experts=4,
+                                router_type="expert_choice"),
+                LlamaConfig.tiny(n_experts=4,
+                                 router_type="expert_choice")):
+        with pytest.raises(ValueError, match="non-causal"):
+            cfg.moe_args
