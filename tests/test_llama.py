@@ -301,3 +301,47 @@ def test_llama_generation_eval_harness():
                                  max_new_tokens=4, batch_size=2,
                                  generate_fn=llama_generate)
     assert set(scores) == {"rouge1", "rouge2", "rougeL", "bleu"}
+
+
+def test_tied_embeddings_under_pp():
+    """Tied lm head (= tok embedding) under pipeline parallelism: the
+    embedding grad (stage 0) and lm-head grad (last stage) are partial
+    across pp and must combine via the partial-axes psum — same
+    mechanism as GPT-2's tied wte (no manual sync)."""
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.models.gpt2 import clm_loss
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    tied = LlamaConfig.tiny(tie_embeddings=True)
+    model = llama_model_spec(tied)
+    host = llama_init(jax.random.key(0), tied)
+    ids = _ids(b=4, s=16, v=tied.vocab_size)
+
+    def ref_loss(p):
+        return clm_loss(llama_apply(p, jnp.asarray(ids), tied),
+                        jnp.asarray(ids))
+
+    loss_ref, g_ref = jax.value_and_grad(ref_loss)(host)
+    p_ref = optax.apply_updates(
+        host, optax.sgd(0.05).update(
+            g_ref, optax.sgd(0.05).init(host), host)[0])
+
+    cfg = Config.from_dict({
+        "mesh_dim": [2], "mesh_name": ["pp"],
+        "training": {"batch_size": 4, "grad_clip_norm": None,
+                     "gradient_accumulation_steps": 2,
+                     "schedule": "1f1b"},
+    })
+    strat = get_strategy("pp", cfg)
+    opt = optax.sgd(0.05)
+    p = strat.shard_params(model, jax.tree.map(jnp.array, host))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch((jnp.asarray(ids), jnp.asarray(ids)), model)
+    p2, _, loss = strat.make_train_step(model, opt)(p, s, b)
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    # the tied table's update must include BOTH grad contributions
+    np.testing.assert_allclose(
+        np.asarray(p2["embedding"]["tok"]),
+        np.asarray(p_ref["embedding"]["tok"]), rtol=2e-4, atol=1e-5)
