@@ -158,3 +158,22 @@ def test_parity_report_flags_stale_legs(tmp_path, monkeypatch):
     assert "INCOMPARABLE" in md           # vit legs differ -> flagged
     assert "GPT2 (1 epochs)" in md        # gpt2 legs match -> compared
     assert md.count("PASS") == 1
+
+
+def test_compilation_cache_helper(tmp_path):
+    from quintnet_tpu.core import runtime
+
+    d = runtime.enable_compilation_cache(str(tmp_path / "xla"),
+                                         min_compile_time_secs=0.0)
+    import os
+
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.cos(x) @ x.T
+
+    f(jnp.ones((128, 128))).block_until_ready()
+    assert sum(len(fs) for _, _, fs in os.walk(d)) > 0
+    # restore defaults for the rest of the session
+    jax.config.update("jax_compilation_cache_dir", None)
