@@ -214,6 +214,26 @@ def make_parallel_train_step(
 
     def local_step(params, opt_state, batch, seed):
         key = device_dropout_key(seed, mesh_axes) if needs_rng else None
+        zero2 = zero1_axis is not None and zero_stage == 2
+        if zero2 and grad_fn is None and grad_accum_steps > 1:
+            # ZeRO-2 chunk accumulation: the full-size grad buffer never
+            # exists across microbatches; clipping + the optimizer run
+            # in chunk space (parallel/zero.py accumulate_grads_zero2)
+            from quintnet_tpu.parallel import zero
+
+            out, g_chunk = zero.accumulate_grads_zero2(
+                loss_fn, params, batch, grad_accum_steps,
+                axis=zero1_axis, data_axes=data_axes, model_axes=maxes,
+                partial_axes=paxes, param_specs=param_specs,
+                has_aux=has_aux, key=key)
+            if data_axes:
+                out = jax.tree.map(lambda x: lax.pmean(x, data_axes), out)
+            _, _, update_from_chunk = zero.make_zero2(
+                optimizer, param_specs, axis=zero1_axis,
+                mesh_axes=mesh_axes, clip_norm=grad_clip_norm)
+            params, opt_state = update_from_chunk(g_chunk, opt_state,
+                                                  params)
+            return params, opt_state, out
         if grad_fn is not None:
             out, grads = (grad_fn(params, batch, key) if needs_rng
                           else grad_fn(params, batch))
@@ -221,7 +241,6 @@ def make_parallel_train_step(
             out, grads = accumulate_grads(loss_fn, params, batch,
                                           grad_accum_steps, has_aux,
                                           key=key)
-        zero2 = zero1_axis is not None and zero_stage == 2
         grads = reduce_grads(
             grads, param_specs,
             # ZeRO-2: the zero-axis mean happens inside update_local as
@@ -241,7 +260,7 @@ def make_parallel_train_step(
         if zero2:
             from quintnet_tpu.parallel import zero
 
-            _, update_local = zero.make_zero2(
+            _, update_local, _ = zero.make_zero2(
                 optimizer, param_specs, axis=zero1_axis,
                 mesh_axes=mesh_axes, clip_norm=grad_clip_norm)
             params, opt_state = update_local(grads, opt_state, params)

@@ -138,6 +138,17 @@ def grad_weights(params, param_specs, *, mesh_axes, skip_axis: str):
     return flat
 
 
+def scatter_grad_chunk(grads, axis: str):
+    """Flat-ravel a (non-``axis``-reduced) grad tree and reduce-scatter
+    its ``axis`` mean straight into this rank's chunk (allreduce = this
+    + the discarded other chunks; half the traffic)."""
+    flat_g, _ = ravel_pytree(grads)
+    dp = lax.axis_size(axis)
+    chunk = _chunk_size(flat_g.shape[0], dp)
+    padded_g = jnp.pad(flat_g, (0, chunk * dp - flat_g.shape[0]))
+    return cc.reduce_scatter(padded_g, axis, scatter_dim=0) / dp
+
+
 def make_zero2(
     optimizer: optax.GradientTransformation,
     param_specs,
@@ -146,28 +157,26 @@ def make_zero2(
     mesh_axes: Sequence[str],
     clip_norm: Optional[float] = None,
 ):
-    """(init_local, update_local) for ZeRO-2 inside shard_map.
+    """(init_local, update_local, update_from_chunk) for ZeRO-2 inside
+    shard_map.
 
     ``update_local(grads_local, opt_state, params_local)``: ``grads``
     must be reduced over model/partial axes and over data axes OTHER
     than ``axis`` — the ``axis`` mean happens here via psum_scatter.
-    Clipping (when ``clip_norm``) runs on the reduced chunk with
-    replication-corrected weights, so it matches the full-tree
-    ``clip_sharded_grads`` exactly.
+    ``update_from_chunk(g_chunk, ...)``: same, for a grad already in
+    chunk form (the chunk-accumulation path —
+    :func:`accumulate_grads_zero2`). Clipping (when ``clip_norm``) runs
+    on the reduced chunk with replication-corrected weights, so it
+    matches the full-tree ``clip_sharded_grads`` exactly.
     """
     init_local, _ = make_zero1(optimizer, axis=axis)
     opt_extra = optax.with_extra_args_support(optimizer)
 
-    def update_local(grads, opt_state, params):
+    def update_from_chunk(g_chunk, opt_state, params):
         flat_p, unravel = ravel_pytree(params)
-        flat_g, _ = ravel_pytree(grads)
         dp = lax.axis_size(axis)
         chunk = _chunk_size(flat_p.shape[0], dp)
         r = lax.axis_index(axis)
-        padded_g = jnp.pad(flat_g, (0, chunk * dp - flat_g.shape[0]))
-        # the dp reduction: reduce-scatter straight into this rank's
-        # chunk (allreduce = this + the discarded other chunks)
-        g_chunk = cc.reduce_scatter(padded_g, axis, scatter_dim=0) / dp
         if clip_norm is not None:
             wflat = grad_weights(params, param_specs,
                                  mesh_axes=mesh_axes, skip_axis=axis)
@@ -178,7 +187,79 @@ def make_zero2(
         return _chunk_apply(opt_extra, g_chunk, opt_state, params,
                             flat_p, unravel, axis, dp, r, chunk)
 
-    return init_local, update_local
+    def update_local(grads, opt_state, params):
+        return update_from_chunk(scatter_grad_chunk(grads, axis),
+                                 opt_state, params)
+
+    return init_local, update_local, update_from_chunk
+
+
+def accumulate_grads_zero2(loss_fn, params, batch, n_micro: int, *,
+                           axis: str, data_axes, model_axes, partial_axes,
+                           param_specs, has_aux: bool = False, key=None):
+    """Microbatch gradient accumulation in CHUNK space: each microbatch
+    computes its full local grad tree transiently, reduces it over
+    model/partial/non-``axis``-data axes, reduce-scatters the ``axis``
+    mean into this rank's chunk, and the scan carries only the
+    [N_local/dp] chunk accumulator — the classic ZeRO-2 memory win (a
+    full-size accumulation buffer never exists; cost: one
+    reduce-scatter per microbatch instead of one allreduce per step).
+
+    Returns (mean loss[, aux], mean g_chunk) matching
+    dp.accumulate_grads's output normalisation.
+    """
+    from quintnet_tpu.parallel.train_step import reduce_grads
+
+    other_data = tuple(a for a in data_axes if a != axis)
+
+    if key is None:
+        vg = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        call = lambda p, mb, _m: vg(p, mb)  # noqa: E731
+    else:
+        vg = jax.value_and_grad(
+            lambda p, mb, k: loss_fn(p, mb, k), has_aux=has_aux)
+        call = lambda p, mb, m: vg(p, mb, jax.random.fold_in(key, m))  # noqa: E731
+
+    def to_chunk(grads):
+        grads = reduce_grads(grads, param_specs, data_axes=other_data,
+                             model_axes=tuple(model_axes),
+                             partial_axes=tuple(partial_axes))
+        return scatter_grad_chunk(grads, axis)
+
+    micro = jax.tree.map(
+        lambda x: x.reshape((n_micro, x.shape[0] // n_micro)
+                            + x.shape[1:]), batch)
+
+    def step(carry, inp):
+        m, mb = inp
+        out, g = call(params, mb, m)
+        acc_out, acc_c = carry
+        acc_c = acc_c + to_chunk(g)
+        if has_aux:
+            loss, aux = out
+            acc_loss, acc_aux = acc_out
+            acc_out = (acc_loss + loss,
+                       jax.tree.map(jnp.add, acc_aux, aux))
+        else:
+            acc_out = acc_out + out
+        return (acc_out, acc_c), None
+
+    flat_t = jax.eval_shape(lambda t: ravel_pytree(t)[0], params)
+    dp = lax.axis_size(axis)
+    chunk = _chunk_size(flat_t.shape[0], dp)
+    zero_c = jnp.zeros((chunk,), flat_t.dtype)
+    if has_aux:
+        out_shape = jax.eval_shape(
+            lambda p, mb: call(p, mb, 0), params,
+            jax.tree.map(lambda x: x[0], micro))
+        zero_out = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                out_shape[0])
+    else:
+        zero_out = jnp.zeros(())
+    (out, c), _ = jax.lax.scan(step, (zero_out, zero_c),
+                               (jnp.arange(n_micro), micro))
+    inv = 1.0 / n_micro
+    return jax.tree.map(lambda x: x * inv, out), c * inv
 
 
 def state_specs(

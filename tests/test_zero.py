@@ -137,3 +137,41 @@ def test_zero2_composes_with_3d():
     for a, b in zip(jax.tree.leaves(p_2), jax.tree.leaves(p_1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_zero2_chunk_accumulation_matches_zero1():
+    """grad_accum > 1 routes ZeRO-2 through chunk-space accumulation
+    (the full grad buffer never materialises across microbatches) —
+    updates must match the ZeRO-1 full-tree path. One step compares
+    tightly; multi-step tolerance is loose for the same reason as
+    test_zero1_matches_replicated_adamw_multistep: the scatter-then-sum
+    reassociation's ulp noise is amplified by Adam's rsqrt."""
+    p_1, _, l_1 = _run("zero1_adamw", [4], ["dp"], n_steps=1, grad_acc=4)
+    p_2, _, l_2 = _run("zero2_adamw", [4], ["dp"], n_steps=1, grad_acc=4)
+    np.testing.assert_allclose(l_2, l_1, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_2), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+    p_1, _, l_1 = _run("zero1_adamw", [4], ["dp"], n_steps=3, grad_acc=4)
+    p_2, _, l_2 = _run("zero2_adamw", [4], ["dp"], n_steps=3, grad_acc=4)
+    np.testing.assert_allclose(l_2, l_1, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(p_2), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_zero2_chunk_accumulation_under_dp_tp():
+    """Chunk accumulation + tp: per-microbatch model-axis psums must
+    reproduce the accumulate-then-reduce ordering (linearity; same
+    rsqrt-amplified tolerance as above)."""
+    p_1, _, l_1 = _run("zero1_adamw", [2, 2], ["dp", "tp"], n_steps=1,
+                       grad_acc=2)
+    p_2, _, l_2 = _run("zero2_adamw", [2, 2], ["dp", "tp"], n_steps=1,
+                       grad_acc=2)
+    np.testing.assert_allclose(l_2, l_1, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_2), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
