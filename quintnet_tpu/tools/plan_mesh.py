@@ -62,7 +62,7 @@ class Plan:
 
 
 def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
-             seq: int, zero1: bool = False,
+             seq: int, zero1: bool = False, zero_stage: int = 1,
              remat: bool = True) -> Plan:
     """Per-chip memory + per-step ICI-traffic estimate for one mesh.
 
@@ -87,7 +87,11 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
     master = 4 * local_params                      # f32 master copy
     compute = 2 * local_params                     # bf16 cast-at-use copy
     opt = 8 * (local_params // dp if zero1 else local_params)  # adam m+v
-    grads = 4 * local_params                       # f32 grads at update
+    # ZeRO-2 (zero_stage=2): gradients reduce-scatter into the rank's
+    # chunk and the grad-accumulation buffer is chunk-sized too
+    # (parallel/zero.py accumulate_grads_zero2)
+    grads = 4 * (local_params // dp if (zero1 and zero_stage == 2)
+                 else local_params)
     # activations: the scan stores one residual-stream tensor per layer
     # (bf16) even under full remat (carry boundaries), plus the block
     # working set; dense CE materialises f32 logits unless vp/sp/chunked
@@ -123,6 +127,7 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
 
 def plan(cfg: GPT2Config, *, n_devices: int, batch: int, seq: int,
          hbm_gb: float = DEFAULT_HBM_GB, zero1: bool = False,
+         zero_stage: int = 1,
          remat: bool = True, max_pp: Optional[int] = None,
          use_sp: bool = True) -> List[Plan]:
     """All legal meshes over ``n_devices``, fitting ones first, each
@@ -148,7 +153,7 @@ def plan(cfg: GPT2Config, *, n_devices: int, batch: int, seq: int,
                 out.append(estimate(cfg, {"dp": dp, "tp": tp,
                                           "pp": pp, "sp": sp},
                                     batch=batch, seq=seq, zero1=zero1,
-                                    remat=remat))
+                                    zero_stage=zero_stage, remat=remat))
     out.sort(key=lambda p: (p.bytes_per_chip > hbm,
                             p.comm_bytes_per_step, p.bytes_per_chip))
     return out
@@ -170,6 +175,9 @@ def main(argv=None):
     ap.add_argument("--hbm-gb", type=float, default=DEFAULT_HBM_GB)
     ap.add_argument("--zero1", action="store_true",
                     help="shard adam m/v over dp (parallel/zero.py)")
+    ap.add_argument("--zero2", action="store_true",
+                    help="additionally shard gradients/accumulators "
+                         "over dp (implies --zero1)")
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--vocab-parallel", action="store_true")
     ap.add_argument("--top", type=int, default=5)
@@ -182,7 +190,9 @@ def main(argv=None):
         cfg = dataclasses.replace(cfg, vocab_parallel=True,
                                   padded_vocab_size=50304)
     plans = plan(cfg, n_devices=args.devices, batch=args.batch,
-                 seq=args.seq, hbm_gb=args.hbm_gb, zero1=args.zero1,
+                 seq=args.seq, hbm_gb=args.hbm_gb,
+                 zero1=args.zero1 or args.zero2,
+                 zero_stage=2 if args.zero2 else 1,
                  remat=not args.no_remat)
     hbm = args.hbm_gb * GB
     fitting = [p for p in plans if p.bytes_per_chip <= hbm]

@@ -78,3 +78,10 @@ def test_cli_smoke(capsys):
     main(["--model", "gpt2-medium", "--devices", "8", "--batch", "32"])
     out = capsys.readouterr().out
     assert "legal meshes fit" in out and "GiB" in out
+
+
+def test_zero2_shards_grads_too():
+    z1 = _mem({"dp": 4}, zero1=True)
+    z2 = _mem({"dp": 4}, zero1=True, zero_stage=2)
+    assert z2.breakdown["grads"] * 4 == z1.breakdown["grads"]
+    assert z2.breakdown["opt"] == z1.breakdown["opt"]
