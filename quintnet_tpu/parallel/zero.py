@@ -19,7 +19,9 @@ all-gather.
 axes but NOT over dp; the dp reduction IS a ``psum_scatter`` straight
 into the rank's chunk — half the gradient-reduction traffic of the
 allreduce, and the full dp-reduced gradient vector never exists on any
-rank. Global-norm clipping moves inside, computed in chunk space with a
+rank. Under gradient accumulation, :func:`accumulate_grads_zero2`
+scatters per microbatch so even the ACCUMULATOR is chunk-sized (the
+classic ZeRO-2 memory story). Global-norm clipping moves inside, computed in chunk space with a
 per-element replication weight (a LayerNorm grad replicated over tp
 contributes once, not tp times — :func:`grad_weights`). Same update
 math as ZeRO-1 + clip to float reassociation (tests/test_zero.py).

@@ -345,3 +345,39 @@ def test_tied_embeddings_under_pp():
     np.testing.assert_allclose(
         np.asarray(p2["embedding"]["tok"]),
         np.asarray(p_ref["embedding"]["tok"]), rtol=2e-4, atol=1e-5)
+
+
+def test_llama_moe_pp_matches_single_device():
+    """Llama-MoE under pipeline parallelism: the per-stage aux
+    accumulation in the shared pp schedules must carry the SwiGLU-MoE
+    aux exactly as it does GPT-2's (per-microbatch aux objective —
+    compare against the microbatched single-device loss)."""
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    mcfg = LlamaConfig.tiny(n_experts=4, expert_top_k=2,
+                            expert_capacity=4096, aux_loss_weight=0.0)
+    model = llama_model_spec(mcfg)
+    host = llama_init(jax.random.key(0), mcfg)
+    ids = _ids(b=4, s=16, v=mcfg.vocab_size)
+
+    n_micro = 2
+    parts = [model.loss_fn(host, (jnp.asarray(ids[i * 2:(i + 1) * 2]),
+                                  jnp.asarray(ids[i * 2:(i + 1) * 2])))
+             for i in range(n_micro)]
+    ref = jnp.mean(jnp.stack(parts))
+
+    cfg = Config.from_dict({
+        "mesh_dim": [2], "mesh_name": ["pp"],
+        "training": {"batch_size": 4, "grad_clip_norm": None,
+                     "gradient_accumulation_steps": n_micro,
+                     "schedule": "1f1b"},
+    })
+    strat = get_strategy("pp", cfg)
+    p = strat.shard_params(model, jax.tree.map(jnp.array, host))
+    s = strat.init_opt_state(model, optax.sgd(0.05), p)
+    b = strat.shard_batch((jnp.asarray(ids), jnp.asarray(ids)), model)
+    _, _, loss = strat.make_train_step(model, optax.sgd(0.05))(p, s, b)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
