@@ -156,6 +156,67 @@ class LlamaConfig:
         )
 
 
+def llama_upcycle_to_moe(params, cfg: LlamaConfig, key=None):
+    """Sparse upcycling: dense Llama params -> SwiGLU-MoE params for a
+    config with ``n_experts > 0``. Every expert starts as a copy of the
+    dense SwiGLU; routers start near-zero so initial routing is
+    ~uniform (same recipe as gpt2_upcycle_to_moe)."""
+    if cfg.n_experts <= 0 or "moe" in params["blocks"]:
+        return params
+    key = key if key is not None else jax.random.key(0)
+    E = cfg.n_experts
+    blocks = dict(params["blocks"])
+    mlp = blocks.pop("mlp")
+    L = mlp["gate"]["w"].shape[0]
+
+    def per_expert(x):  # [L, D, H] -> [L, E, D, H]
+        return jnp.repeat(x[:, None], E, axis=1)
+
+    blocks["moe"] = {
+        "router": {"w": 1e-2 * jax.random.normal(
+            key, (L, cfg.dim, E), jnp.float32)},
+        "wg": per_expert(mlp["gate"]["w"]),
+        "wu": per_expert(mlp["up"]["w"]),
+        "wd": per_expert(mlp["down"]["w"]),
+    }
+    return {**params, "blocks": blocks}
+
+
+def llama_to_hf_state(params, cfg: LlamaConfig):
+    """Inverse of :func:`llama_from_hf_state`: this layout -> an HF
+    LlamaForCausalLM state dict of numpy arrays ([out, in] Linear
+    weights), loadable via ``model.load_state_dict`` after wrapping in
+    torch tensors. Dense configs only (HF has no SwiGLU-MoE Llama)."""
+    import numpy as np
+
+    if "moe" in params["blocks"]:
+        raise ValueError("HF export supports dense Llama only")
+    out = {"model.embed_tokens.weight":
+           np.asarray(params["embedding"]["tok"]),
+           "model.norm.weight":
+           np.asarray(params["head"]["ln_f"]["scale"])}
+    if not cfg.tie_embeddings:
+        out["lm_head.weight"] = np.asarray(params["head"]["lm"]["w"]).T
+    b = params["blocks"]
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        out[pre + "input_layernorm.weight"] = \
+            np.asarray(b["ln1"]["scale"][i])
+        out[pre + "post_attention_layernorm.weight"] = \
+            np.asarray(b["ln2"]["scale"][i])
+        for src, dst in (("q", "self_attn.q_proj"),
+                         ("k", "self_attn.k_proj"),
+                         ("v", "self_attn.v_proj"),
+                         ("o", "self_attn.o_proj")):
+            out[pre + dst + ".weight"] = \
+                np.asarray(b["attn"][src]["w"][i]).T
+        for src, dst in (("gate", "mlp.gate_proj"), ("up", "mlp.up_proj"),
+                         ("down", "mlp.down_proj")):
+            out[pre + dst + ".weight"] = \
+                np.asarray(b["mlp"][src]["w"][i]).T
+    return out
+
+
 def llama3_scaled_inv_freq(cfg: LlamaConfig):
     """Rope inverse frequencies with the llama3 wavelength-dependent
     scaling (HF _compute_llama3_parameters): high-frequency lanes keep

@@ -381,3 +381,56 @@ def test_llama_moe_pp_matches_single_device():
     b = strat.shard_batch((jnp.asarray(ids), jnp.asarray(ids)), model)
     _, _, loss = strat.make_train_step(model, optax.sgd(0.05))(p, s, b)
     np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+
+def test_llama_hf_export_roundtrip():
+    """export -> HF load_state_dict -> logits must match ours (the
+    inverse of the import golden)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from quintnet_tpu.models.llama import llama_to_hf_state
+
+    params = llama_init(jax.random.key(2), CFG)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=CFG.vocab_size, hidden_size=CFG.dim,
+        intermediate_size=CFG.intermediate_size,
+        num_hidden_layers=CFG.n_layers, num_attention_heads=CFG.n_heads,
+        num_key_value_heads=CFG.n_kv_heads,
+        max_position_embeddings=CFG.n_positions,
+        rope_theta=CFG.rope_theta, rms_norm_eps=CFG.rms_eps,
+        tie_word_embeddings=CFG.tie_embeddings,
+        attention_bias=False, mlp_bias=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    state = {k: torch.from_numpy(np.ascontiguousarray(v))
+             for k, v in llama_to_hf_state(params, CFG).items()}
+    missing, unexpected = hf.load_state_dict(state, strict=False)
+    assert not unexpected, unexpected
+    assert all("rotary" in m or "bias" not in m for m in missing), missing
+
+    ids = _ids(b=2, s=12, seed=9)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = np.asarray(llama_apply(params, jnp.asarray(ids), CFG))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_upcycle_to_moe_near_identity():
+    """Upcycled SwiGLU-MoE starts function-close to the dense model
+    (copied experts, near-uniform router; normalize_gates makes top-k
+    of identical experts exact up to gate normalisation)."""
+    from quintnet_tpu.models.llama import llama_upcycle_to_moe
+
+    dense = LlamaConfig.tiny()
+    moe = LlamaConfig.tiny(n_experts=4, expert_top_k=2,
+                           expert_capacity=4096)
+    params = llama_init(jax.random.key(0), dense)
+    up = llama_upcycle_to_moe(params, moe, key=jax.random.key(3))
+    assert set(up["blocks"]["moe"]) == {"router", "wg", "wu", "wd"}
+
+    ids = jnp.asarray(_ids(b=2, s=16, v=dense.vocab_size))
+    base = llama_apply(params, ids, dense)
+    upc = llama_apply(up, ids, moe)
+    # identical experts -> combine of normalised gates == dense output
+    np.testing.assert_allclose(np.asarray(upc), np.asarray(base),
+                               rtol=2e-3, atol=2e-3)
