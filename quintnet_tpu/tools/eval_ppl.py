@@ -1,0 +1,100 @@
+"""Perplexity of a GPT-2 or Llama checkpoint over a text file.
+
+The packed-stride evaluation standard: tokenise the whole file, pack
+into windows of ``--seq`` with no padding (data/datasets.pack_documents),
+mean CLM loss -> ppl = exp(loss). Works offline with the byte-level
+fallback tokenizer; pass an HF tokenizer dir for real BPE.
+
+  python -m quintnet_tpu.tools.eval_ppl --text file.txt \
+      [--family gpt2|llama] [--checkpoint model.safetensors] \
+      [--tokenizer tok_dir] [--seq 512] [--batch 8]
+
+Without --checkpoint a random tiny model runs (plumbing smoke; the
+number is meaningless). Reference analogue: none — the reference
+evaluates perplexity only inside its training loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", required=True)
+    ap.add_argument("--family", default="gpt2", choices=["gpt2", "llama"])
+    ap.add_argument("--checkpoint", default=None,
+                    help="HF safetensors (gpt2) — random tiny model if "
+                         "omitted")
+    ap.add_argument("--tokenizer", default=None,
+                    help="HF tokenizer dir; default byte-level")
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--platform", default="cpu",
+                    help="'cpu' (default) or e.g. 'tpu'")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quintnet_tpu.data import ByteTokenizer
+    from quintnet_tpu.data.datasets import pack_documents
+    from quintnet_tpu.models.gpt2 import clm_loss
+
+    if args.tokenizer:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.tokenizer)
+    else:
+        tok = ByteTokenizer()
+
+    text = open(args.text, encoding="utf-8").read()
+    eos = getattr(tok, "eos_token_id", 0) or 0
+    rows = pack_documents([tok.encode(text)], args.seq, eos_id=eos,
+                          drop_remainder=False)
+    print(f"{len(rows)} windows x {args.seq} tokens")
+
+    if args.family == "gpt2":
+        from quintnet_tpu.models.gpt2 import (GPT2Config, gpt2_apply,
+                                              gpt2_init)
+
+        if args.checkpoint:
+            from quintnet_tpu.models.gpt2_io import load_hf_gpt2
+
+            params, cfg = load_hf_gpt2(args.checkpoint)
+        else:
+            v = -(-max(getattr(tok, "vocab_size", 257), 128) // 8) * 8
+            cfg = GPT2Config.tiny(vocab_size=v,
+                                  n_positions=max(64, args.seq))
+            params = gpt2_init(jax.random.key(0), cfg)
+        apply_fn = lambda p, ids: gpt2_apply(p, ids, cfg)  # noqa: E731
+    else:
+        from quintnet_tpu.models.llama import (LlamaConfig, llama_apply,
+                                               llama_init)
+
+        v = -(-max(getattr(tok, "vocab_size", 257), 128) // 8) * 8
+        cfg = LlamaConfig.tiny(vocab_size=v,
+                               n_positions=max(64, args.seq))
+        params = llama_init(jax.random.key(0), cfg)
+        apply_fn = lambda p, ids: llama_apply(p, ids, cfg)  # noqa: E731
+
+    @jax.jit
+    def batch_loss(p, ids):
+        return clm_loss(apply_fn(p, ids), ids)
+
+    losses, weights = [], []
+    for i in range(0, len(rows), args.batch):
+        b = rows[i:i + args.batch]
+        losses.append(float(batch_loss(params, jnp.asarray(b))))
+        weights.append(len(b))
+    loss = float(np.average(losses, weights=weights))
+    print(f"loss {loss:.4f}  perplexity {math.exp(min(loss, 20.0)):.2f}")
+
+
+if __name__ == "__main__":
+    main()
