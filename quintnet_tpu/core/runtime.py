@@ -63,6 +63,7 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+    return jax
 
 
 def enable_compilation_cache(directory: str = "~/.cache/quintnet_tpu_xla",
@@ -90,7 +91,6 @@ def enable_compilation_cache(directory: str = "~/.cache/quintnet_tpu_xla",
     # cache everything jit-compiled, not only top-level programs
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return path
-    return jax
 
 
 def process_index() -> int:

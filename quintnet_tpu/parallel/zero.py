@@ -204,8 +204,12 @@ def accumulate_grads_zero2(loss_fn, params, batch, n_micro: int, *,
     model/partial/non-``axis``-data axes, reduce-scatters the ``axis``
     mean into this rank's chunk, and the scan carries only the
     [N_local/dp] chunk accumulator — the classic ZeRO-2 memory win (a
-    full-size accumulation buffer never exists; cost: one
-    reduce-scatter per microbatch instead of one allreduce per step).
+    full-size accumulation buffer never exists). Cost: EVERY grad
+    reduction now runs per microbatch — the dp reduce-scatter AND the
+    model/partial-axis psums (n_micro x the tp/pp reduction traffic of
+    the accumulate-then-reduce path; the same tradeoff DeepSpeed's
+    per-bucket reduction makes). Worth it when grad memory is the
+    binding constraint, which is when ZeRO-2 is chosen at all.
 
     Returns (mean loss[, aux], mean g_chunk) matching
     dp.accumulate_grads's output normalisation.

@@ -77,6 +77,15 @@ def main():
         from quintnet_tpu.models.llama import (LlamaConfig, llama_apply,
                                                llama_init)
 
+        if args.checkpoint:
+            # a silently-random model masquerading as the checkpoint is
+            # worse than an error; llama loading takes an HF DIRECTORY
+            # (config + weights), not a bare safetensors file
+            raise SystemExit(
+                "--checkpoint with --family llama is not supported by "
+                "this tool yet — load via transformers + "
+                "models/llama.llama_from_hf_state (see "
+                "tools/verify_llama.py --hf-dir for the pattern)")
         v = -(-max(getattr(tok, "vocab_size", 257), 128) // 8) * 8
         cfg = LlamaConfig.tiny(vocab_size=v,
                                n_positions=max(64, args.seq))

@@ -73,6 +73,7 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
     over dp and sequence over sp. f32 master params + bf16 compute
     (the shipped default), Adam m+v f32.
     """
+    zero1 = zero1 or zero_stage >= 2   # zero2 implies the stage-1 shard
     dp, tp, pp, sp = (mesh.get(a, 1) for a in ("dp", "tp", "pp", "sp"))
     d, L, V, H = cfg.n_embd, cfg.n_layer, cfg.table_vocab_size, cfg.n_head
 
