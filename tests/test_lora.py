@@ -195,3 +195,22 @@ def test_lora_on_llama_family():
         lora, state, loss = step(lora, state)
         l0 = l0 if l0 is not None else float(loss)
     assert float(loss) < l0
+
+
+def test_lora_on_vit():
+    """Third family: the same adapters train ViT blocks (qkv/proj/fc
+    names match) — one LoRA implementation, every model."""
+    from quintnet_tpu.models.vit import (ViTConfig, cross_entropy_loss,
+                                         vit_apply, vit_init)
+
+    vcfg = ViTConfig(image_size=14, patch_size=7, in_channels=1,
+                     hidden_dim=16, depth=2, num_heads=2, num_classes=10)
+    params = vit_init(jax.random.key(0), vcfg)
+    lcfg = LoRAConfig(rank=2, alpha=4.0)
+    lora = lora_init(jax.random.key(1), params["blocks"], lcfg)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 14, 14, 1)), jnp.float32)
+    merged = lora_merge_tree(params, lora, lcfg)
+    np.testing.assert_allclose(  # zero-init identity
+        np.asarray(vit_apply(merged, x, vcfg)),
+        np.asarray(vit_apply(params, x, vcfg)), rtol=1e-6, atol=1e-6)
