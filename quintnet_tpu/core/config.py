@@ -155,6 +155,9 @@ class TrainingConfig:
     # queue wastes host memory. The drain costs only the host dispatch
     # latency every N steps (<1% at real step times). 0 disables.
     sync_every: int = 8
+    # host-side batch prefetch depth (data/datasets.prefetch_batches):
+    # overlaps tokenisation/stacking with device steps. 0 disables.
+    prefetch: int = 2
 
     @property
     def remat_mode(self):

@@ -368,7 +368,13 @@ class Trainer:
             losses = []
             t_win = time.time()
             sync_every = self.config.training.sync_every
-            for i, (xb, yb) in enumerate(train_batches_fn(epoch)):
+            batches = train_batches_fn(epoch)
+            if self.config.training.prefetch:
+                from quintnet_tpu.data import prefetch_batches
+
+                batches = prefetch_batches(
+                    iter(batches), n=self.config.training.prefetch)
+            for i, (xb, yb) in enumerate(batches):
                 batch = self.strategy.shard_batch(
                     (jnp.asarray(xb), jnp.asarray(yb)), self.model)
                 # per-step dropout seed: deterministic in (config seed,
