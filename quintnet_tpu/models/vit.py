@@ -55,6 +55,28 @@ class ViTConfig:
     # lax.scan unroll factor over the block stack (perf knob, same
     # semantics as GPT2Config.scan_unroll)
     scan_unroll: int = 1
+    # --- MoE (0 = dense): every block's MLP becomes a routed mixture
+    # (nn/moe.py), ep-shardable. ViT is non-causal, so BOTH routers are
+    # legal here — including "expert_choice" (the router the causal LM
+    # families must reject).
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    expert_capacity: Optional[int] = None
+    aux_loss_weight: float = 1e-2
+    router_type: str = "topk"
+
+    @property
+    def moe_args(self):
+        if self.n_experts <= 0:
+            return None
+        from quintnet_tpu.nn.moe import MoEArgs
+
+        return MoEArgs(n_experts=self.n_experts, top_k=self.expert_top_k,
+                       capacity_factor=self.capacity_factor,
+                       capacity=self.expert_capacity,
+                       aux_weight=self.aux_loss_weight,
+                       router=self.router_type)
 
     @property
     def needs_dropout(self) -> bool:
@@ -85,7 +107,8 @@ def vit_init(key, cfg: ViTConfig, *, dtype=jnp.float32):
 
     block_keys = jax.random.split(k_blocks, cfg.depth)
     blocks = tree_stack(
-        [block_init(bk, cfg.hidden_dim, mlp_hidden=cfg.mlp_hidden, dtype=dtype)
+        [block_init(bk, cfg.hidden_dim, mlp_hidden=cfg.mlp_hidden,
+                    dtype=dtype, moe=cfg.moe_args)
          for bk in block_keys]
     )
 
@@ -126,17 +149,21 @@ def vit_head(p_head, x):
     return linear_apply(p_head["fc"], cls)
 
 
-def vit_apply(
+def vit_forward(
     params,
     images,
     cfg: ViTConfig,
     *,
     tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
     remat: bool = False,
     compute_dtype=None,
     key=None,
 ):
-    """Forward pass: [B, H, W, C] (or [B, C, H, W] — auto-detected) -> logits.
+    """[B, H, W, C] (or [B, C, H, W] — auto-detected) ->
+    (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs; with
+    ``cfg.n_experts > 0`` every block MLP routes through nn/moe.py
+    (ViT is non-causal, so expert_choice routing is legal here).
 
     ``tp_axis``: see nn/transformer.py — heads/MLP column-row sharded;
     ``num_heads`` passed to attention is LOCAL heads.
@@ -160,7 +187,7 @@ def vit_apply(
         k_embd, k_blocks = jax.random.split(key)
     x = vit_embed(params["embedding"], images, cfg.patch_size,
                   pdrop=cfg.dropout, key=k_embd)
-    x = stacked_blocks_apply(
+    out = stacked_blocks_apply(
         params["blocks"],
         x,
         num_heads=local_heads,
@@ -168,17 +195,32 @@ def vit_apply(
         act=jax.nn.relu,  # reference ViT MLP uses ReLU (model.py:112-148)
         tp_axis=tp_axis,
         remat=remat,
+        moe_args=cfg.moe_args,
+        ep_axis=ep_axis,
         attn_pdrop=cfg.dropout,
         resid_pdrop=cfg.dropout,
         key=k_blocks,
         scan_unroll=cfg.scan_unroll,
     )
-    return vit_head(params["head"], x).astype(jnp.float32)
+    x, aux = out if cfg.n_experts > 0 else (out,
+                                            jnp.zeros((), jnp.float32))
+    return vit_head(params["head"], x).astype(jnp.float32), aux
+
+
+def vit_apply(params, images, cfg: ViTConfig, *,
+              tp_axis: Optional[str] = None, remat: bool = False,
+              compute_dtype=None, key=None):
+    """Logits only (aux discarded) — the eval/inference view."""
+    logits, _ = vit_forward(params, images, cfg, tp_axis=tp_axis,
+                            remat=remat, compute_dtype=compute_dtype,
+                            key=key)
+    return logits
 
 
 def vit_partition_specs(cfg: Optional[ViTConfig] = None, *,
                         tp_axis: Optional[str] = "tp",
-                        pp_axis: Optional[str] = None):
+                        pp_axis: Optional[str] = None,
+                        ep_axis: Optional[str] = None):
     """PartitionSpec tree matching :func:`vit_init`'s param tree.
 
     Embedding and head are small -> replicated (the reference replicates
@@ -190,13 +232,20 @@ def vit_partition_specs(cfg: Optional[ViTConfig] = None, *,
 
     from quintnet_tpu.parallel.tp import block_specs
 
+    bspecs = block_specs(tp_axis=tp_axis, stacked=True, pp_axis=pp_axis)
+    if cfg is not None and cfg.n_experts > 0:
+        from quintnet_tpu.nn.moe import moe_specs
+
+        del bspecs["mlp"]
+        bspecs["moe"] = moe_specs(ep_axis=ep_axis, tp_axis=tp_axis,
+                                  stacked=True, pp_axis=pp_axis)
     return {
         "embedding": {
             "patch": {"w": P(), "b": P()},
             "cls": P(),
             "pos": P(),
         },
-        "blocks": block_specs(tp_axis=tp_axis, stacked=True, pp_axis=pp_axis),
+        "blocks": bspecs,
         "head": {
             "ln": {"scale": P(), "bias": P()},
             "fc": {"w": P(), "b": P()},
@@ -272,15 +321,16 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
     def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
                 key=None):
         x, y = batch
-        return cross_entropy_loss(
-            vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat,
-                      key=key), y)
+        logits, aux = vit_forward(params, x, cfg, tp_axis=tp_axis,
+                                  ep_axis=ep_axis, remat=remat, key=key)
+        return cross_entropy_loss(logits, y) + aux
 
     def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
         return vit_pipeline_fns(cfg, tp_axis=tp_axis, remat=remat)
 
     def partition_specs(tp_axis=None, pp_axis=None, ep_axis=None):
-        return vit_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis)
+        return vit_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
+                                   ep_axis=ep_axis)
 
     def to_tp_layout(params, tp):
         return vit_to_tp_layout(params, cfg, tp)

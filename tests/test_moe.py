@@ -406,3 +406,40 @@ def test_expert_choice_rejected_by_causal_configs():
                                  router_type="expert_choice")):
         with pytest.raises(ValueError, match="non-causal"):
             cfg.moe_args
+
+
+def test_vit_moe_expert_choice_trains_and_shards(rng):
+    """ViT-MoE with EXPERT-CHOICE routing (legal: non-causal encoder) —
+    dp x ep strategy loss == single device, and training reduces it."""
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.models.vit import (ViTConfig, vit_init,
+                                         vit_model_spec)
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    vcfg = ViTConfig(image_size=14, patch_size=7, in_channels=1,
+                     hidden_dim=16, depth=2, num_heads=2, num_classes=10,
+                     n_experts=4, router_type="expert_choice",
+                     expert_capacity=4096, aux_loss_weight=0.0)
+    model = vit_model_spec(vcfg)
+    host = vit_init(jax.random.key(0), vcfg)
+    x = jnp.asarray(rng.normal(size=(8, 14, 14, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (8,)), jnp.int32)
+
+    ref = model.loss_fn(host, (x, y))
+
+    cfg = Config.from_dict({
+        "mesh_dim": [2, 2], "mesh_name": ["dp", "ep"],
+        "training": {"batch_size": 8, "grad_clip_norm": None}})
+    strat = get_strategy("dp_ep", cfg)
+    opt = optax.adam(1e-2)
+    p = strat.shard_params(model, jax.tree.map(jnp.array, host))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch((x, y), model)
+    step = strat.make_train_step(model, opt)
+    p, s, loss = step(p, s, b)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4)
+    for _ in range(9):
+        p, s, loss = step(p, s, b)
+    assert float(loss) < float(ref)
