@@ -277,6 +277,11 @@ def vit_pipeline_fns(cfg: ViTConfig, *, tp_axis: Optional[str] = None,
     (wrapper.py:89-96: embedding -> stage 0, classification_head -> last
     stage, blocks split in between).
     """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "ViT-MoE under pipeline parallelism is not wired (the MoE "
+            "aux is not threaded through the ViT stage fns); use "
+            "dp/tp/ep meshes, or the GPT-2/Llama families for MoE+pp")
 
     def embed_fn(params, x, key=None):
         if x.ndim == 4 and x.shape[1] == cfg.in_channels \
