@@ -27,6 +27,9 @@ def main():
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--docs", type=int, default=512,
                     help="synthetic documents to pack")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="n_experts: Mixtral-style SwiGLU-MoE blocks "
+                         "(add an 'ep' axis to --mesh to shard them)")
     args = ap.parse_args()
 
     from quintnet_tpu.examples.common import setup_platform
@@ -67,7 +70,8 @@ def main():
     # exercises GQA under whatever mesh was picked
     lcfg = LlamaConfig.tiny(vocab_size=264, n_positions=args.seq,
                             dim=64, n_layers=4, n_heads=4, n_kv_heads=2,
-                            intermediate_size=128)
+                            intermediate_size=128,
+                            n_experts=args.experts)
     model = llama_model_spec(lcfg, sp_mode="zigzag")
     strat = get_strategy("auto", cfg)
     print(f"mesh={dict(strat.mesh.shape)} llama dim={lcfg.dim} "
