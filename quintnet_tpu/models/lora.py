@@ -192,6 +192,59 @@ def lora_upcast(lora, dtype=jnp.float32):
     return jax.tree.map(lambda l: l.astype(dtype), lora)
 
 
+def make_lora_train_step(mesh, merged_loss_fn, optimizer, *,
+                         base_specs, lora_specs, batch_specs=None,
+                         batch_axes=("dp",), model_axes=("tp",)):
+    """Sharded adapter-only training over any (dp, tp, ...) mesh.
+
+    ``merged_loss_fn(base_params, lora, batch) -> scalar`` runs INSIDE
+    shard_map (it sees local shards and may use collectives — merge with
+    :func:`lora_merge_blocks`/``lora_merge_tree`` locally; the spec
+    derivation makes that exact, see module docstring). Only the
+    adapters carry gradients/optimizer state; the base rides along as a
+    frozen sharded input (never donated, no optimizer memory).
+
+    Returns ``step(base, lora, opt_state, batch) ->
+    (lora, opt_state, loss)`` — jitted, adapters+state donated.
+    """
+    import optax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.core import collectives as cc
+    from quintnet_tpu.parallel.train_step import (opt_state_specs,
+                                                  reduce_grads)
+
+    data_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    maxes = tuple(a for a in model_axes if a in mesh.axis_names)
+
+    def local_step(base, lora, opt_state, batch):
+        loss, g = jax.value_and_grad(
+            lambda l: merged_loss_fn(base, l, batch))(lora)
+        g = reduce_grads(g, lora_specs, data_axes=data_axes,
+                         model_axes=maxes)
+        if data_axes:
+            loss = lax.pmean(loss, data_axes)
+        updates, opt_state = optimizer.update(g, opt_state, lora)
+        return optax.apply_updates(lora, updates), opt_state, loss
+
+    compiled = {}
+
+    def step(base, lora, opt_state, batch):
+        if "fn" not in compiled:
+            o_specs = opt_state_specs(optimizer, lora, lora_specs)
+            b_spec = (batch_specs if batch_specs is not None
+                      else P(data_axes if data_axes else None))
+            compiled["fn"] = jax.jit(cc.shard_map_fn(
+                local_step, mesh,
+                in_specs=(base_specs, lora_specs, o_specs, b_spec),
+                out_specs=(lora_specs, o_specs, P())),
+                donate_argnums=(1, 2))
+        return compiled["fn"](base, lora, opt_state, batch)
+
+    return step
+
+
 def _flatten(lora) -> Dict[str, jnp.ndarray]:
     out = {}
 

@@ -214,3 +214,72 @@ def test_lora_on_vit():
     np.testing.assert_allclose(  # zero-init identity
         np.asarray(vit_apply(merged, x, vcfg)),
         np.asarray(vit_apply(params, x, vcfg)), rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_lora_training_matches_single_device(base):
+    """make_lora_train_step on a dp x tp mesh: 3 adapter-only steps
+    must match single-device LoRA training (same data, same init)."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.core.mesh import mesh_from_sizes
+    from quintnet_tpu.models.gpt2 import (clm_loss, gpt2_forward,
+                                          gpt2_partition_specs,
+                                          gpt2_to_tp_layout)
+    from quintnet_tpu.models.lora import (lora_merge_blocks,
+                                          make_lora_train_step)
+    from quintnet_tpu.parallel.tp import block_specs
+    from quintnet_tpu.parallel.train_step import shard_pytree
+
+    params, ids = base
+    lcfg = LoRAConfig(rank=4, alpha=8.0, targets=("proj", "fc"))
+    lora0 = lora_init(jax.random.key(11), params["blocks"], lcfg)
+    opt = optax.adam(1e-2)
+    ids_j = jnp.asarray(ids)
+
+    # single-device reference
+    fwd = lora_wrap(lambda p, i: gpt2_apply(p, i, CFG), params, lcfg)
+    lo, st = jax.tree.map(jnp.array, lora0), None
+    st = opt.init(lo)
+
+    @jax.jit
+    def ref_step(lo, st):
+        loss, g = jax.value_and_grad(
+            lambda l: clm_loss(fwd(l, ids_j), ids_j))(lo)
+        up, st = opt.update(g, st, lo)
+        return optax.apply_updates(lo, up), st, loss
+
+    ref_losses = []
+    for _ in range(3):
+        lo, st, loss = ref_step(lo, st)
+        ref_losses.append(float(loss))
+
+    # dp2 x tp2 sharded
+    mesh = mesh_from_sizes(dp=2, tp=2)
+    bspecs = block_specs(tp_axis="tp", stacked=True)
+    lspecs = lora_partition_specs(bspecs, lcfg)
+    base_specs = gpt2_partition_specs(CFG, tp_axis="tp")
+    base_tp = shard_pytree(mesh, gpt2_to_tp_layout(params, CFG, 2),
+                           base_specs)
+    lora_s = shard_pytree(mesh, jax.tree.map(jnp.array, lora0), lspecs)
+    opt_s = opt.init(lora_s)
+
+    def merged_loss(base, lora, batch):
+        merged = {**base,
+                  "blocks": lora_merge_blocks(base["blocks"], lora, lcfg)}
+        logits, _ = gpt2_forward(merged, batch[0], CFG, tp_axis="tp")
+        return clm_loss(logits, batch[1])
+
+    step = make_lora_train_step(mesh, merged_loss, opt,
+                                base_specs=base_specs, lora_specs=lspecs)
+    losses = []
+    for _ in range(3):
+        lora_s, opt_s, loss = step(base_tp, lora_s, opt_s,
+                                   (ids_j, ids_j))
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+        lora_s, lo)
