@@ -230,17 +230,19 @@ def gpt2_generate(params, input_ids, cfg: GPT2Config, *,
     return np.asarray(out)
 
 
-def _beam_body(params, input_ids, cfg: GPT2Config, beams: int,
-               max_new_tokens: int, eos_token_id: Optional[int],
-               length_penalty: float):
+def beam_autoregress(prefill_fn, decode_fn, input_ids, *, beams: int,
+                     vocab: int, max_new_tokens: int,
+                     eos_token_id: Optional[int],
+                     length_penalty: float):
+    """Model-agnostic beam decode (same prefill_fn/decode_fn contract
+    as :func:`autoregress`; ``vocab`` = logits width). GPT-2 wires it
+    below; Llama in models/llama_generate.py."""
     B, T0 = input_ids.shape
     K = beams
-    V = cfg.table_vocab_size if cfg.padded_vocab_size else cfg.vocab_size
-    cache_len = T0 + max_new_tokens
+    V = vocab
     neg = jnp.float32(-1e30)
 
-    logits0, caches = gpt2_prefill(params, input_ids, cfg,
-                                   cache_len=cache_len)
+    logits0, caches = prefill_fn(input_ids)
     # expand to B*K rows (beam-major inside each batch row)
     caches = jax.tree.map(
         lambda c: jnp.repeat(c, K, axis=1), caches)   # [L, B*K, H, T, Dh]
@@ -259,8 +261,8 @@ def _beam_body(params, input_ids, cfg: GPT2Config, beams: int,
         scores, done, toks, caches = carry
         tok = lax.dynamic_index_in_dim(toks, i - 1, axis=2,
                                        keepdims=False)  # [B, K]
-        logits, caches = gpt2_decode_step(
-            params, tok.reshape(B * K), jnp.int32(T0) + i - 1, caches, cfg)
+        logits, caches = decode_fn(tok.reshape(B * K),
+                                   jnp.int32(T0) + i - 1, caches)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         logp = logp.reshape(B, K, V)
         if eos_token_id is not None:
@@ -309,6 +311,22 @@ def _beam_body(params, input_ids, cfg: GPT2Config, beams: int,
                         max_new_tokens)[:, None]
         best_toks = jnp.where(pos > cut, eos_token_id, best_toks)
     return jnp.concatenate([input_ids, best_toks], axis=1)
+
+
+def _beam_body(params, input_ids, cfg: GPT2Config, beams: int,
+               max_new_tokens: int, eos_token_id: Optional[int],
+               length_penalty: float):
+    cache_len = input_ids.shape[1] + max_new_tokens
+    return beam_autoregress(
+        lambda ids: gpt2_prefill(params, ids, cfg,
+                                 cache_len=cache_len),
+        lambda tok, pos, caches: gpt2_decode_step(params, tok, pos,
+                                                  caches, cfg),
+        input_ids, beams=beams,
+        vocab=(cfg.table_vocab_size if cfg.padded_vocab_size
+               else cfg.vocab_size),
+        max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+        length_penalty=length_penalty)
 
 
 _beam_jit = partial(jax.jit, static_argnames=(

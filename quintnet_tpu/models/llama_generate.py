@@ -154,3 +154,42 @@ def _llama_tp_generate_fn(cfg: LlamaConfig, mesh, tp_axis: str,
         local_gen, mesh,
         in_specs=(specs, P(), P()),
         out_specs=P()))
+
+
+def llama_beam_search(params, input_ids, cfg: LlamaConfig, *,
+                      beams: int = 4, max_new_tokens: int,
+                      eos_token_id: Optional[int] = None,
+                      length_penalty: float = 1.0) -> np.ndarray:
+    """Beam-search decode for Llama on the shared beam machinery
+    (models/gpt2_generate.beam_autoregress): GNMT length penalty,
+    beams=1 reduces to greedy (tests/test_llama.py golden)."""
+    if max_new_tokens < 1:
+        return np.asarray(input_ids)
+    if input_ids.shape[1] + max_new_tokens > cfg.n_positions:
+        raise ValueError(
+            f"prompt {input_ids.shape[1]} + max_new {max_new_tokens} "
+            f"exceeds n_positions={cfg.n_positions}")
+    out = _llama_beam_jit(params, jnp.asarray(input_ids, jnp.int32), cfg,
+                          int(beams), int(max_new_tokens), eos_token_id,
+                          float(length_penalty))
+    return np.asarray(out)
+
+
+def _llama_beam_body(params, input_ids, cfg: LlamaConfig, beams: int,
+                     max_new_tokens: int, eos_token_id,
+                     length_penalty: float):
+    from quintnet_tpu.models.gpt2_generate import beam_autoregress
+
+    cache_len = input_ids.shape[1] + max_new_tokens
+    return beam_autoregress(
+        lambda ids: llama_prefill(params, ids, cfg, cache_len=cache_len),
+        lambda tok, pos, caches: llama_decode_step(params, tok, pos,
+                                                   caches, cfg),
+        input_ids, beams=beams, vocab=cfg.vocab_size,
+        max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+        length_penalty=length_penalty)
+
+
+_llama_beam_jit = partial(jax.jit, static_argnames=(
+    "cfg", "beams", "max_new_tokens", "eos_token_id",
+    "length_penalty"))(_llama_beam_body)

@@ -434,3 +434,26 @@ def test_llama_upcycle_to_moe_near_identity():
     # identical experts -> combine of normalised gates == dense output
     np.testing.assert_allclose(np.asarray(upc), np.asarray(base),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_llama_beam1_equals_greedy_and_beam_scores():
+    from quintnet_tpu.models.llama_generate import (llama_beam_search,
+                                                    llama_generate)
+
+    params = llama_init(jax.random.key(0), CFG)
+    ids = _ids(b=2, s=5, seed=12)
+    greedy = llama_generate(params, ids, CFG, max_new_tokens=5)
+    beam1 = llama_beam_search(params, ids, CFG, beams=1, max_new_tokens=5)
+    np.testing.assert_array_equal(greedy, beam1)
+
+    beam4 = llama_beam_search(params, ids, CFG, beams=4, max_new_tokens=5)
+
+    def seq_lp(full):
+        logits = llama_apply(params, jnp.asarray(full), CFG)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tgt = full[:, 1:]
+        tok = np.take_along_axis(np.asarray(logp[:, :-1]),
+                                 tgt[:, :, None], axis=2)[:, :, 0]
+        return tok[:, 4:].sum(axis=1)
+
+    assert (seq_lp(beam4) >= seq_lp(greedy) - 1e-4).all()
