@@ -457,3 +457,35 @@ def test_llama_beam1_equals_greedy_and_beam_scores():
         return tok[:, 4:].sum(axis=1)
 
     assert (seq_lp(beam4) >= seq_lp(greedy) - 1e-4).all()
+
+
+@pytest.mark.parametrize("sp_mode", ["zigzag", "ulysses"])
+def test_llama_sp_modes_match_single_device(sp_mode):
+    """Ring is covered in the strategy matrix; pin zigzag and ulysses
+    too (rope with global positions must compose with both)."""
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.models.gpt2 import clm_loss
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    cfg_m = LlamaConfig.tiny()
+    model = llama_model_spec(cfg_m, sp_mode=sp_mode)
+    host = llama_init(jax.random.key(0), cfg_m)
+    ids = _ids(b=4, s=16)
+
+    ref = clm_loss(llama_apply(host, jnp.asarray(ids), cfg_m),
+                   jnp.asarray(ids))
+
+    cfg = Config.from_dict({
+        "mesh_dim": [2], "mesh_name": ["sp"],
+        "training": {"batch_size": 4, "grad_clip_norm": None,
+                     "sp_mode": sp_mode},
+    })
+    strat = get_strategy("sp", cfg)
+    opt = optax.sgd(0.05)
+    p = strat.shard_params(model, jax.tree.map(jnp.array, host))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch((jnp.asarray(ids), jnp.asarray(ids)), model)
+    _, _, loss = strat.make_train_step(model, opt)(p, s, b)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
