@@ -96,7 +96,9 @@ class LlamaConfig:
 
     @staticmethod
     def llama32_1b() -> "LlamaConfig":
-        return LlamaConfig(n_positions=131072,
+        # vocab_size matches the real Llama-3.2-1B checkpoint (128256);
+        # tie_embeddings=True (the default above) also matches 3.2-1B.
+        return LlamaConfig(vocab_size=128256, n_positions=131072,
                            rope_scaling=(32.0, 1.0, 4.0, 8192))
 
     @staticmethod
@@ -291,6 +293,10 @@ def llama_qkv(p_attn, a_in, cfg: LlamaConfig, cos, sin, *, tp: int = 1):
     """Projections + rope, shared by training forward, prefill and
     decode: normalized input [B, S, D] -> (q [B, Hq/tp, S, hd] rotated,
     k [B, Hkv/tp, S, hd] rotated, v) — k/v UNrepeated (GQA)."""
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_heads={cfg.n_heads} and "
+            f"n_kv_heads={cfg.n_kv_heads} (Megatron head sharding)")
     b, s, _ = a_in.shape
     hd = cfg.head_dim
 

@@ -55,9 +55,24 @@ def main():
 
     text = open(args.text, encoding="utf-8").read()
     eos = getattr(tok, "eos_token_id", 0) or 0
-    rows = pack_documents([tok.encode(text)], args.seq, eos_id=eos,
+    enc = tok.encode(text)
+    rows = pack_documents([enc], args.seq, eos_id=eos,
                           drop_remainder=False)
-    print(f"{len(rows)} windows x {args.seq} tokens")
+    # pack_documents EOS-pads the final window; those synthetic
+    # positions must not count in the loss (they bias ppl downward on
+    # repetitive EOS runs). Labels mask the tail with IGNORE_INDEX —
+    # inputs keep the padding (shapes stay static).
+    from quintnet_tpu.models.gpt2 import IGNORE_INDEX
+
+    if not enc:
+        raise SystemExit(f"--text {args.text}: no tokens to evaluate")
+    labels = rows.copy()
+    n_real = len(enc) + 1  # + the appended EOS separator
+    rem = n_real % args.seq
+    if rem:
+        labels[-1, rem:] = IGNORE_INDEX
+    print(f"{len(rows)} windows x {args.seq} tokens "
+          f"({n_real} real tokens)")
 
     if args.family == "gpt2":
         from quintnet_tpu.models.gpt2 import (GPT2Config, gpt2_apply,
@@ -93,14 +108,17 @@ def main():
         apply_fn = lambda p, ids: llama_apply(p, ids, cfg)  # noqa: E731
 
     @jax.jit
-    def batch_loss(p, ids):
-        return clm_loss(apply_fn(p, ids), ids)
+    def batch_loss(p, ids, lab):
+        return clm_loss(apply_fn(p, ids), lab)
 
     losses, weights = [], []
     for i in range(0, len(rows), args.batch):
-        b = rows[i:i + args.batch]
-        losses.append(float(batch_loss(params, jnp.asarray(b))))
-        weights.append(len(b))
+        b, lb = rows[i:i + args.batch], labels[i:i + args.batch]
+        losses.append(float(batch_loss(params, jnp.asarray(b),
+                                       jnp.asarray(lb))))
+        # weight by REAL (unmasked) shifted targets, not row count —
+        # the final window contributes only its real tokens
+        weights.append(int(np.sum(lb[:, 1:] != IGNORE_INDEX)))
     loss = float(np.average(losses, weights=weights))
     print(f"loss {loss:.4f}  perplexity {math.exp(min(loss, 20.0)):.2f}")
 

@@ -142,11 +142,25 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
     eos_token_id=..., temperature=..., top_k=..., top_p=..., key=...)``:
     override the decoder — e.g. models/llama_generate.llama_generate
     scores a Llama model with the same ROUGE/BLEU harness. Default:
-    the GPT-2 decoders (+beams/tp routing below).
+    the GPT-2 decoders (+beams/tp routing below). With ``beams > 1``
+    the sampling kwargs are replaced by ``beams=`` — pass a
+    beam-capable decoder (e.g. llama_generate.llama_beam_search).
     """
     from quintnet_tpu.models.gpt2_generate import (gpt2_beam_search,
                                                    gpt2_generate,
                                                    gpt2_generate_tp)
+
+    if (beams > 1 and generate_fn is None and mesh is not None
+            and mesh.shape.get(tp_axis, 1) > 1):
+        # the built-in gpt2 beam decode is single-device; silently
+        # scoring the tp sampling decoder instead of the requested
+        # beams would corrupt the comparison — refuse instead (a
+        # custom generate_fn receives beams= and routes itself)
+        raise ValueError(
+            "beams > 1 under a tp>1 mesh is not implemented by the "
+            "built-in decoder; use beams=1 (sampling/greedy tp "
+            "decode), a single-device mesh, or a beam-capable "
+            "generate_fn")
 
     by_len: Dict[int, List[int]] = {}
     for i, (ids, _ref) in enumerate(prompts):
@@ -166,13 +180,15 @@ def evaluate_generation(params, cfg, prompts: Sequence, tokenizer, *,
             sample = dict(temperature=temperature, top_k=top_k,
                           top_p=top_p, key=key)
             if generate_fn is not None:
+                # beam decoders (e.g. llama_beam_search) take beams=
+                # and are deterministic (no sampling kwargs)
+                kw = (dict(beams=beams) if beams > 1 else sample)
                 out = generate_fn(params, batch, cfg,
                                   max_new_tokens=max_new_tokens,
-                                  eos_token_id=eos_token_id, **sample)
-            elif beams > 1 and (mesh is None
-                              or mesh.shape.get(tp_axis, 1) == 1):
+                                  eos_token_id=eos_token_id, **kw)
+            elif beams > 1:
                 # beam decode is single-device (deterministic, so no
-                # key); tp meshes fall through to sampling/greedy tp
+                # key); the tp>1 case was refused above
                 out = gpt2_beam_search(params, batch, cfg, beams=beams,
                                        max_new_tokens=max_new_tokens,
                                        eos_token_id=eos_token_id)
