@@ -270,18 +270,17 @@ def vit_to_tp_layout(params, cfg: ViTConfig, tp: int):
 
 
 def vit_pipeline_fns(cfg: ViTConfig, *, tp_axis: Optional[str] = None,
-                     remat: bool = False):
+                     ep_axis: Optional[str] = None, remat: bool = False):
     """(embed_fn, stage_fn, head_loss_fn) for parallel/pp.py schedules.
 
     Replaces the reference's PipelineParallelWrapper attribute plumbing
     (wrapper.py:89-96: embedding -> stage 0, classification_head -> last
     stage, blocks split in between).
+
+    MoE configs make ``stage_fn`` return ``(h, aux)`` — the schedules
+    in parallel/pp.py accumulate each stage's aux into the loss (same
+    contract as gpt2_pipeline_fns).
     """
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "ViT-MoE under pipeline parallelism is not wired (the MoE "
-            "aux is not threaded through the ViT stage fns); use "
-            "dp/tp/ep meshes, or the GPT-2/Llama families for MoE+pp")
 
     def embed_fn(params, x, key=None):
         if x.ndim == 4 and x.shape[1] == cfg.in_channels \
@@ -299,6 +298,8 @@ def vit_pipeline_fns(cfg: ViTConfig, *, tp_axis: Optional[str] = None,
             act=jax.nn.relu,
             tp_axis=tp_axis,
             remat=remat,
+            moe_args=cfg.moe_args,
+            ep_axis=ep_axis,
             attn_pdrop=cfg.dropout,
             resid_pdrop=cfg.dropout,
             key=key,
@@ -331,7 +332,8 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
         return cross_entropy_loss(logits, y) + aux
 
     def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
-        return vit_pipeline_fns(cfg, tp_axis=tp_axis, remat=remat)
+        return vit_pipeline_fns(cfg, tp_axis=tp_axis, ep_axis=ep_axis,
+                                remat=remat)
 
     def partition_specs(tp_axis=None, pp_axis=None, ep_axis=None):
         return vit_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
@@ -349,6 +351,7 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
 
     def pipeline_eval_fns(tp_axis=None, sp_axis=None, ep_axis=None):
         embed_fn, stage_fn, _ = vit_pipeline_fns(cfg, tp_axis=tp_axis,
+                                                 ep_axis=ep_axis,
                                                  remat=remat)
 
         def head_metrics_fn(params, h, y):

@@ -408,6 +408,48 @@ def test_expert_choice_rejected_by_causal_configs():
             cfg.moe_args
 
 
+@pytest.mark.parametrize("schedule", ["afab", "1f1b"])
+def test_vit_moe_pp_matches_single_device(rng, schedule):
+    """ViT-MoE under PIPELINE parallelism (aux enabled): per-stage aux
+    accumulation through the ViT stage fns must reproduce a
+    single-device run with the same microbatching — the family x axis
+    combination that was a guarded hole before round 5."""
+    from quintnet_tpu.models.vit import ViTConfig, vit_init, vit_model_spec
+
+    vcfg = ViTConfig(image_size=14, patch_size=7, in_channels=1,
+                     hidden_dim=16, depth=4, num_heads=2, num_classes=10,
+                     n_experts=4, expert_top_k=2, expert_capacity=4096,
+                     aux_loss_weight=1e-2)
+    model = vit_model_spec(vcfg)
+    params = vit_init(jax.random.key(0), vcfg)
+    x = jnp.asarray(rng.normal(size=(8, 14, 14, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (8,)), jnp.int32)
+    opt = optax.sgd(0.05)
+
+    # single-device reference with the SAME microbatching (aux stats are
+    # per-microbatch; the f*P term is nonlinear in the batch split)
+    def loss_ref(p):
+        parts = [model.loss_fn(p, (x[i * 4:(i + 1) * 4],
+                                   y[i * 4:(i + 1) * 4]))
+                 for i in range(2)]
+        return jnp.mean(jnp.stack(parts))
+
+    ref_loss, g = jax.value_and_grad(loss_ref)(params)
+    up, _ = opt.update(g, opt.init(params), params)
+    p_ref = optax.apply_updates(params, up)
+
+    cfg = _config([2], ["pp"], schedule=schedule, grad_acc=2)
+    strat = get_strategy("pp", cfg)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch((x, y), model)
+    step = strat.make_train_step(model, opt)
+    p, s, loss = step(p, s, b)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    _assert_trees_close(p, p_ref)
+
+
 def test_vit_moe_expert_choice_trains_and_shards(rng):
     """ViT-MoE with EXPERT-CHOICE routing (legal: non-causal encoder) —
     dp x ep strategy loss == single device, and training reduces it."""
