@@ -37,6 +37,89 @@ COMMITTED_BASELINES = {
     "gpt2_124m_seq512_train_samples_per_sec_per_chip": 181.3,
 }
 
+HEADLINE_METRIC = "gpt2_124m_seq512_train_samples_per_sec_per_chip"
+
+
+def last_known_result(art_dir=None, metric=HEADLINE_METRIC):
+    """Most recent committed measurement of ``metric`` from
+    artifacts/*.json, clearly labelled stale.
+
+    Rounds 3/4 recorded NO number because the tunneled TPU was down at
+    the driver's capture time even though real measurements sat in
+    committed sweep artifacts. When the backend is unavailable the
+    diagnostic JSON now carries the latest such record under
+    ``last_known`` (``stale: true`` + its provenance) so a dead tunnel
+    can never zero out a round's perf evidence again.
+
+    Provenance timestamp: the artifact's last git commit date, falling
+    back to file mtime (dirty/untracked trees).
+    """
+    import glob
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    art_dir = art_dir or os.path.join(repo, "artifacts")
+    best = None
+    for path in sorted(glob.glob(os.path.join(art_dir, "*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        records = data if isinstance(data, list) else [data]
+        hits = [r for r in records if isinstance(r, dict)
+                and r.get("metric") == metric
+                and r.get("rc", 0) == 0 and r.get("value", 0) > 0]
+        if not hits:
+            continue
+        try:
+            out = subprocess.run(
+                ["git", "log", "-1", "--format=%cI", "--", path],
+                capture_output=True, text=True, cwd=repo, timeout=10)
+            as_of = out.stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            as_of = ""
+        if not as_of:
+            import datetime
+
+            as_of = datetime.datetime.fromtimestamp(
+                os.path.getmtime(path)).isoformat()
+        for r in hits:
+            # prefer newest artifact, then records measured under the
+            # committed-baseline config (extras.baseline set), then rate
+            default_cfg = (r.get("extras") or {}).get("baseline") is not None
+            key = (as_of, default_cfg, r.get("value", 0.0))
+            if best is None or key > best[0]:
+                best = (key, {
+                    "stale": True,
+                    "as_of": as_of,
+                    "source": os.path.relpath(path, repo),
+                    "metric": r["metric"],
+                    "value": r["value"],
+                    "unit": r.get("unit", "samples/s/chip"),
+                    "vs_baseline": r.get("vs_baseline"),
+                    "mfu": (r.get("extras") or {}).get("mfu"),
+                })
+    return best[1] if best else None
+
+
+def _unavailable_json(error_detail, retries=None):
+    out = {
+        "metric": "backend_unavailable",
+        "value": 0.0,
+        "unit": "none",
+        "vs_baseline": 0.0,
+        "error": "tpu_unavailable",
+        "error_detail": str(error_detail)[:500],
+    }
+    if retries is not None:
+        out["retries"] = retries
+    last = last_known_result()
+    if last is not None:
+        out["last_known"] = last
+    return out
+
 
 def init_backend_with_retry(retries: int = 5, backoff_s: float = 10.0,
                             attempt_timeout_s: float = 120.0):
@@ -99,15 +182,7 @@ def init_backend_with_retry(retries: int = 5, backoff_s: float = 10.0,
             # clear so the next attempt actually retries.
             jax.extend.backend.clear_backends()
             time.sleep(backoff_s * (attempt + 1))
-    print(json.dumps({
-        "metric": "backend_unavailable",
-        "value": 0.0,
-        "unit": "none",
-        "vs_baseline": 0.0,
-        "error": "tpu_unavailable",
-        "error_detail": str(last_err)[:500],
-        "retries": retries,
-    }))
+    print(json.dumps(_unavailable_json(last_err, retries=retries)))
     sys.exit(0)
 
 
@@ -420,12 +495,17 @@ if __name__ == "__main__":
         msg = str(e)
         unavailable = ("UNAVAILABLE" in msg or "Unable to initialize"
                        in msg or "failed to connect" in msg.lower())
-        print(json.dumps({
+        out = {
             "metric": "backend_failed_midrun",
             "value": 0.0,
             "unit": "none",
             "vs_baseline": 0.0,
             "error": "tpu_unavailable" if unavailable else "runtime_error",
             "error_detail": msg[:500],
-        }))
+        }
+        if unavailable:
+            last = last_known_result()
+            if last is not None:
+                out["last_known"] = last
+        print(json.dumps(out))
         sys.exit(0 if unavailable else 1)

@@ -1,0 +1,69 @@
+"""bench.py must never leave a round's official record number-free:
+when the TPU backend is down, the diagnostic JSON embeds the most
+recent committed measurement, clearly labelled stale (VERDICT r4 #8).
+
+These tests exercise the artifact-scanning logic directly (no backend
+needed) — the repo's own committed artifacts are the fixture.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import bench  # noqa: E402
+
+
+@pytest.mark.fast
+def test_last_known_from_committed_artifacts():
+    """The committed round-4 sweep contains a real headline number; the
+    scanner must surface it with provenance."""
+    last = bench.last_known_result()
+    assert last is not None
+    assert last["stale"] is True
+    assert last["metric"] == bench.HEADLINE_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]  # commit date or mtime, never empty
+
+
+@pytest.mark.fast
+def test_last_known_prefers_default_config_record(tmp_path):
+    """Among same-age records, the one measured under the committed
+    baseline config (extras.baseline set) wins, not the fastest."""
+    recs = [
+        {"metric": bench.HEADLINE_METRIC, "value": 250.0, "rc": 0,
+         "unit": "samples/s/chip", "vs_baseline": 1.0,
+         "extras": {"baseline": None, "batch_per_chip": 32}},
+        {"metric": bench.HEADLINE_METRIC, "value": 188.0, "rc": 0,
+         "unit": "samples/s/chip", "vs_baseline": 1.037,
+         "extras": {"baseline": 181.3, "batch_per_chip": 8, "mfu": 0.36}},
+    ]
+    (tmp_path / "sweep.json").write_text(json.dumps(recs))
+    last = bench.last_known_result(art_dir=str(tmp_path))
+    assert last["value"] == 188.0
+    assert last["mfu"] == 0.36
+
+
+@pytest.mark.fast
+def test_last_known_skips_failed_records(tmp_path):
+    recs = [
+        {"metric": "backend_unavailable", "value": 0.0, "rc": 0},
+        {"metric": bench.HEADLINE_METRIC, "value": 100.0, "rc": 1},
+    ]
+    (tmp_path / "bad.json").write_text(json.dumps(recs))
+    (tmp_path / "junk.json").write_text("not json{")
+    assert bench.last_known_result(art_dir=str(tmp_path)) is None
+
+
+@pytest.mark.fast
+def test_unavailable_json_embeds_last_known():
+    out = bench._unavailable_json("tunnel hang", retries=5)
+    assert out["metric"] == "backend_unavailable"
+    assert out["error"] == "tpu_unavailable"
+    assert out["retries"] == 5
+    assert out["last_known"]["stale"] is True
+    assert out["last_known"]["value"] > 0
+    json.dumps(out)  # stays one well-formed JSON line
