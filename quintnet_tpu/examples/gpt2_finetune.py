@@ -130,6 +130,19 @@ def main():
     trainer = Trainer(cfg, model, strategy=strategy, task_type="clm",
                       checkpoint_dir=args.checkpoint_dir)
 
+    if args.checkpoint_dir and jax.process_index() == 0:
+        # record the model geometry next to the checkpoints so post-run
+        # tools (pod_run merge-test / export_gpt2) can rebuild the
+        # restore template without re-supplying flags
+        import dataclasses as _dc
+        import json as _json
+
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        with open(os.path.join(args.checkpoint_dir,
+                               "model_config.json"), "w") as f:
+            _json.dump({"family": "gpt2", "tp_layout": cfg.tp_size,
+                        **_dc.asdict(gcfg)}, f, indent=1)
+
     if args.checkpoint:
         host_params, _ = load_hf_gpt2(args.checkpoint, gcfg)
         if gcfg.n_experts > 0:
