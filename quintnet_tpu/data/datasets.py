@@ -275,13 +275,28 @@ def pack_documents(docs: Sequence[Sequence[int]], seq_len: int,
     return np.asarray(flat[: n * seq_len], np.int32).reshape(n, seq_len)
 
 
+def segments_from_tokens(rows: np.ndarray, eos_id: int) -> np.ndarray:
+    """Packed rows [N, S] -> per-position document ids [N, S] int32 for
+    attention segment masking (ops/flash_attention.flash_attention
+    ``segment_ids``): each EOS separator closes its document, so the id
+    increments AFTER every eos. Ids restart at 0 per row (attention
+    never crosses rows, so only within-row distinctness matters)."""
+    rows = np.asarray(rows)
+    ends = np.cumsum(rows == eos_id, axis=1)
+    seg = np.concatenate([np.zeros_like(ends[:, :1]), ends[:, :-1]], axis=1)
+    return seg.astype(np.int32)
+
+
 class PackedLMDataset:
     """Causal-LM dataset over packed rows: labels ARE the inputs (the
     model's CLM loss does the shift; models/gpt2.py clm_loss), so there
     is no -100 masking and no padding — maximal tokens/step.
 
     Build from raw texts + any tokenizer with ``encode``/``eos_token_id``
-    (HF GPT2Tokenizer or the ByteTokenizer fallback)."""
+    (HF GPT2Tokenizer or the ByteTokenizer fallback). Cross-document
+    attention is the default (GPT-2 convention); pass the rows through
+    :func:`segments_from_tokens` and hand the result to the attention
+    stack for strict document isolation."""
 
     def __init__(self, rows: np.ndarray):
         assert rows.ndim == 2, rows.shape

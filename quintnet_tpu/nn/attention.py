@@ -70,13 +70,14 @@ def repeat_kv(x, n_rep: int):
 
 
 def sdpa(q, k, v, *, causal: bool, softmax_dtype=jnp.float32,
-         pdrop: float = 0.0, key=None):
+         pdrop: float = 0.0, key=None, segment_ids=None):
     """Plain scaled-dot-product attention: [B, H, S, Dh] -> [B, H, S, Dh].
 
     Matches the reference's F.scaled_dot_product_attention call
     (gpt2_attention.py:156-161), including its ``dropout_p`` on the
     attention probabilities when ``key`` is given. Softmax in f32
-    regardless of input dtype.
+    regardless of input dtype. ``segment_ids`` [B, S]: cross-segment
+    pairs are masked (packed-document isolation).
     """
     dh = q.shape[-1]
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(softmax_dtype)
@@ -85,6 +86,10 @@ def sdpa(q, k, v, *, causal: bool, softmax_dtype=jnp.float32,
         s, t = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((s, t), dtype=bool))
         scores = jnp.where(mask, scores, jnp.finfo(softmax_dtype).min)
+    if segment_ids is not None:
+        same = (segment_ids[:, None, :, None]
+                == segment_ids[:, None, None, :])  # [B, 1, S, S]
+        scores = jnp.where(same, scores, jnp.finfo(softmax_dtype).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     if key is not None and pdrop > 0.0:
         from quintnet_tpu.nn.layers import dropout
@@ -107,6 +112,7 @@ def mha_apply(
     attn_pdrop: float = 0.0,
     resid_pdrop: float = 0.0,
     key=None,
+    segment_ids=None,
 ):
     """x: [B, S_local, D] -> [B, S_local, D].
 
@@ -131,7 +137,17 @@ def mha_apply(
     so the mask agrees across tp ranks (gpt2_attention.py:156-180).
     Under tp the SAME prob-dropout mask pattern is reused on each rank's
     head block — head-group correlation, accepted for mask/key locality.
+
+    ``segment_ids`` [B, S_local]: packed-document isolation masking,
+    supported on the local paths (sdpa + flash incl. the Pallas
+    kernel); the sequence-parallel modes shard S and would need the
+    GLOBAL id vector per chunk pair — unsupported, explicit error.
     """
+    if segment_ids is not None and sp_axis is not None:
+        raise NotImplementedError(
+            "segment_ids under sequence parallelism is not wired "
+            "(ring/zigzag/ulysses would need global segment exchange); "
+            "pack without sp or drop segment isolation")
     k_attn = k_resid = None
     if key is not None:
         k_attn, k_resid = jax.random.split(key)
@@ -164,9 +180,11 @@ def mha_apply(
     elif use_flash:
         from quintnet_tpu.ops.flash_attention import flash_attention
 
-        o = flash_attention(q, k, v, causal=causal, **drop_kw)
+        o = flash_attention(q, k, v, causal=causal,
+                            segment_ids=segment_ids, **drop_kw)
     else:
-        o = sdpa(q, k, v, causal=causal, pdrop=attn_pdrop, key=k_attn)
+        o = sdpa(q, k, v, causal=causal, pdrop=attn_pdrop, key=k_attn,
+                 segment_ids=segment_ids)
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])

@@ -70,8 +70,34 @@ def _causal_mask(s, qi, ki, block_q: int, block_k: int):
     return jnp.where(cols <= rows, s, NEG_INF)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, block_q: int, block_k: int):
+def _segment_mask(s, sq_ref, sk_ref):
+    """Mask score entries whose q and k positions belong to different
+    packed segments (segment refs carried as [1, blk, 1] int32 — the
+    same trailing-unit-dim trick the lse output uses)."""
+    sq = sq_ref[0][:, 0]                           # [bq]
+    sk = sk_ref[0][:, 0]                           # [bk]
+    return jnp.where(sq[:, None] == sk[None, :], s, NEG_INF)
+
+
+def _segment_overlap(sq_ref, sk_ref):
+    """False when the q and k tiles cannot share any segment id (their
+    id RANGES are disjoint — exact for any ids, and for the monotone
+    packed-document layout it prunes every fully-cross-document tile).
+    Combined into the pl.when liveness so pruned tiles skip all three
+    MXU matmuls, the same treatment the causal grid pruning gets."""
+    sq = sq_ref[0][:, 0]
+    sk = sk_ref[0][:, 0]
+    return ((jnp.max(sk) >= jnp.min(sq))
+            & (jnp.min(sk) <= jnp.max(sq)))
+
+
+def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -83,6 +109,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     live = _block_live(qi, ki, block_q, block_k) if causal else ki >= 0
+    if has_seg:
+        live = live & _segment_overlap(sq_ref, sk_ref)
 
     @pl.when(live)
     def _accumulate():
@@ -104,14 +132,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 _block_needs_mask(qi, ki, block_q, block_k),
                 lambda t: _causal_mask(t, qi, ki, block_q, block_k),
                 lambda t: t, s)
+        if has_seg:
+            s = _segment_mask(s, sq_ref, sk_ref)
 
         m_prev = m_scr[:, :1]                      # [bq, 1]
         l_prev = l_scr[:, :1]                      # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # NEG_INF -> 0
+        if has_seg:
+            # a live tile can be FULLY segment-masked (k tile from a
+            # different packed document): m_new stays NEG_INF there and
+            # exp(s - m_new) would be exp(0) = 1 for every masked entry.
+            # Guard the exponent base; m_scr still records the true max
+            # (the recurrence and the final lse are unchanged for rows
+            # that ever see a valid entry — and every row sees at least
+            # its own diagonal position).
+            m_exp = jnp.where(m_new > 0.5 * NEG_INF, m_new, 0.0)
+        else:
+            m_exp = m_new
+        p = jnp.exp(s - m_exp)                     # NEG_INF -> 0
         l_cur = jnp.sum(p, axis=1, keepdims=True)
-        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.exp(m_prev - m_exp)
         l_new = l_prev * corr + l_cur
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -128,29 +169,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+def _seg3(segments, b, s):
+    """[B, S] int32 segment ids -> [B, S, 1] (the block-legal layout)."""
+    return segments.astype(jnp.int32).reshape(b, s, 1)
+
+
+def _flash_fwd(q, k, v, segments, causal: bool, block_q: int, block_k: int,
                interpret: bool):
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     bq = min(block_q, s)
     bk = min(block_k, s)
     assert s % bq == 0 and s % bk == 0, (s, bq, bk)
+    has_seg = segments is not None
 
     qr = q.reshape(b * h, s, d)
     kr = k.reshape(b * h, s, d)
     vr = v.reshape(b * h, s, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk)
+                               block_q=bq, block_k=bk, has_seg=has_seg)
     grid = (b * h, s // bq, s // bk)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    inputs = [qr, kr, vr]
+    if has_seg:
+        # segments are per-BATCH (shared by heads): index_map divides
+        # the flattened batch*head grid coordinate back down
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh // h, qi, 0)),
+            pl.BlockSpec((1, bk, 1), lambda bh, qi, ki: (bh // h, ki, 0)),
+        ]
+        seg = _seg3(segments, b, s)
+        inputs += [seg, seg]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
@@ -165,14 +223,18 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((bq, d), jnp.float32),
         ] if _HAVE_PLTPU else None,
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*inputs)
     return out.reshape(b, h, s, d), lse.reshape(b, h, s, 1)
 
 
-def _bwd_block(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
+def _bwd_block(q, k, v, do, lse, delta, qi, ki, seg_refs, *, scale, causal,
                block_q, block_k):
     """Shared per-tile backward math -> (p, ds), both [bq, bk] f32.
-    Matmul inputs stay in their native dtype (bf16 MXU when bf16 in)."""
+    Matmul inputs stay in their native dtype (bf16 MXU when bf16 in).
+    ``seg_refs``: (sq_ref, sk_ref) or None; masked entries have
+    s = NEG_INF so p = exp(s - lse) = 0 and ds = 0 — no extra guard
+    needed (lse is finite for every row: the diagonal is always
+    same-segment)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -181,6 +243,8 @@ def _bwd_block(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
             _block_needs_mask(qi, ki, block_q, block_k),
             lambda t: _causal_mask(t, qi, ki, block_q, block_k),
             lambda t: t, s)
+    if seg_refs is not None:
+        s = _segment_mask(s, *seg_refs)
     p = jnp.exp(s - lse)                          # [bq, bk]; masked -> 0
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -189,9 +253,16 @@ def _bwd_block(q, k, v, do, lse, delta, qi, ki, *, scale, causal,
     return p, ds
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale: float, causal: bool, block_q: int, block_k: int):
+def _bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
+                    block_k: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        seg_refs = (sq_ref, sk_ref)
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        seg_refs = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -202,6 +273,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     live = _block_live(qi, ki, block_q, block_k) if causal else qi >= 0
+    if has_seg:
+        live = live & _segment_overlap(sq_ref, sk_ref)
 
     @pl.when(live)
     def _accumulate():
@@ -210,7 +283,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0]
         do = do_ref[0]
         p, ds = _bwd_block(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
-                           scale=scale, causal=causal,
+                           seg_refs, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -225,9 +298,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *,
-                   scale: float, causal: bool, block_q: int, block_k: int):
+def _bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
+                   block_k: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+         dq_ref, dq_scr) = refs
+        seg_refs = (sq_ref, sk_ref)
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        seg_refs = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -237,6 +317,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     live = _block_live(qi, ki, block_q, block_k) if causal else ki >= 0
+    if has_seg:
+        live = live & _segment_overlap(sq_ref, sk_ref)
 
     @pl.when(live)
     def _accumulate():
@@ -245,7 +327,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0]
         do = do_ref[0]
         _, ds = _bwd_block(q, k, v, do, lse_ref[0], delta_ref[0], qi, ki,
-                           scale=scale, causal=causal,
+                           seg_refs, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -256,12 +338,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal: bool, block_q: int,
+def _flash_bwd(q, k, v, segments, out, lse, do, causal: bool, block_q: int,
                block_k: int, interpret: bool):
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     bq = min(block_q, s)
     bk = min(block_k, s)
+    has_seg = segments is not None
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)       # [b, h, s, 1]
@@ -272,24 +355,34 @@ def _flash_bwd(q, k, v, out, lse, do, causal: bool, block_q: int,
     dor = do.reshape(b * h, s, d)
     lser = lse.reshape(b * h, s, 1)
     dr = delta.reshape(b * h, s, 1)
+    seg = _seg3(segments, b, s) if has_seg else None
 
     q_spec = pl.BlockSpec((1, bq, d), lambda bh, a, b_: (bh, a, 0))
     row_spec = pl.BlockSpec((1, bq, 1), lambda bh, a, b_: (bh, a, 0))
 
     # dK/dV: k blocks on grid dim 1, q innermost (dim 2)
     kv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, has_seg=has_seg)
+    kv_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # q
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # k
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # v
+        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # do
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # lse
+        pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # delta
+    ]
+    kv_inputs = [qr, kr, vr, dor, lser, dr]
+    if has_seg:
+        kv_in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh // h, qi, 0)),
+            pl.BlockSpec((1, bk, 1), lambda bh, ki, qi: (bh // h, ki, 0)),
+        ]
+        kv_inputs += [seg, seg]
     dk, dv = pl.pallas_call(
         kv_kernel,
         grid=(b * h, s // bk, s // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # q
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # k
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # v
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # do
-            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # lse
-            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # delta
-        ],
+        in_specs=kv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
@@ -303,58 +396,81 @@ def _flash_bwd(q, k, v, out, lse, do, causal: bool, block_q: int,
             pltpu.VMEM((bk, d), jnp.float32),
         ] if _HAVE_PLTPU else None,
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, dr)
+    )(*kv_inputs)
 
     # dQ: q blocks on grid dim 1, k innermost (dim 2)
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, has_seg=has_seg)
+    dq_in_specs = [
+        q_spec,
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        q_spec,
+        row_spec,
+        row_spec,
+    ]
+    dq_inputs = [qr, kr, vr, dor, lser, dr]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh // h, qi, 0)),
+            pl.BlockSpec((1, bk, 1), lambda bh, qi, ki: (bh // h, ki, 0)),
+        ]
+        dq_inputs += [seg, seg]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * h, s // bq, s // bk),
-        in_specs=[
-            q_spec,
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            q_spec,
-            row_spec,
-            row_spec,
-        ],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
         ] if _HAVE_PLTPU else None,
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, dr)
+    )(*dq_inputs)
 
     rs = lambda x: x.reshape(b, h, s, d)
     return rs(dq), rs(dk), rs(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _pallas_flash(q, k, v, segments, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, segments, causal, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, segments, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, segments, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, segments, out, lse)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, segments, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, segments, out, lse, g, causal,
+                            block_q, block_k, interpret)
+    return dq, dk, dv, None  # segment ids: integer input, no cotangent
+
+
+_pallas_flash.defvjp(_fa_fwd, _fa_bwd)
+
+
 def pallas_flash_attention(q, k, v, causal: bool = False,
                            block_q: int = 128, block_k: int = 128,
-                           interpret: bool = False):
+                           interpret: bool = False, segment_ids=None):
     """[B, H, S, D] fused attention via the Pallas TPU kernels (fwd and
     hand-tiled bwd).
+
+    ``segment_ids``: optional [B, S] int32 packed-document ids —
+    positions in different segments never attend to each other (the
+    masking runs INSIDE the kernel, so PackedLMDataset training keeps
+    the fused path; reference analogue: none — its sdpa call has no
+    packing support either, gpt2_attention.py:156-161).
 
     ``interpret=True`` runs the kernels in the Pallas interpreter (CPU
     testing). S must divide by the block sizes (the dispatcher in
     ops/flash_attention.py falls back to jnp otherwise).
     """
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
-
-
-def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
-                      interpret)
-
-
-pallas_flash_attention.defvjp(_fa_fwd, _fa_bwd)
+    return _pallas_flash(q, k, v, segment_ids, causal, block_q, block_k,
+                         interpret)

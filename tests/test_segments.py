@@ -1,0 +1,159 @@
+"""Packed-segment attention masking: sdpa, the blockwise jnp path and
+the Pallas kernels (interpret mode) must all agree with a brute-force
+masked softmax, forward AND backward — positions in different packed
+documents never attend to each other (round-4 verdict item 3: the
+kernel previously had no segment support at all).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quintnet_tpu.data.datasets import segments_from_tokens
+from quintnet_tpu.nn.attention import mha_apply, mha_init, sdpa
+from quintnet_tpu.ops.flash_attention import blockwise_attention
+from quintnet_tpu.ops.pallas_attention import pallas_flash_attention
+
+
+def _qkv(b=2, h=2, s=64, d=32, keyseed=0):
+    ks = jax.random.split(jax.random.key(keyseed), 3)
+    return tuple(jax.random.normal(k, (b, h, s, d)) for k in ks)
+
+
+def _segments(b=2, s=64, keyseed=3, n_docs=3):
+    """Random monotone segment ids (packed-document layout)."""
+    rng = np.random.default_rng(keyseed)
+    out = np.zeros((b, s), np.int32)
+    for i in range(b):
+        cuts = np.sort(rng.choice(np.arange(1, s), n_docs - 1,
+                                  replace=False))
+        out[i] = np.searchsorted(cuts, np.arange(s), side="right")
+    return jnp.asarray(out)
+
+
+def _brute(q, k, v, seg, causal):
+    """Dense masked softmax oracle."""
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(q.shape[-1])
+    mask = (seg[:, None, :, None] == seg[:, None, None, :])
+    if causal:
+        s = q.shape[2]
+        mask = mask & jnp.tril(jnp.ones((s, s), bool))[None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    return jnp.einsum("bhst,bhtd->bhsd",
+                      jax.nn.softmax(scores, axis=-1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sdpa_segments(causal):
+    q, k, v = _qkv()
+    seg = _segments()
+    ref = _brute(q, k, v, seg, causal)
+    out = sdpa(q, k, v, causal=causal, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_segments(causal):
+    """Segment boundaries intentionally misaligned with the 16-wide
+    blocks: interior tiles, crossing tiles and fully-masked tiles all
+    occur."""
+    q, k, v = _qkv()
+    seg = _segments()
+    ref = _brute(q, k, v, seg, causal)
+    out = blockwise_attention(q, k, v, causal=causal, block_q=16,
+                              block_k=16, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_blockwise_segments_ragged():
+    q, k, v = _qkv(s=50)
+    seg = _segments(s=50)
+    ref = _brute(q, k, v, seg, True)
+    out = blockwise_attention(q, k, v, causal=True, block_q=16,
+                              block_k=16, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_kernel_segments_fwd(causal):
+    """In-kernel segment masking (interpret mode), including tiles that
+    are FULLY segment-masked (the exp-guard path)."""
+    q, k, v = _qkv(s=128, d=64)
+    seg = _segments(s=128)
+    ref = _brute(q, k, v, seg, causal)
+    out = pallas_flash_attention(q, k, v, causal, 32, 32, True,
+                                 segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_kernel_segments_grads(causal):
+    q, k, v = _qkv(s=64, d=32)
+    seg = _segments()
+    w = jax.random.normal(jax.random.key(9), q.shape)
+
+    def ref_loss(q_, k_, v_):
+        return jnp.sum(_brute(q_, k_, v_, seg, causal) * w)
+
+    def fa_loss(q_, k_, v_):
+        return jnp.sum(pallas_flash_attention(
+            q_, k_, v_, causal, 32, 32, True, segment_ids=seg) * w)
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_fa = jax.grad(fa_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fa, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_segments_from_tokens():
+    eos = 9
+    rows = np.asarray([[1, 2, eos, 3, 4, 5, eos, 6],
+                       [eos, 1, 2, 3, eos, eos, 4, 5]])
+    seg = segments_from_tokens(rows, eos)
+    np.testing.assert_array_equal(
+        seg, [[0, 0, 0, 1, 1, 1, 1, 2],
+              [0, 1, 1, 1, 1, 2, 3, 3]])
+
+
+def test_mha_apply_segments_match_manual():
+    """Threading through the attention module: mha_apply(segment_ids=)
+    equals running each document separately."""
+    d, h, s = 32, 4, 24
+    p = mha_init(jax.random.key(0), d)
+    x = jax.random.normal(jax.random.key(1), (1, s, d))
+    cut = 10
+    seg = jnp.asarray([[0] * cut + [1] * (s - cut)])
+
+    out = mha_apply(p, x, num_heads=h, causal=True, segment_ids=seg)
+    out_a = mha_apply(p, x[:, :cut], num_heads=h, causal=True)
+    out_b = mha_apply(p, x[:, cut:], num_heads=h, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :cut]),
+                               np.asarray(out_a), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out[:, cut:]),
+                               np.asarray(out_b), rtol=2e-4, atol=2e-5)
+
+
+def test_mha_apply_segments_under_sp_raises():
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.core import collectives as cc
+    from quintnet_tpu.core.mesh import mesh_from_sizes
+
+    d, h, s = 16, 2, 16
+    p = mha_init(jax.random.key(0), d)
+    x = jax.random.normal(jax.random.key(1), (2, s, d))
+    seg = jnp.zeros((2, s), jnp.int32)
+    mesh = mesh_from_sizes(sp=2)
+    f = cc.shard_map_fn(
+        lambda p_, x_, s_: mha_apply(p_, x_, num_heads=h, causal=True,
+                                     sp_axis="sp", segment_ids=s_),
+        mesh, in_specs=(None, P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"))
+    with pytest.raises(NotImplementedError, match="segment_ids"):
+        f(p, x, seg)
