@@ -62,6 +62,17 @@ def _setup(mode: str):
 
 
 def run_vit(mode: str) -> dict:
+    """mode: 'single' | '3d' | 'control'.
+
+    'control' is the chaos-sensitivity control for the late-epoch drift
+    seen between single and 3d (round-4 verdict: 10.1% at epoch 8,
+    "asserted, not demonstrated"): the SAME single-device program with a
+    one-off 1e-7 relative perturbation of the initial params — the
+    magnitude of a single step's float-reassociation noise between two
+    XLA programs. If single-vs-control drifts as much as single-vs-3d by
+    epochs 8-9, the 3d drift is demonstrated to be chaotic float
+    divergence, not a sharding bug; the report computes this band.
+    """
     _setup(mode)
     from quintnet_tpu.core.config import Config
     from quintnet_tpu.data import ArrayDataset, make_batches
@@ -95,9 +106,23 @@ def run_vit(mode: str) -> dict:
 
     trainer = Trainer(cfg, model, strategy=strategy,
                       task_type="classification")
+    params = opt_state = None
+    if mode == "control":
+        import jax
+        import jax.numpy as jnp
+
+        params, opt_state = trainer.init_state()
+        ks = iter(jax.random.split(jax.random.key(1234),
+                                   len(jax.tree.leaves(params))))
+        params = jax.tree.map(
+            lambda x: x * (1.0 + 1e-7 * jax.random.rademacher(
+                next(ks), x.shape).astype(x.dtype))
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params)
     hist = trainer.fit(
         lambda ep: make_batches(train, 64, seed=ep),
         val_batches_fn=lambda ep: make_batches(test, 64, shuffle=False),
+        params=params, opt_state=opt_state,
     )
     return {
         "task": "vit", "mode": mode, "mesh": dict(strategy.mesh.shape),
@@ -199,20 +224,36 @@ def report() -> str:
                       f"`python -m quintnet_tpu.tools.parity_run --task "
                       f"{task} --mode <stale mode>`.", ""]
             continue
+        # optional chaos-sensitivity control (see run_vit docstring)
+        ctl = None
+        ctl_path = os.path.join(ART_DIR, f"{task}_control.json")
+        if os.path.exists(ctl_path):
+            ctl = load(task, "control")
+            if (ctl.get("data_fp") != s.get("data_fp")
+                    or len(ctl.get("train_loss", []))
+                    != len(s["train_loss"])):
+                ctl = None  # stale control (different data OR epochs)
+        hdr_ctl = " ctl drift (1e-7 perturbation) |" if ctl else ""
         lines += [f"## {task.upper()} ({s['epochs']} epochs)", "",
                   f"| epoch | train loss (1 dev) | train loss (3D) | "
-                  f"rel diff | {metric_name} (1 dev) | {metric_name} (3D) |",
-                  "|---|---|---|---|---|---|"]
+                  f"rel diff |{hdr_ctl} {metric_name} (1 dev) | "
+                  f"{metric_name} (3D) |",
+                  "|---|---|---|---|---|---|" + ("---|" if ctl else "")]
         max_rel = 0.0
-        rels = []
+        rels, ctl_rels = [], []
         for e in range(s["epochs"]):
             a, b = s["train_loss"][e], d["train_loss"][e]
             rel = abs(a - b) / max(abs(a), 1e-9)
             rels.append(rel)
             max_rel = max(max_rel, rel)
             ma, mb = s[metric_key][e], d[metric_key][e]
-            lines.append(f"| {e} | {a:.4f} | {b:.4f} | {rel:.2%} | "
-                         f"{ma:.4f} | {mb:.4f} |")
+            ctl_cell = ""
+            if ctl:
+                cr = abs(a - ctl["train_loss"][e]) / max(abs(a), 1e-9)
+                ctl_rels.append(cr)
+                ctl_cell = f" {cr:.2%} |"
+            lines.append(f"| {e} | {a:.4f} | {b:.4f} | {rel:.2%} |"
+                         f"{ctl_cell} {ma:.4f} | {mb:.4f} |")
         # Verdict. Exact trajectory identity across the whole run is the
         # strong bar, but the sharded step is a DIFFERENT float program
         # (XLA fuses/reassociates per sharding), so ~1e-7 per-step noise
@@ -228,12 +269,29 @@ def report() -> str:
             track += 1
         fa, fb = s[metric_key][-1], d[metric_key][-1]
         final_rel = abs(fa - fb) / max(abs(fa), 1e-9)
+        # When a control leg exists, the chaos claim is MEASURED: the 3d
+        # drift must sit within 2x the drift the same single-device
+        # program shows from a one-off 1e-7 init perturbation (the
+        # magnitude of per-step float reassociation between two XLA
+        # programs). Without a control the 1%-tracking fallback applies.
+        band_ok = None
+        if ctl_rels:
+            band = max(max(ctl_rels), 1e-4)
+            band_ok = max_rel <= 2.0 * band
         if max_rel < 0.01:
             verdict = "PASS (exact trajectory)"
-        elif track * 2 >= s["epochs"] and final_rel < 0.02:
+        elif band_ok and final_rel < 0.02:
+            verdict = (f"PASS (3d drift {max_rel:.2%} is within the "
+                       f"measured chaos band: the SAME single-device "
+                       f"program drifts {max(ctl_rels):.2%} from a 1e-7 "
+                       f"init perturbation; final {metric_name} within "
+                       f"{final_rel:.2%})")
+        elif band_ok is None and track * 2 >= s["epochs"] \
+                and final_rel < 0.02:
             verdict = (f"PASS (tracks {track}/{s['epochs']} epochs within "
                        f"1%, final {metric_name} within {final_rel:.2%};"
-                       f" late drift is chaotic float divergence)")
+                       f" late drift is chaotic float divergence — "
+                       f"run --mode control to demonstrate)")
         else:
             verdict = "FAIL"
         lines += ["", f"Max relative train-loss difference: "
@@ -246,7 +304,7 @@ def report() -> str:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--task", choices=["vit", "gpt2"])
-    ap.add_argument("--mode", choices=["single", "3d"])
+    ap.add_argument("--mode", choices=["single", "3d", "control"])
     ap.add_argument("--report", action="store_true")
     args = ap.parse_args()
 
@@ -258,6 +316,11 @@ def main():
         return
 
     os.makedirs(ART_DIR, exist_ok=True)
+    if args.task == "gpt2" and args.mode == "control":
+        ap.error("--mode control is implemented for --task vit only "
+                 "(gpt2 parity is an exact trajectory, PARITY.md — no "
+                 "chaos band needed); run_gpt2 would silently produce "
+                 "an unperturbed leg")
     res = run_vit(args.mode) if args.task == "vit" else run_gpt2(args.mode)
     out = os.path.join(ART_DIR, f"{args.task}_{args.mode}.json")
     with open(out, "w") as f:
