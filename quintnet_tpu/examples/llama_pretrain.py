@@ -30,6 +30,12 @@ def main():
     ap.add_argument("--experts", type=int, default=0,
                     help="n_experts: Mixtral-style SwiGLU-MoE blocks "
                          "(add an 'ep' axis to --mesh to shard them)")
+    ap.add_argument("--isolate-docs", action="store_true",
+                    help="mask cross-document attention in the packed "
+                         "rows (segment ids derived from the EOS "
+                         "separator; default: GPT-2-style cross-doc "
+                         "attention). Not compatible with an sp mesh "
+                         "axis.")
     args = ap.parse_args()
 
     from quintnet_tpu.examples.common import setup_platform
@@ -68,10 +74,13 @@ def main():
     })
     # vocab 257+pad to 264 covers the byte tokenizer; n_kv < n_heads
     # exercises GQA under whatever mesh was picked
+    tok_eos = 256  # ByteTokenizer.eos_token_id
     lcfg = LlamaConfig.tiny(vocab_size=264, n_positions=args.seq,
                             dim=64, n_layers=4, n_heads=4, n_kv_heads=2,
                             intermediate_size=128,
-                            n_experts=args.experts)
+                            n_experts=args.experts,
+                            segment_eos_id=(tok_eos if args.isolate_docs
+                                            else None))
     model = llama_model_spec(lcfg, sp_mode="zigzag")
     strat = get_strategy("auto", cfg)
     print(f"mesh={dict(strat.mesh.shape)} llama dim={lcfg.dim} "

@@ -97,6 +97,13 @@ class GPT2Config:
     # under sp (clm_loss_sp) / vocab_parallel (clm_loss_vp), which
     # already avoid full logits their own way.
     loss_chunk: int = 0
+    # --- packed-document isolation: when set, attention segment ids are
+    # derived on the fly from input_ids (a new segment starts AFTER each
+    # occurrence of this token) and threaded into every attention layer
+    # incl. the Pallas flash kernel (ops/flash_attention segment_ids) —
+    # positions never attend across packed-document boundaries. None =
+    # the GPT-2 convention (cross-document attention accepted).
+    segment_eos_id: Optional[int] = None
     # --- lax.scan unroll factor for the layer stack (>1 lets XLA
     # software-pipeline adjacent layers; measured knob, see
     # artifacts/remat_unroll_r04.json)
@@ -268,7 +275,8 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
                 ep_axis: Optional[str] = None,
-                remat: "bool | str" = False, use_flash: bool = False, key=None):
+                remat: "bool | str" = False, use_flash: bool = False,
+                key=None, segment_ids=None):
     """Returns ``h`` for dense configs, ``(h, moe_aux)`` when
     ``cfg.n_experts > 0``. ``key`` enables training dropout."""
     tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
@@ -289,6 +297,7 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
         resid_pdrop=resid_p,
         key=key,
         scan_unroll=cfg.scan_unroll,
+        segment_ids=segment_ids,
     )
 
 
@@ -335,9 +344,11 @@ def gpt2_hidden(params, input_ids, cfg: GPT2Config, *,
     vp_axis = tp_axis if (cfg.vocab_parallel and tp_axis) else None
     h = gpt2_embed(params, input_ids, sp_axis=sp_axis,
                    embd_pdrop=cfg.pdrops[0], key=k_embd, vp_axis=vp_axis)
+    seg = segment_ids_from_input(input_ids, cfg)
     out = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
                       sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
-                      remat=remat, use_flash=use_flash, key=k_blocks)
+                      remat=remat, use_flash=use_flash, key=k_blocks,
+                      segment_ids=seg)
     return out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
 
 
@@ -586,6 +597,17 @@ def gpt2_from_tp_layout(params, cfg: GPT2Config, tp: int):
     return out
 
 
+def segment_ids_from_input(input_ids, cfg: GPT2Config):
+    """[B, S] token ids -> [B, S] int32 attention segment ids, or None
+    when ``cfg.segment_eos_id`` is unset. Device-side equivalent of
+    data/datasets.segments_from_tokens: exclusive running count of the
+    separator (each EOS closes its own document)."""
+    if cfg.segment_eos_id is None:
+        return None
+    is_eos = (input_ids == cfg.segment_eos_id).astype(jnp.int32)
+    return jnp.cumsum(is_eos, axis=1) - is_eos
+
+
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None, sp_mode: str = "ring",
                       ep_axis: Optional[str] = None,
@@ -604,6 +626,12 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
     pass per-(microbatch, stage) keys (parallel/pp.py) so the 1F1B
     vjp-recompute reproduces the forward masks exactly.
     """
+    if cfg.segment_eos_id is not None:
+        raise NotImplementedError(
+            "segment_eos_id under pipeline parallelism is not wired "
+            "(stage fns receive hidden states, not token ids, so the "
+            "segment vector cannot be derived mid-pipeline); use "
+            "dp/tp/ep meshes for packed-document isolation")
 
     def embed_fn(params, input_ids, key=None):
         return gpt2_embed(_cast_tree(params, compute_dtype), input_ids,

@@ -65,6 +65,11 @@ class LlamaConfig:
     expert_capacity: Optional[int] = None
     aux_loss_weight: float = 1e-2
     router_type: str = "topk"  # or "expert_choice" (nn/moe.py)
+    # packed-document isolation: derive attention segment ids from
+    # input_ids (new segment after each occurrence of this token) and
+    # mask cross-document attention — models/gpt2.py segment_ids_from_input
+    # semantics. None = cross-document attention (pretraining default).
+    segment_eos_id: Optional[int] = None
     # llama3-style rope scaling (None = unscaled). Tuple (hashable — the
     # config is a jit static arg): (factor, low_freq_factor,
     # high_freq_factor, original_max_position). HF applies this when
@@ -337,7 +342,7 @@ def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
                       tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None, sp_mode: str = "ring",
                       use_flash: bool = False, ep_axis: Optional[str] = None,
-                      key=None):
+                      key=None, segment_ids=None):
     """Returns ``x`` for dense configs, ``(x, aux)`` for MoE (the
     stacked-scan runner's moe path accumulates aux per layer)."""
     del key  # llama has no dropout
@@ -348,6 +353,11 @@ def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
     k, v = repeat_kv(k, rep), repeat_kv(v, rep)
 
     if sp_axis is not None:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "segment_eos_id under sequence parallelism is not wired "
+                "(ring/zigzag/ulysses would need global segment "
+                "exchange); pack without sp or drop segment isolation")
         from quintnet_tpu.ops.ring_attention import (ring_attention,
                                                      zigzag_ring_attention)
         from quintnet_tpu.ops.ulysses_attention import ulysses_attention
@@ -362,9 +372,9 @@ def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
     elif use_flash:
         from quintnet_tpu.ops.flash_attention import flash_attention
 
-        o = flash_attention(q, k, v, causal=True)
+        o = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
     else:
-        o = sdpa(q, k, v, causal=True)
+        o = sdpa(q, k, v, causal=True, segment_ids=segment_ids)
 
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
     x, aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis,
@@ -431,10 +441,14 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
     cos, sin = llama_rope_tables(_positions(b, s, sp_axis), cfg)
     import functools
 
+    seg = None
+    if cfg.segment_eos_id is not None:
+        is_eos = (input_ids == cfg.segment_eos_id).astype(jnp.int32)
+        seg = jnp.cumsum(is_eos, axis=1) - is_eos
     body = functools.partial(llama_block_apply, cfg=cfg, cos=cos, sin=sin,
                              tp_axis=tp_axis, sp_axis=sp_axis,
                              sp_mode=sp_mode, use_flash=use_flash,
-                             ep_axis=ep_axis)
+                             ep_axis=ep_axis, segment_ids=seg)
     out = stacked_blocks_apply(
         params["blocks"], h, num_heads=0, body_fn=body, remat=remat,
         moe_args=cfg.moe_args, sp_axis=sp_axis,
@@ -521,6 +535,11 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
         return clm_loss(logits, labels) + aux
 
     def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
+        if cfg.segment_eos_id is not None:
+            raise NotImplementedError(
+                "segment_eos_id under pipeline parallelism is not wired "
+                "(stage fns receive hidden states, not token ids); use "
+                "dp/tp/ep meshes for packed-document isolation")
 
         def embed_fn(params, input_ids, key=None):
             del key

@@ -63,6 +63,7 @@ def block_apply(
     attn_pdrop: float = 0.0,
     resid_pdrop: float = 0.0,
     key=None,
+    segment_ids=None,
 ):
     """Returns ``x`` for dense blocks, ``(x, aux_loss)`` when
     ``moe_args`` is given (the MoE load-balance term, device-local).
@@ -84,6 +85,7 @@ def block_apply(
         attn_pdrop=attn_pdrop,
         resid_pdrop=resid_pdrop,
         key=k_attn,
+        segment_ids=segment_ids,
     )
     h = layer_norm_apply(p["ln2"], x)
     if moe_args is not None:
@@ -121,6 +123,7 @@ def stacked_blocks_apply(
     key=None,
     scan_unroll: int = 1,
     body_fn: Optional[Callable] = None,
+    segment_ids=None,
 ):
     """Run a [depth, ...]-stacked block pytree with lax.scan.
 
@@ -162,6 +165,7 @@ def stacked_blocks_apply(
         ep_axis=ep_axis,
         attn_pdrop=attn_pdrop,
         resid_pdrop=resid_pdrop,
+        segment_ids=segment_ids,
     )
     if remat == "dots":
         body = jax.checkpoint(
