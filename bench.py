@@ -300,6 +300,14 @@ def main():
                          "only elementwise work (jax dots_saveable)")
     ap.add_argument("--scan-unroll", type=int, default=1,
                     help="lax.scan unroll factor over the layer stack")
+    ap.add_argument("--mu-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="Adam first-moment dtype: bfloat16 halves the "
+                         "m read+write HBM traffic in the optimizer "
+                         "tail (the trace-measured ~4.5 ms batch-"
+                         "independent span, docs/PERF_r04.md); nu "
+                         "stays f32 (second moments span too many "
+                         "decades). Mirrors training.adam_mu_dtype.")
     ap.add_argument("--vocab-parallel", action="store_true",
                     help="shard wte + sharded-CE over tp (multi-chip)")
     ap.add_argument("--loss-chunk", type=int, default=0,
@@ -427,7 +435,9 @@ def main():
                           * args.batch * n_dev)
         metric = "vit_mnist_train_samples_per_sec_per_chip"
 
-    opt = optax.adamw(1e-4)
+    opt = optax.adamw(1e-4, mu_dtype=(jnp.bfloat16
+                                      if args.mu_dtype == "bfloat16"
+                                      else None))
     params = strat.shard_params(model, model.init(jax.random.key(0)))
     opt_state = strat.init_opt_state(model, opt, params)
     b = strat.shard_batch(batch, model)
@@ -477,6 +487,7 @@ def main():
             "remat": bool(args.remat),
             "remat_policy": args.remat_policy,
             "scan_unroll": args.scan_unroll,
+            "mu_dtype": args.mu_dtype,
             "mfu": round(mfu, 4),
             "loss": loss_val,
             "baseline": baseline,

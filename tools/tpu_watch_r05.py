@@ -36,11 +36,26 @@ PLAN = [
         ["--remat-policy", "dots", "--scan-unroll", "2"],
         ["--scan-unroll", "2"],
         ["--scan-unroll", "3"],
+        ["--mu-dtype", "bfloat16"],
+        ["--mu-dtype", "bfloat16", "--remat-policy", "dots"],
         [],  # default re-measured in the same session for a fair A/B
     ]),
     ("flash_r05.json", [
+        # crossover hunt at the flagship's training seqs: single-k-pass
+        # geometries (bk == s kills the online-softmax correction steps;
+        # scores tile [bq, s] f32 still fits VMEM at these sizes)
         ["--model", "flash-attn", "--seq", "1024", "--steps", "30"],
+        ["--model", "flash-attn", "--seq", "1024", "--steps", "30",
+         "--block-q", "512", "--block-k", "1024"],
+        ["--model", "flash-attn", "--seq", "1024", "--steps", "30",
+         "--block-q", "1024", "--block-k", "1024"],
+        ["--model", "flash-attn", "--seq", "1024", "--steps", "30",
+         "--block-q", "256", "--block-k", "1024"],
         ["--model", "flash-attn", "--seq", "2048", "--steps", "30"],
+        ["--model", "flash-attn", "--seq", "2048", "--steps", "30",
+         "--block-q", "512", "--block-k", "2048"],
+        ["--model", "flash-attn", "--seq", "2048", "--steps", "30",
+         "--block-q", "1024", "--block-k", "2048"],
         ["--model", "flash-attn", "--seq", "4096", "--steps", "30"],
         ["--model", "flash-attn", "--seq", "8192", "--steps", "30"],
     ]),
