@@ -344,7 +344,7 @@ def gpt2_hidden(params, input_ids, cfg: GPT2Config, *,
     vp_axis = tp_axis if (cfg.vocab_parallel and tp_axis) else None
     h = gpt2_embed(params, input_ids, sp_axis=sp_axis,
                    embd_pdrop=cfg.pdrops[0], key=k_embd, vp_axis=vp_axis)
-    seg = segment_ids_from_input(input_ids, cfg)
+    seg = segment_ids_from_input(input_ids, cfg, sp_axis=sp_axis)
     out = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
                       sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
                       remat=remat, use_flash=use_flash, key=k_blocks,
@@ -597,15 +597,31 @@ def gpt2_from_tp_layout(params, cfg: GPT2Config, tp: int):
     return out
 
 
-def segment_ids_from_input(input_ids, cfg: GPT2Config):
+def segment_ids_from_input(input_ids, cfg: GPT2Config, *,
+                           sp_axis: Optional[str] = None):
     """[B, S] token ids -> [B, S] int32 attention segment ids, or None
     when ``cfg.segment_eos_id`` is unset. Device-side equivalent of
     data/datasets.segments_from_tokens: exclusive running count of the
-    separator (each EOS closes its own document)."""
+    separator (each EOS closes its own document).
+
+    ``sp_axis``: the sequence dim is a SHARD of the global sequence —
+    the local count is offset by the total separator count of all
+    earlier shards (one tiny [sp, B] all-gather), so ids are globally
+    consistent and the sp attention modes can compare them across
+    chunks."""
     if cfg.segment_eos_id is None:
         return None
     is_eos = (input_ids == cfg.segment_eos_id).astype(jnp.int32)
-    return jnp.cumsum(is_eos, axis=1) - is_eos
+    seg = jnp.cumsum(is_eos, axis=1) - is_eos
+    if sp_axis is not None:
+        sp = jax.lax.axis_size(sp_axis)
+        idx = jax.lax.axis_index(sp_axis)
+        counts = jax.lax.all_gather(jnp.sum(is_eos, axis=1),
+                                    sp_axis)               # [sp, B]
+        prefix = jnp.sum(
+            jnp.where(jnp.arange(sp)[:, None] < idx, counts, 0), axis=0)
+        seg = seg + prefix[:, None]
+    return seg
 
 
 def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,

@@ -353,22 +353,20 @@ def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
     k, v = repeat_kv(k, rep), repeat_kv(v, rep)
 
     if sp_axis is not None:
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "segment_eos_id under sequence parallelism is not wired "
-                "(ring/zigzag/ulysses would need global segment "
-                "exchange); pack without sp or drop segment isolation")
         from quintnet_tpu.ops.ring_attention import (ring_attention,
                                                      zigzag_ring_attention)
         from quintnet_tpu.ops.ulysses_attention import ulysses_attention
 
         if sp_mode == "ulysses":
             o = ulysses_attention(q, k, v, axis=sp_axis, causal=True,
-                                  use_flash=use_flash)
+                                  use_flash=use_flash,
+                                  segment_ids=segment_ids)
         elif sp_mode == "zigzag":
-            o = zigzag_ring_attention(q, k, v, axis=sp_axis, causal=True)
+            o = zigzag_ring_attention(q, k, v, axis=sp_axis, causal=True,
+                                      segment_ids=segment_ids)
         else:
-            o = ring_attention(q, k, v, axis=sp_axis, causal=True)
+            o = ring_attention(q, k, v, axis=sp_axis, causal=True,
+                               segment_ids=segment_ids)
     elif use_flash:
         from quintnet_tpu.ops.flash_attention import flash_attention
 
@@ -441,10 +439,9 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
     cos, sin = llama_rope_tables(_positions(b, s, sp_axis), cfg)
     import functools
 
-    seg = None
-    if cfg.segment_eos_id is not None:
-        is_eos = (input_ids == cfg.segment_eos_id).astype(jnp.int32)
-        seg = jnp.cumsum(is_eos, axis=1) - is_eos
+    from quintnet_tpu.models.gpt2 import segment_ids_from_input
+
+    seg = segment_ids_from_input(input_ids, cfg, sp_axis=sp_axis)
     body = functools.partial(llama_block_apply, cfg=cfg, cos=cos, sin=sin,
                              tp_axis=tp_axis, sp_axis=sp_axis,
                              sp_mode=sp_mode, use_flash=use_flash,

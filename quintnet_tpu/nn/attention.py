@@ -138,16 +138,14 @@ def mha_apply(
     Under tp the SAME prob-dropout mask pattern is reused on each rank's
     head block — head-group correlation, accepted for mask/key locality.
 
-    ``segment_ids`` [B, S_local]: packed-document isolation masking,
-    supported on the local paths (sdpa + flash incl. the Pallas
-    kernel); the sequence-parallel modes shard S and would need the
-    GLOBAL id vector per chunk pair — unsupported, explicit error.
+    ``segment_ids``: packed-document isolation masking on every path.
+    Local paths (sdpa + flash incl. the Pallas kernel) take [B, S]
+    directly; under ``sp_axis`` pass this rank's [B, S_local] slice of
+    the GLOBAL id vector (models/gpt2.py segment_ids_from_input
+    derives it sp-aware) — ring/zigzag rotate the ids alongside their
+    K/V chunks and Ulysses all-gathers them for its full-sequence
+    inner attention.
     """
-    if segment_ids is not None and sp_axis is not None:
-        raise NotImplementedError(
-            "segment_ids under sequence parallelism is not wired "
-            "(ring/zigzag/ulysses would need global segment exchange); "
-            "pack without sp or drop segment isolation")
     k_attn = k_resid = None
     if key is not None:
         k_attn, k_resid = jax.random.split(key)
@@ -163,12 +161,13 @@ def mha_apply(
         from quintnet_tpu.ops.ulysses_attention import ulysses_attention
 
         o = ulysses_attention(q, k, v, axis=sp_axis, causal=causal,
-                              use_flash=use_flash, **drop_kw)
+                              use_flash=use_flash,
+                              segment_ids=segment_ids, **drop_kw)
     elif sp_axis is not None and sp_mode == "zigzag":
         from quintnet_tpu.ops.ring_attention import zigzag_ring_attention
 
         o = zigzag_ring_attention(q, k, v, axis=sp_axis, causal=causal,
-                                  **drop_kw)
+                                  segment_ids=segment_ids, **drop_kw)
     elif sp_axis is not None:
         if sp_mode != "ring":
             raise ValueError(
@@ -176,7 +175,8 @@ def mha_apply(
                 "or 'ulysses'")
         from quintnet_tpu.ops.ring_attention import ring_attention
 
-        o = ring_attention(q, k, v, axis=sp_axis, causal=causal, **drop_kw)
+        o = ring_attention(q, k, v, axis=sp_axis, causal=causal,
+                           segment_ids=segment_ids, **drop_kw)
     elif use_flash:
         from quintnet_tpu.ops.flash_attention import flash_attention
 

@@ -35,13 +35,18 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _chunk_attention(q, k, v, *, mode, scale, pdrop: float = 0.0, key=None):
+def _chunk_attention(q, k, v, *, mode, scale, pdrop: float = 0.0, key=None,
+                     seg_q=None, seg_k=None):
     """One (local-Q x incoming-KV-chunk) blockwise step.
 
     q: [B, H, Sq, D]; k/v: [B, H, Sk, D];
     mode: 0=full, 1=causal-diagonal, 2=none (masked out).
     Returns (scores_max [B,H,Sq], probs-sum [B,H,Sq], weighted-V
     [B,H,Sq,D]) in f32.
+
+    ``seg_q`` [B, Sq] / ``seg_k`` [B, Sk]: GLOBAL packed-segment ids for
+    the two chunks — cross-segment pairs are masked (the fully-masked-
+    row guard below already handles rows whose whole chunk is foreign).
 
     ``key``: attention-prob dropout for this (q-chunk, kv-chunk) tile —
     the numerator drops masked probs (scaled 1/keep), the denominator
@@ -53,6 +58,9 @@ def _chunk_attention(q, k, v, *, mode, scale, pdrop: float = 0.0, key=None):
     sq, sk = scores.shape[-2], scores.shape[-1]
     diag = jnp.tril(jnp.ones((sq, sk), bool))
     mask = jnp.where(mode == 0, True, jnp.where(mode == 1, diag, False))
+    if seg_q is not None:
+        same = (seg_q[:, None, :, None] == seg_k[:, None, None, :])
+        mask = mask & same                         # [B, 1, Sq, Sk]
     scores = jnp.where(mask, scores, -jnp.inf)
     m_raw = jnp.max(scores, axis=-1)  # -inf where the row is fully masked
     m_safe = jnp.where(jnp.isfinite(m_raw), m_raw, 0.0)
@@ -67,24 +75,32 @@ def _chunk_attention(q, k, v, *, mode, scale, pdrop: float = 0.0, key=None):
 
 
 def ring_attention(q, k, v, *, axis: str, causal: bool = False,
-                   pdrop: float = 0.0, key=None):
+                   pdrop: float = 0.0, key=None, segment_ids=None):
     """[B, H, S_local, Dh] sharded attention over ``axis``.
 
     Exactly equals full-sequence attention on the gathered sequence
     (tests/test_ring.py golden checks). ``pdrop``/``key`` enable
     attention-prob dropout (each rank folds its axis index so every
     (query, key) pair draws an iid mask exactly once around the ring).
+
+    ``segment_ids`` [B, S_local]: this rank's slice of the GLOBAL
+    packed-segment id vector (models/gpt2.py segment_ids_from_input
+    derives it sp-aware) — the ids rotate around the ring alongside
+    their K/V chunk, and every chunk pair masks cross-segment entries.
     """
     sp = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
+    has_seg = segment_ids is not None
+    seg_local = (segment_ids.astype(jnp.int32) if has_seg
+                 else jnp.zeros((b, s), jnp.int32))
     base_key = None
     if key is not None and pdrop > 0.0:
         base_key = jax.random.fold_in(key, idx)
 
     def body(carry, step):
-        m, l, acc, k_cur, v_cur = carry
+        m, l, acc, k_cur, v_cur, seg_cur = carry
         # k_cur currently holds the chunk originating at rank (idx - step)
         src = jnp.mod(idx - step, sp)
         if causal:
@@ -94,7 +110,9 @@ def ring_attention(q, k, v, *, axis: str, causal: bool = False,
         m_new, l_new, o_new = _chunk_attention(
             q, k_cur, v_cur, mode=mode, scale=scale, pdrop=pdrop,
             key=(None if base_key is None
-                 else jax.random.fold_in(base_key, step)))
+                 else jax.random.fold_in(base_key, step)),
+            seg_q=(seg_local if has_seg else None),
+            seg_k=(seg_cur if has_seg else None))
         # carry max stays -inf until a row sees its first unmasked key;
         # rescale factors use a finite-ized base so exp never sees inf-inf
         m_tot = jnp.maximum(m, m_new)
@@ -111,7 +129,9 @@ def ring_attention(q, k, v, *, axis: str, causal: bool = False,
         perm = [(i, (i + 1) % sp) for i in range(sp)]
         k_nxt = lax.ppermute(k_cur, axis, perm)
         v_nxt = lax.ppermute(v_cur, axis, perm)
-        return (m_tot, l, acc, k_nxt, v_nxt), None
+        seg_nxt = (lax.ppermute(seg_cur, axis, perm) if has_seg
+                   else seg_cur)
+        return (m_tot, l, acc, k_nxt, v_nxt, seg_nxt), None
 
     init = (
         jnp.full((b, h, s), -jnp.inf, jnp.float32),
@@ -119,8 +139,9 @@ def ring_attention(q, k, v, *, axis: str, causal: bool = False,
         jnp.zeros((b, h, s, d), jnp.float32),
         k,
         v,
+        seg_local,
     )
-    (m, l, acc, _, _), _ = lax.scan(body, init, jnp.arange(sp))
+    (m, l, acc, _, _, _), _ = lax.scan(body, init, jnp.arange(sp))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
@@ -149,7 +170,7 @@ def _masked_contrib(cond, m, l, o):
 
 
 def zigzag_ring_attention(q, k, v, *, axis: str, causal: bool = True,
-                          pdrop: float = 0.0, key=None):
+                          pdrop: float = 0.0, key=None, segment_ids=None):
     """Load-balanced causal ring attention over ``axis``.
 
     The global sequence is viewed as 2·sp chunks; rank i computes the
@@ -177,7 +198,8 @@ def zigzag_ring_attention(q, k, v, *, axis: str, causal: bool = True,
     """
     if not causal:
         return ring_attention(q, k, v, axis=axis, causal=False,
-                              pdrop=pdrop, key=key)
+                              pdrop=pdrop, key=key,
+                              segment_ids=segment_ids)
     sp = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     b, h, s, d = q.shape
@@ -185,6 +207,7 @@ def zigzag_ring_attention(q, k, v, *, axis: str, causal: bool = True,
         raise ValueError(f"zigzag needs an even local sequence, got {s}")
     c = s // 2
     scale = 1.0 / math.sqrt(d)
+    has_seg = segment_ids is not None
 
     use_drop = key is not None and pdrop > 0.0
     base_key = jax.random.fold_in(key, idx) if use_drop else None
@@ -211,6 +234,19 @@ def zigzag_ring_attention(q, k, v, *, axis: str, causal: bool = True,
     tail = jnp.where(is_even, od, ev)   # global chunk 2sp-1-idx
     q_lo, k_lo, v_lo = head[0], head[1], head[2]
     q_hi, k_hi, v_hi = tail[0], tail[1], tail[2]
+    if has_seg:
+        # segment ids ride the SAME relayout (global ids, so equality
+        # comparisons are meaningful across any chunk pair)
+        sg = segment_ids.astype(jnp.int32)          # [B, 2c] contiguous
+        ev_s = lax.ppermute(sg[:, :c], axis, perm0)
+        od_s = lax.ppermute(sg[:, c:], axis, perm1)
+        s_lo = jnp.where(is_even, ev_s, od_s)       # ids of chunk idx
+        s_hi = jnp.where(is_even, od_s, ev_s)       # ids of chunk 2sp-1-idx
+    else:
+        s_lo = s_hi = jnp.zeros((b, c), jnp.int32)
+
+    def _sq(x):
+        return x if has_seg else None
 
     zero = (jnp.full((b, h, c), -jnp.inf, jnp.float32),
             jnp.zeros((b, h, c), jnp.float32),
@@ -219,20 +255,26 @@ def zigzag_ring_attention(q, k, v, *, axis: str, causal: bool = True,
     # ---- step 0: local chunks (src == idx) ------------------------------
     lo = _merge(*zero, *_chunk_attention(q_lo, k_lo, v_lo, mode=1,
                                          scale=scale, pdrop=pdrop,
-                                         key=kk(0, 0)))
+                                         key=kk(0, 0), seg_q=_sq(s_lo),
+                                         seg_k=_sq(s_lo)))
     hi = _merge(*zero, *_chunk_attention(q_hi, k_hi, v_hi, mode=1,
                                          scale=scale, pdrop=pdrop,
-                                         key=kk(0, 1)))
+                                         key=kk(0, 1), seg_q=_sq(s_hi),
+                                         seg_k=_sq(s_hi)))
     hi = _merge(*hi, *_chunk_attention(q_hi, k_lo, v_lo, mode=0,
                                        scale=scale, pdrop=pdrop,
-                                       key=kk(0, 2)))
+                                       key=kk(0, 2), seg_q=_sq(s_hi),
+                                       seg_k=_sq(s_lo)))
 
     # ---- steps 1..sp-1: rotate K/V pairs around the ring ----------------
     perm_ring = [(i, (i + 1) % sp) for i in range(sp)]
 
     def body(carry, step):
-        lo, hi, kv = carry
+        lo, hi, kv, sg_pair = carry
         kv = lax.ppermute(kv, axis, perm_ring)
+        if has_seg:
+            sg_pair = lax.ppermute(sg_pair, axis, perm_ring)
+        s_lo_in, s_hi_in = sg_pair[0], sg_pair[1]
         k_lo_in, v_lo_in, k_hi_in, v_hi_in = kv[0], kv[1], kv[2], kv[3]
         # incoming chunks originate at src = (idx - step) mod sp:
         # head chunk j = src, tail chunk 2sp-1-j.
@@ -240,7 +282,9 @@ def zigzag_ring_attention(q, k, v, *, axis: str, causal: bool = True,
         #     2sp-1-idx), full visibility at every step
         hi = _merge(*hi, *_chunk_attention(q_hi, k_lo_in, v_lo_in, mode=0,
                                            scale=scale, pdrop=pdrop,
-                                           key=kk(step, 0)))
+                                           key=kk(step, 0),
+                                           seg_q=_sq(s_hi),
+                                           seg_k=_sq(s_lo_in)))
         # (b) selected: j < idx  <=>  step <= idx  -> head-vs-head full;
         #     j > idx -> tail-vs-tail full (2sp-1-j < 2sp-1-idx). The
         #     complementary pair would be fully masked — never computed.
@@ -248,15 +292,21 @@ def zigzag_ring_attention(q, k, v, *, axis: str, causal: bool = True,
         qs = jnp.where(cond, q_lo, q_hi)
         ks = jnp.where(cond, k_lo_in, k_hi_in)
         vs = jnp.where(cond, v_lo_in, v_hi_in)
+        sq_sel = jnp.where(cond, s_lo, s_hi)
+        sk_sel = jnp.where(cond, s_lo_in, s_hi_in)
         m2, l2, o2 = _chunk_attention(qs, ks, vs, mode=0, scale=scale,
-                                      pdrop=pdrop, key=kk(step, 1))
+                                      pdrop=pdrop, key=kk(step, 1),
+                                      seg_q=_sq(sq_sel),
+                                      seg_k=_sq(sk_sel))
         lo = _merge(*lo, *_masked_contrib(cond, m2, l2, o2))
         hi = _merge(*hi, *_masked_contrib(~cond, m2, l2, o2))
-        return (lo, hi, kv), None
+        return (lo, hi, kv, sg_pair), None
 
     kv0 = jnp.stack([k_lo, v_lo, k_hi, v_hi])
+    sg0 = jnp.stack([s_lo, s_hi])
     if sp > 1:
-        (lo, hi, _), _ = lax.scan(body, (lo, hi, kv0), jnp.arange(1, sp))
+        (lo, hi, _, _), _ = lax.scan(body, (lo, hi, kv0, sg0),
+                                     jnp.arange(1, sp))
 
     out_lo = (lo[2] / jnp.maximum(lo[1], 1e-30)[..., None])
     out_hi = (hi[2] / jnp.maximum(hi[1], 1e-30)[..., None])

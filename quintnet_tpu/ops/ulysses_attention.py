@@ -34,7 +34,7 @@ from quintnet_tpu.nn import attention as _attn
 
 def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
                       use_flash: bool = False,
-                      pdrop: float = 0.0, key=None):
+                      pdrop: float = 0.0, key=None, segment_ids=None):
     """Attention over sequence-sharded inputs via two all-to-alls.
 
     q/k/v: [B, H_local, S_local, Dh] with the sequence dim sharded over
@@ -45,6 +45,12 @@ def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
     ``pdrop``/``key``: attention-prob dropout on the inner (full-
     sequence, local-head-subset) attention; each rank folds its axis
     index since it owns a disjoint head subset after the scatter.
+
+    ``segment_ids`` [B, S_local]: this rank's slice of the GLOBAL
+    packed-segment ids — after the head-scatter every rank holds the
+    full sequence, so one cheap [B, S] all-gather reassembles the id
+    vector and the inner attention (sdpa or the Pallas flash kernel)
+    masks cross-segment pairs natively.
     """
     sp = lax.axis_size(axis)
     h_local = q.shape[1]
@@ -62,6 +68,11 @@ def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
     qkv = cc.all_to_all(qkv, axis, split_dim=2, concat_dim=3)
     qf, kf, vf = qkv[0], qkv[1], qkv[2]
 
+    seg_full = None
+    if segment_ids is not None:
+        seg_full = cc.all_gather(segment_ids.astype(jnp.int32), axis,
+                                 gather_dim=1)   # [B, S_full]
+
     k_local = None
     if key is not None and pdrop > 0.0:
         k_local = jax.random.fold_in(key, lax.axis_index(axis))
@@ -70,10 +81,12 @@ def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
         from quintnet_tpu.ops.flash_attention import flash_attention
 
         of = flash_attention(qf, kf, vf, causal=causal,
-                             pdrop=pdrop, key=k_local)
+                             pdrop=pdrop, key=k_local,
+                             segment_ids=seg_full)
     else:
         of = _attn.sdpa(qf, kf, vf, causal=causal,
-                        pdrop=pdrop, key=k_local)
+                        pdrop=pdrop, key=k_local,
+                        segment_ids=seg_full)
 
     # gather heads back, re-scatter sequence: [B, H_local, S_local, Dh]
     return cc.all_to_all(of, axis, split_dim=2, concat_dim=1)

@@ -139,7 +139,49 @@ def test_mha_apply_segments_match_manual():
                                np.asarray(out_b), rtol=2e-4, atol=2e-5)
 
 
-def test_mha_apply_segments_under_sp_raises():
+# ---------------------------------------------------------------------------
+# sequence-parallel: ring / zigzag / ulysses carry the GLOBAL ids
+
+
+@pytest.mark.parametrize("sp,mode", [(2, "ring"), (4, "ring"),
+                                     (2, "zigzag"), (4, "zigzag"),
+                                     (2, "ulysses")])
+def test_sp_attention_segments_match_sdpa(sp, mode):
+    """Sequence-parallel attention with segment masking == single-device
+    masked sdpa on the gathered sequence (ring rotates ids with K/V,
+    zigzag relays them through its permuted layout, ulysses all-gathers
+    them)."""
+    from jax.sharding import PartitionSpec as P
+
+    from quintnet_tpu.core import collectives as cc
+    from quintnet_tpu.core.mesh import mesh_from_sizes
+    from quintnet_tpu.ops.ring_attention import (ring_attention,
+                                                 zigzag_ring_attention)
+    from quintnet_tpu.ops.ulysses_attention import ulysses_attention
+
+    b, h, s, d = 2, 2, 32, 16
+    q, k, v = _qkv(b=b, h=h, s=s, d=d)
+    seg = _segments(b=b, s=s, n_docs=3)
+    ref = _brute(q, k, v, seg, True)
+
+    fns = {"ring": ring_attention, "zigzag": zigzag_ring_attention,
+           "ulysses": ulysses_attention}
+    fn = fns[mode]
+    mesh = mesh_from_sizes(sp=sp)
+    out = cc.shard_map_fn(
+        lambda q_, k_, v_, s_: fn(q_, k_, v_, axis="sp", causal=True,
+                                  segment_ids=s_),
+        mesh,
+        in_specs=(P(None, None, "sp"), P(None, None, "sp"),
+                  P(None, None, "sp"), P(None, "sp")),
+        out_specs=P(None, None, "sp"))(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_mha_apply_segments_under_sp_matches_local():
+    """mha_apply(sp_axis=..., segment_ids=<local slice of global ids>)
+    equals the unsharded call with the full vector."""
     from jax.sharding import PartitionSpec as P
 
     from quintnet_tpu.core import collectives as cc
@@ -148,15 +190,51 @@ def test_mha_apply_segments_under_sp_raises():
     d, h, s = 16, 2, 16
     p = mha_init(jax.random.key(0), d)
     x = jax.random.normal(jax.random.key(1), (2, s, d))
-    seg = jnp.zeros((2, s), jnp.int32)
+    seg = _segments(b=2, s=s, n_docs=3)
+    ref = mha_apply(p, x, num_heads=h, causal=True, segment_ids=seg)
     mesh = mesh_from_sizes(sp=2)
-    f = cc.shard_map_fn(
+    out = cc.shard_map_fn(
         lambda p_, x_, s_: mha_apply(p_, x_, num_heads=h, causal=True,
                                      sp_axis="sp", segment_ids=s_),
         mesh, in_specs=(None, P(None, "sp"), P(None, "sp")),
-        out_specs=P(None, "sp"))
-    with pytest.raises(NotImplementedError, match="segment_ids"):
-        f(p, x, seg)
+        out_specs=P(None, "sp"))(p, x, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_gpt2_segment_isolation_sp_strategy_golden():
+    """Full GPT-2 train-step golden: segment_eos_id on a dp x sp mesh
+    (sp-aware GLOBAL id derivation inside the model) == single device."""
+    import optax
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.models.gpt2 import (GPT2Config, gpt2_init,
+                                          gpt2_model_spec)
+    from quintnet_tpu.parallel.strategy import get_strategy
+
+    gcfg = GPT2Config.tiny(segment_eos_id=5)
+    model = gpt2_model_spec(gcfg)
+    params = gpt2_init(jax.random.key(0), gcfg)
+    ids = np.random.default_rng(0).integers(
+        0, gcfg.vocab_size, (4, 16)).astype(np.int32)
+    ids[:, 5] = 5  # a separator inside every row, off the sp boundary
+    batch = (jnp.asarray(ids), jnp.asarray(ids))
+    opt = optax.sgd(0.05)
+
+    ref_loss, g = jax.value_and_grad(model.loss_fn)(params, batch)
+    up, _ = opt.update(g, opt.init(params), params)
+    p_ref = optax.apply_updates(params, up)
+
+    cfg = Config.from_dict({"mesh_dim": [2, 2], "mesh_name": ["dp", "sp"],
+                            "training": {"batch_size": 4,
+                                         "grad_clip_norm": None}})
+    strat = get_strategy("dp_sp", cfg)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+    st = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch(batch, model)
+    step = strat.make_train_step(model, opt)
+    p, st, loss = step(p, st, b)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
 
 
 # ---------------------------------------------------------------------------
