@@ -168,7 +168,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     kernel carries no PRNG, so a dropout-enabled call routes to the
     blockwise jnp path (still O(S) live memory under scan) — correctness
     of the requested regularisation wins over kernel speed; benches and
-    inference never pass a key so they keep the fast path."""
+    inference never pass a key so they keep the fast path. (In-kernel
+    dropout via pltpu.prng_seed/prng_random_bits was evaluated and
+    deliberately NOT shipped: those primitives have no CPU/interpret
+    lowering in this jax version, so the code path would be untestable
+    in CI — against this repo's golden-test standard — and attention
+    dropout is off in every throughput config anyway.)"""
     s = q.shape[-2]
     bq, bk = min(block_q, s), min(block_k, s)
     use_drop = key is not None and pdrop > 0.0
