@@ -40,7 +40,8 @@ from quintnet_tpu.nn.layers import (cast_floating, linear_init,
 from quintnet_tpu.nn.moe import moe_apply, moe_init, moe_specs
 from quintnet_tpu.nn.transformer import stacked_blocks_apply
 
-from quintnet_tpu.models.gpt2 import clm_loss, clm_loss_sp  # shared CLM loss
+from quintnet_tpu.models.gpt2 import (clm_loss, clm_loss_sp,  # shared CLM
+                                      clm_loss_vp, mask_padded_cols)
 
 
 @dataclass(frozen=True)
@@ -65,6 +66,15 @@ class LlamaConfig:
     expert_capacity: Optional[int] = None
     aux_loss_weight: float = 1e-2
     router_type: str = "topk"  # or "expert_choice" (nn/moe.py)
+    # --- vocab parallelism: shard the token table (and untied lm head)
+    # over tp — at Llama-3's 128256-token vocab the replicated table is
+    # the single largest tensor, and the vp loss (models/gpt2.py
+    # clm_loss_vp) never materialises full [B, S, V] logits on any
+    # rank. Same semantics as GPT2Config.vocab_parallel; requires
+    # (padded_)vocab_size % tp == 0 (use padded_vocab_size to round up;
+    # padded columns are masked out of the softmax).
+    vocab_parallel: bool = False
+    padded_vocab_size: Optional[int] = None
     # packed-document isolation: derive attention segment ids from
     # input_ids (new segment after each occurrence of this token) and
     # mask cross-document attention — models/gpt2.py segment_ids_from_input
@@ -81,6 +91,11 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def table_vocab_size(self) -> int:
+        """tok table rows (padded vocab when padding is configured)."""
+        return self.padded_vocab_size or self.vocab_size
 
     @property
     def moe_args(self):
@@ -284,13 +299,14 @@ def llama_init(key, cfg: LlamaConfig, *, dtype=jnp.float32):
         for bk in jax.random.split(k_blocks, cfg.n_layers)])
     params: Dict[str, Any] = {
         "embedding": {"tok": jax.random.normal(
-            k_emb, (cfg.vocab_size, cfg.dim), dtype) * 0.02},
+            k_emb, (cfg.table_vocab_size, cfg.dim), dtype) * 0.02},
         "blocks": blocks,
         "head": {"ln_f": rms_norm_init(cfg.dim, dtype)},
     }
     if not cfg.tie_embeddings:
         params["head"]["lm"] = linear_init(
-            k_head, cfg.dim, cfg.vocab_size, use_bias=False, dtype=dtype)
+            k_head, cfg.dim, cfg.table_vocab_size, use_bias=False,
+            dtype=dtype)
     return params
 
 
@@ -435,7 +451,14 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
                  remat: "bool | str" = False, use_flash: bool = False):
     """-> (final hidden states, moe aux total — 0.0 for dense)."""
     b, s = input_ids.shape
-    h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
+    if cfg.vocab_parallel and tp_axis is not None:
+        from quintnet_tpu.parallel.tp import vocab_parallel_embedding
+
+        h = vocab_parallel_embedding(
+            {"table": params["embedding"]["tok"]}, input_ids,
+            axis=tp_axis)
+    else:
+        h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
     cos, sin = llama_rope_tables(_positions(b, s, sp_axis), cfg)
     import functools
 
@@ -454,10 +477,19 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
 
 
 def llama_logits(params, h, cfg: LlamaConfig):
+    """ln_f + lm head (tied: tok.T). With a padded vocab and a
+    FULL-width table the padding columns are -inf-masked (single-device
+    / no-tp fallback of a vocab_parallel config); vocab-SHARDED tables
+    are masked inside clm_loss_vp, which knows the shard offset (same
+    split of responsibilities as models/gpt2.py gpt2_logits)."""
     h = rms_norm_apply(params["head"]["ln_f"], h, eps=cfg.rms_eps)
     w = (params["embedding"]["tok"].T if cfg.tie_embeddings
          else params["head"]["lm"]["w"])
-    return jnp.dot(h, w).astype(jnp.float32)
+    logits = jnp.dot(h, w).astype(jnp.float32)
+    if (cfg.padded_vocab_size
+            and logits.shape[-1] == cfg.table_vocab_size):
+        logits = mask_padded_cols(logits, cfg)
+    return logits
 
 
 def llama_apply(params, input_ids, cfg: LlamaConfig, *,
@@ -498,14 +530,30 @@ def llama_partition_specs(cfg: Optional[LlamaConfig] = None, *,
     else:
         blocks["mlp"] = {"gate": {"w": col}, "up": {"w": col},
                          "down": {"w": row}}
+    vp = cfg is not None and cfg.vocab_parallel and tp_axis is not None
     specs = {
-        "embedding": {"tok": P()},
+        # vp: vocab dim sharded over tp; grads stay un-psummed over tp
+        # (train_step.py reduce_grads spec rule) — the vp loss/embed
+        # psums supply the tp cotangent factor exactly once
+        "embedding": {"tok": P(t, None) if vp else P()},
         "blocks": blocks,
         "head": {"ln_f": {"scale": P()}},
     }
     if cfg is None or not cfg.tie_embeddings:
-        specs["head"]["lm"] = {"w": P()}
+        specs["head"]["lm"] = {"w": P(None, t) if vp else P()}
     return specs
+
+
+def _validate_tp(cfg: LlamaConfig, tp: int, params):
+    """Separate q/k/v need no qkv re-blocking (identity layout); this
+    hook just validates the vp divisibility constraint with a clear
+    message before shard_params hits an opaque partition error."""
+    if cfg.vocab_parallel and tp > 1 and cfg.table_vocab_size % tp != 0:
+        raise ValueError(
+            f"vocab_parallel needs (padded_)vocab_size % tp == 0; got "
+            f"{cfg.table_vocab_size} % {tp}. Set padded_vocab_size; "
+            f"padded columns are masked out of the loss.")
+    return params
 
 
 def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
@@ -527,6 +575,11 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
                               sp_mode=sp_mode, ep_axis=ep_axis,
                               remat=remat, use_flash=use_flash)
         logits = llama_logits(cast(params), h, cfg)
+        if cfg.vocab_parallel and tp_axis is not None:
+            return clm_loss_vp(
+                logits, labels, tp_axis=tp_axis, sp_axis=sp_axis,
+                vocab_size=(cfg.vocab_size if cfg.padded_vocab_size
+                            else None)) + aux
         if sp_axis is not None:
             return clm_loss_sp(logits, labels, sp_axis=sp_axis) + aux
         return clm_loss(logits, labels) + aux
@@ -540,8 +593,14 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
 
         def embed_fn(params, input_ids, key=None):
             del key
-            return jnp.take(cast(params)["embedding"]["tok"], input_ids,
-                            axis=0)
+            tok = cast(params)["embedding"]["tok"]
+            if cfg.vocab_parallel and tp_axis is not None:
+                from quintnet_tpu.parallel.tp import \
+                    vocab_parallel_embedding
+
+                return vocab_parallel_embedding({"table": tok}, input_ids,
+                                                axis=tp_axis)
+            return jnp.take(tok, input_ids, axis=0)
 
         def stage_fn(blocks_local, h, key=None):
             del key
@@ -560,14 +619,26 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
                                         sp_axis=sp_axis,
                                         scan_unroll=cfg.scan_unroll)
 
-        if sp_axis is not None:
+        vp = cfg.vocab_parallel and tp_axis is not None
+        if vp or sp_axis is not None:
+            # the loss contains collectives (vp lse psums / sp
+            # shift+psum), which may not sit inside the schedules'
+            # lax.cond gate — split as gpt2_pipeline_fns does
             from quintnet_tpu.parallel.pp import SplitHead
+
+            def head_reduce_fn(logits, labels, valid):
+                if vp:
+                    loss = clm_loss_vp(
+                        logits, labels, tp_axis=tp_axis, sp_axis=sp_axis,
+                        vocab_size=(cfg.vocab_size if cfg.padded_vocab_size
+                                    else None))
+                else:
+                    loss = clm_loss_sp(logits, labels, sp_axis=sp_axis)
+                return jnp.where(valid, loss, 0.0)
 
             return embed_fn, stage_fn, SplitHead(
                 lambda params, h, labels: llama_logits(cast(params), h, cfg),
-                lambda logits, labels, valid: jnp.where(
-                    valid, clm_loss_sp(logits, labels, sp_axis=sp_axis),
-                    0.0))
+                head_reduce_fn)
 
         def head_loss_fn(params, h, labels):
             return clm_loss(llama_logits(cast(params), h, cfg), labels)
@@ -585,7 +656,7 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
             llama_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
                                   ep_axis=ep_axis),
         pipeline_fns=pipeline_fns,
-        to_tp_layout=lambda p, tp: p,  # separate q/k/v: no qkv re-blocking
+        to_tp_layout=lambda p, tp: _validate_tp(cfg, tp, p),
         depth=cfg.n_layers,
         batch_specs=batch_specs,
         needs_rng=False,

@@ -25,12 +25,39 @@ from quintnet_tpu.models.llama import (LlamaConfig, llama_block_decode,
                                        llama_rope_tables)
 
 
+def _embed(params, ids, cfg: LlamaConfig, tp_axis):
+    """Token lookup; under vocab_parallel the table arrives vocab-
+    sharded, so out-of-shard ids zero-contribute and one psum
+    assembles the embedding (same as gpt2_generate's vp path)."""
+    if tp_axis is not None and cfg.vocab_parallel:
+        from quintnet_tpu.parallel.tp import vocab_parallel_embedding
+
+        return vocab_parallel_embedding(
+            {"table": params["embedding"]["tok"]}, ids, axis=tp_axis)
+    return jnp.take(params["embedding"]["tok"], ids, axis=0)
+
+
+def _full_logits(params, h, cfg: LlamaConfig, tp_axis):
+    """Full-vocab logits for sampling/argmax. Under vocab_parallel the
+    local [.., V/tp] shard is all-gathered and padded columns masked
+    (decoding must never emit an id >= vocab_size)."""
+    logits = llama_logits(params, h, cfg)
+    if tp_axis is not None and cfg.vocab_parallel:
+        from quintnet_tpu.core import collectives as cc
+        from quintnet_tpu.models.gpt2 import mask_padded_cols
+
+        logits = cc.all_gather(logits, tp_axis, gather_dim=-1)
+        if cfg.padded_vocab_size:
+            logits = mask_padded_cols(logits, cfg)
+    return logits
+
+
 def llama_prefill(params, input_ids, cfg: LlamaConfig, *, cache_len: int,
                   tp_axis=None):
     """[B, T0] -> (last-pos logits [B, V], (k, v) caches
     [L, B, H_kv(/tp), cache_len, Dh])."""
     B, T0 = input_ids.shape
-    h = jnp.take(params["embedding"]["tok"], input_ids, axis=0)
+    h = _embed(params, input_ids, cfg, tp_axis)
     cos, sin = llama_rope_tables(jnp.arange(T0), cfg)
 
     def body(x, blk):
@@ -39,14 +66,14 @@ def llama_prefill(params, input_ids, cfg: LlamaConfig, *, cache_len: int,
 
     h, (ks, vs) = lax.scan(body, h, params["blocks"])
     pad = [(0, 0), (0, 0), (0, 0), (0, cache_len - T0), (0, 0)]
-    return (llama_logits(params, h[:, -1:, :], cfg)[:, 0, :],
+    return (_full_logits(params, h[:, -1:, :], cfg, tp_axis)[:, 0, :],
             (jnp.pad(ks, pad), jnp.pad(vs, pad)))
 
 
 def llama_decode_step(params, tok, pos, caches, cfg: LlamaConfig,
                       tp_axis=None):
     """One cached step: tok [B], pos scalar -> (logits [B, V], caches)."""
-    x = jnp.take(params["embedding"]["tok"], tok[:, None], axis=0)  # [B,1,D]
+    x = _embed(params, tok[:, None], cfg, tp_axis)              # [B,1,D]
     cos, sin = llama_rope_tables(
         pos[None] if jnp.ndim(pos) == 0 else pos, cfg)
     ks, vs = caches
@@ -58,7 +85,7 @@ def llama_decode_step(params, tok, pos, caches, cfg: LlamaConfig,
         return x, (kc, vc)
 
     h, (ks, vs) = lax.scan(body, x, (params["blocks"], ks, vs))
-    return llama_logits(params, h, cfg)[:, 0, :], (ks, vs)
+    return _full_logits(params, h, cfg, tp_axis)[:, 0, :], (ks, vs)
 
 
 def _llama_generate_body(params, input_ids, key, cfg: LlamaConfig,

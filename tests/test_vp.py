@@ -215,3 +215,161 @@ def test_vp_requires_divisible_vocab():
                               vocab_parallel=True)
     with pytest.raises(ValueError, match="vocab_parallel"):
         gpt2_to_tp_layout(gpt2_init(jax.random.key(0), bad), bad, tp=2)
+
+
+# ---------------------------------------------------------------------------
+# Llama vocab parallelism (models/llama.py LlamaConfig.vocab_parallel) —
+# at Llama-3's 128k vocab the replicated table is the largest tensor, so
+# vp matters most for this family
+
+
+def _llama_cfgs(padded=False):
+    from quintnet_tpu.models.llama import LlamaConfig
+
+    base = LlamaConfig.tiny(vocab_size=VOCAB)
+    kw = dict(vocab_parallel=True)
+    if padded:
+        base = LlamaConfig.tiny(vocab_size=VOCAB - 6)
+        kw["padded_vocab_size"] = VOCAB
+    return base, dataclasses.replace(base, **kw)
+
+
+@pytest.mark.parametrize(
+    "name,mesh_dim,mesh_name,schedule,grad_acc,tie",
+    [
+        ("tp", [2], ["tp"], "afab", 1, True),
+        ("tp", [2], ["tp"], "afab", 1, False),
+        ("dp_tp", [2, 2], ["dp", "tp"], "afab", 1, True),
+        ("3d", [2, 2, 2], ["dp", "tp", "pp"], "1f1b", 2, True),
+        ("auto", [2, 2, 2], ["tp", "sp", "pp"], "1f1b", 2, True),
+    ],
+)
+def test_llama_vp_matches_single_device(name, mesh_dim, mesh_name,
+                                        schedule, grad_acc, tie):
+    from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
+                                           llama_model_spec)
+
+    base = LlamaConfig.tiny(vocab_size=VOCAB, tie_embeddings=tie)
+    vp_cfg = dataclasses.replace(base, vocab_parallel=True)
+    cfg = _config(mesh_dim, mesh_name, schedule, grad_acc)
+    params = llama_init(jax.random.key(0), base)
+    batch = _data()
+    opt = optax.sgd(0.05)
+
+    # single-device reference
+    model_ref = llama_model_spec(base)
+    losses_ref, p_ref = [], params
+    state = opt.init(params)
+    for _ in range(2):
+        loss, g = jax.value_and_grad(model_ref.loss_fn)(p_ref, batch)
+        up, state = opt.update(g, state, p_ref)
+        p_ref = optax.apply_updates(p_ref, up)
+        losses_ref.append(float(loss))
+
+    strat = get_strategy(name, cfg)
+    model = llama_model_spec(vp_cfg)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch(batch, model)
+    step = strat.make_train_step(model, opt)
+    losses = []
+    for _ in range(2):
+        p, s, loss = step(p, s, b)
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-4)
+    ref = dict(jax.tree_util.tree_leaves_with_path(p_ref))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(p):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(leaf)), np.asarray(ref[path]),
+            rtol=2e-4, atol=1e-5,
+            err_msg=f"{name}:{jax.tree_util.keystr(path)}")
+
+
+def test_llama_vp_padded_vocab_matches_unpadded():
+    """padded_vocab_size under vp: loss equals the unpadded single-
+    device model. TIED embeddings + GARBAGE pad rows make the masking
+    load-bearing: the pad rows feed the lm head as logit columns, so
+    deleting the vocab_size mask in clm_loss_vp fails this test."""
+    import dataclasses as _dc
+
+    from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
+                                           llama_model_spec)
+
+    base = LlamaConfig.tiny(vocab_size=VOCAB - 6, tie_embeddings=True)
+    vp_pad = _dc.replace(base, vocab_parallel=True,
+                         padded_vocab_size=VOCAB)
+    params = llama_init(jax.random.key(0), base)
+    ids = jax.random.randint(jax.random.key(3), (4, 16), 0,
+                             base.vocab_size)
+    batch = (ids, ids)
+
+    ref = llama_model_spec(base).loss_fn(params, batch)
+
+    pad_rows = vp_pad.table_vocab_size - base.vocab_size
+    padded = jax.tree.map(jnp.copy, params)
+    padded["embedding"]["tok"] = jnp.pad(
+        padded["embedding"]["tok"], ((0, pad_rows), (0, 0)),
+        constant_values=3.7)  # garbage: only the mask hides it
+
+    cfg = _config([2], ["tp"])
+    strat = get_strategy("tp", cfg)
+    model = llama_model_spec(vp_pad)
+    p = strat.shard_params(model, padded)
+    b = strat.shard_batch(batch, model)
+    opt = optax.sgd(0.05)
+    s = strat.init_opt_state(model, opt, p)
+    step = strat.make_train_step(model, opt)
+    p2, _, loss = step(p, s, b)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    # pad rows must receive ZERO gradient (still exactly 3.7 after sgd)
+    tok2 = np.asarray(jax.device_get(p2["embedding"]["tok"]))
+    np.testing.assert_array_equal(tok2[base.vocab_size:],
+                                  np.float32(3.7))
+
+
+def test_llama_vp_requires_divisible_vocab():
+    from quintnet_tpu.models.llama import LlamaConfig, llama_init, \
+        llama_model_spec
+
+    bad = LlamaConfig.tiny(vocab_size=127, vocab_parallel=True)
+    cfg = _config([2], ["tp"])
+    strat = get_strategy("tp", cfg)
+    model = llama_model_spec(bad)
+    with pytest.raises(ValueError, match="vocab_parallel"):
+        strat.shard_params(model, llama_init(jax.random.key(0), bad))
+
+
+def test_llama_vp_tp_generate_matches_single_device():
+    """vp-trained layout decode: llama_generate_tp with vocab_parallel
+    (sharded table, padded vocab, garbage pad rows) == single-device
+    decode on the unpadded model, token for token (greedy)."""
+    import dataclasses as _dc
+
+    from quintnet_tpu.core.mesh import mesh_from_sizes
+    from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
+                                           llama_partition_specs)
+    from quintnet_tpu.models.llama_generate import (llama_generate,
+                                                    llama_generate_tp)
+    from quintnet_tpu.parallel.train_step import shard_pytree
+
+    base = LlamaConfig.tiny(vocab_size=VOCAB - 6, tie_embeddings=True)
+    vp_pad = _dc.replace(base, vocab_parallel=True,
+                         padded_vocab_size=VOCAB)
+    params = llama_init(jax.random.key(0), base)
+    ids = jax.random.randint(jax.random.key(7), (2, 5), 0,
+                             base.vocab_size)
+    ref = llama_generate(params, ids, base, max_new_tokens=5)
+
+    pad_rows = vp_pad.table_vocab_size - base.vocab_size
+    padded = jax.tree.map(jnp.copy, params)
+    padded["embedding"]["tok"] = jnp.pad(
+        padded["embedding"]["tok"], ((0, pad_rows), (0, 0)),
+        constant_values=3.7)  # decode must never surface these columns
+
+    mesh = mesh_from_sizes(tp=2)
+    specs = llama_partition_specs(vp_pad, tp_axis="tp")
+    sharded = shard_pytree(mesh, padded, specs)
+    out = llama_generate_tp(sharded, ids, vp_pad, mesh=mesh,
+                            max_new_tokens=5)
+    np.testing.assert_array_equal(out, ref)
