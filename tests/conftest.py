@@ -50,6 +50,8 @@ SLOW_FILES = {
     "test_beam.py",       # beam-search goldens (~1 min)
     "test_remat_knobs.py",  # remat policy matrix (~1.5 min; plain
                             # policy goldens promoted fast)
+    "test_segments.py",   # packed-segment matrix incl. sp modes (~3 min;
+                          # sdpa/host-helper goldens promoted fast)
 }
 
 

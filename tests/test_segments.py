@@ -44,6 +44,7 @@ def _brute(q, k, v, seg, causal):
                       jax.nn.softmax(scores, axis=-1), v)
 
 
+@pytest.mark.fast
 @pytest.mark.parametrize("causal", [False, True])
 def test_sdpa_segments(causal):
     q, k, v = _qkv()
@@ -111,6 +112,7 @@ def test_pallas_kernel_segments_grads(causal):
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.fast
 def test_segments_from_tokens():
     eos = 9
     rows = np.asarray([[1, 2, eos, 3, 4, 5, eos, 6],
@@ -254,6 +256,7 @@ def _iso_case(vocab, eos, s1=7, s2=8, seed=0):
     return np.stack([row(doc1a), row(doc1b)]), s1 + 1
 
 
+@pytest.mark.fast
 def test_gpt2_segment_isolation():
     from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_apply, gpt2_init
 
