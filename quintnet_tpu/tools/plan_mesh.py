@@ -32,6 +32,24 @@ from quintnet_tpu.models.gpt2 import GPT2Config
 
 GB = 1 << 30
 
+
+def _geometry(cfg):
+    """(d, L, V, block_params, embed_params, pos_params, n_head) for a
+    GPT2Config or LlamaConfig — the planner's memory model is geometry-
+    driven, so both families share one estimator. Llama: GQA shrinks
+    k/v projections by n_kv/n_heads, SwiGLU is 3 matmuls of width
+    ``intermediate_size``, RMSNorm has no bias, no position table, and
+    an UNTIED lm head doubles the embedding bytes."""
+    if hasattr(cfg, "n_layers"):  # LlamaConfig
+        d, L, V = cfg.dim, cfg.n_layers, cfg.table_vocab_size
+        r = cfg.n_kv_heads / cfg.n_heads
+        block = int(d * d * (2 + 2 * r)) + 3 * d * cfg.intermediate_size             + 2 * d
+        embed = V * d * (1 if cfg.tie_embeddings else 2)
+        return d, L, V, block, embed, 0, cfg.n_heads
+    d, L, V = cfg.n_embd, cfg.n_layer, cfg.table_vocab_size
+    return (d, L, V, 12 * d * d + 13 * d, V * d,
+            cfg.n_positions * d, cfg.n_head)
+
 # v5e per-chip figures; overridable on the CLI. ICI bandwidth only sets
 # the relative weight of comm vs memory in ranking, so precision is not
 # critical.
@@ -75,11 +93,10 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
     """
     zero1 = zero1 or zero_stage >= 2   # zero2 implies the stage-1 shard
     dp, tp, pp, sp = (mesh.get(a, 1) for a in ("dp", "tp", "pp", "sp"))
-    d, L, V, H = cfg.n_embd, cfg.n_layer, cfg.table_vocab_size, cfg.n_head
+    d, L, V, blk, emb, pos, H = _geometry(cfg)
 
-    block_params = L * (12 * d * d + 13 * d) // (tp * pp)
-    embed_params = V * d // (tp if cfg.vocab_parallel else 1) \
-        + cfg.n_positions * d
+    block_params = L * blk // (tp * pp)
+    embed_params = emb // (tp if cfg.vocab_parallel else 1) + pos
     local_params = block_params + embed_params + 2 * d
 
     b_loc = max(batch // dp, 1)
@@ -101,7 +118,8 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
         work = 4 * b_loc * s_loc * d * 2          # one block's live set
     else:
         work = (L // pp) * b_loc * s_loc * (13 * d) * 2  # qkv+mlp saved
-    logits = (0 if (cfg.vocab_parallel or cfg.loss_chunk or sp > 1)
+    logits = (0 if (cfg.vocab_parallel or getattr(cfg, "loss_chunk", 0)
+                    or sp > 1)
               else 4 * b_loc * s_loc * V)
     breakdown = {"master": master, "opt": opt, "grads": grads,
                  "compute": compute, "acts": acts + work, "logits": logits}
@@ -134,14 +152,17 @@ def plan(cfg: GPT2Config, *, n_devices: int, batch: int, seq: int,
     """All legal meshes over ``n_devices``, fitting ones first, each
     group sorted by the comm heuristic (less ICI traffic first)."""
     hbm = hbm_gb * GB
+    n_head = getattr(cfg, "n_head", None) or cfg.n_heads
+    n_kv = getattr(cfg, "n_kv_heads", n_head)
+    n_layer = getattr(cfg, "n_layer", None) or cfg.n_layers
     out = []
     for tp in _divisors(n_devices):
-        if cfg.n_head % tp:
+        if n_head % tp or n_kv % tp:
             continue
         if cfg.vocab_parallel and cfg.table_vocab_size % tp:
             continue
         for pp in _divisors(n_devices // tp):
-            if cfg.n_layer % pp or (max_pp and pp > max_pp):
+            if n_layer % pp or (max_pp and pp > max_pp):
                 continue
             for sp in _divisors(n_devices // (tp * pp)):
                 if not use_sp and sp > 1:
@@ -165,10 +186,19 @@ _PRESETS = {"gpt2": GPT2Config.base, "gpt2-base": GPT2Config.base,
             "gpt2-xl": GPT2Config.xl}
 
 
+def _llama_presets():
+    from quintnet_tpu.models.llama import LlamaConfig
+
+    return {"llama-160m": LlamaConfig.llama_160m,
+            "llama32-1b": LlamaConfig.llama32_1b,
+            "llama3-8b": LlamaConfig.llama3_8b}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    presets = {**_PRESETS, **_llama_presets()}
     ap.add_argument("--model", default="gpt2",
-                    choices=sorted(_PRESETS))
+                    choices=sorted(presets))
     ap.add_argument("--devices", type=int, required=True)
     ap.add_argument("--batch", type=int, required=True,
                     help="GLOBAL batch size")
@@ -184,12 +214,15 @@ def main(argv=None):
     ap.add_argument("--top", type=int, default=5)
     args = ap.parse_args(argv)
 
-    cfg = _PRESETS[args.model]()
+    cfg = presets[args.model]()
     if args.seq > cfg.n_positions:
         cfg = dataclasses.replace(cfg, n_positions=args.seq)
     if args.vocab_parallel:
+        # gpt2's 50257 needs Megatron-style padding to divide tp;
+        # llama's 128256 (and the 160m geometry's 32000) already do
+        pad = 50304 if cfg.vocab_size == 50257 else None
         cfg = dataclasses.replace(cfg, vocab_parallel=True,
-                                  padded_vocab_size=50304)
+                                  padded_vocab_size=pad)
     plans = plan(cfg, n_devices=args.devices, batch=args.batch,
                  seq=args.seq, hbm_gb=args.hbm_gb,
                  zero1=args.zero1 or args.zero2,

@@ -85,3 +85,38 @@ def test_zero2_shards_grads_too():
     z2 = _mem({"dp": 4}, zero1=True, zero_stage=2)
     assert z2.breakdown["grads"] * 4 == z1.breakdown["grads"]
     assert z2.breakdown["opt"] == z1.breakdown["opt"]
+
+
+def test_llama_geometry_gqa_and_tied_head():
+    """Llama memory model: GQA shrinks attention params, SwiGLU uses
+    intermediate_size, tied embeddings count once / untied twice."""
+    import dataclasses
+
+    from quintnet_tpu.models.llama import LlamaConfig
+    from quintnet_tpu.tools.plan_mesh import _geometry, estimate
+
+    cfg = LlamaConfig.llama_160m()  # GQA 12/4, tied
+    d, L, V, blk, emb, pos, H = _geometry(cfg)
+    # q + o full, k + v at kv/heads ratio, SwiGLU 3 matmuls, 2 norms
+    r = cfg.n_kv_heads / cfg.n_heads
+    assert blk == int(d * d * (2 + 2 * r)) + 3 * d * cfg.intermediate_size + 2 * d
+    assert pos == 0 and emb == V * d
+
+    untied = dataclasses.replace(cfg, tie_embeddings=False)
+    assert _geometry(untied)[4] == 2 * V * d
+
+    # vp shards the table over tp
+    vp = dataclasses.replace(cfg, vocab_parallel=True)
+    p_rep = estimate(cfg, {"tp": 4}, batch=8, seq=512)
+    p_vp = estimate(vp, {"tp": 4}, batch=8, seq=512)
+    assert p_vp.bytes_per_chip < p_rep.bytes_per_chip
+    assert p_vp.breakdown["logits"] == 0  # sharded CE, no dense logits
+
+
+def test_cli_llama_smoke(capsys):
+    from quintnet_tpu.tools.plan_mesh import main
+
+    main(["--model", "llama32-1b", "--devices", "8", "--batch", "32",
+          "--seq", "2048", "--zero1", "--vocab-parallel"])
+    out = capsys.readouterr().out
+    assert "llama32-1b" in out and "legal meshes fit" in out
