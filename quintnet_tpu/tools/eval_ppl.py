@@ -33,6 +33,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--platform", default="cpu",
                     help="'cpu' (default) or e.g. 'tpu'")
+    ap.add_argument("--isolate-docs", action="store_true",
+                    help="mask cross-document attention in the packed "
+                         "windows (segment_eos_id on the model config) — "
+                         "match this to how the model was TRAINED")
     args = ap.parse_args()
 
     import jax
@@ -87,6 +91,10 @@ def main():
             cfg = GPT2Config.tiny(vocab_size=v,
                                   n_positions=max(64, args.seq))
             params = gpt2_init(jax.random.key(0), cfg)
+        if args.isolate_docs:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, segment_eos_id=eos)
         apply_fn = lambda p, ids: gpt2_apply(p, ids, cfg)  # noqa: E731
     else:
         from quintnet_tpu.models.llama import (LlamaConfig, llama_apply,
@@ -104,6 +112,10 @@ def main():
         v = -(-max(getattr(tok, "vocab_size", 257), 128) // 8) * 8
         cfg = LlamaConfig.tiny(vocab_size=v,
                                n_positions=max(64, args.seq))
+        if args.isolate_docs:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, segment_eos_id=eos)
         params = llama_init(jax.random.key(0), cfg)
         apply_fn = lambda p, ids: llama_apply(p, ids, cfg)  # noqa: E731
 
