@@ -373,3 +373,38 @@ def test_llama_vp_tp_generate_matches_single_device():
     out = llama_generate_tp(sharded, ids, vp_pad, mesh=mesh,
                             max_new_tokens=5)
     np.testing.assert_array_equal(out, ref)
+
+
+def test_llama_vp_sp_segments_moe_composition():
+    """Capstone composition: Llama-MoE with vocab_parallel AND
+    packed-document isolation on a tp x sp x ep mesh — sharded table,
+    sharded CE, sp-aware global segment ids and expert dispatch in ONE
+    step, loss golden vs single device."""
+    import dataclasses as _dc
+
+    from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
+                                           llama_model_spec)
+
+    base = LlamaConfig.tiny(vocab_size=VOCAB, tie_embeddings=True,
+                            n_experts=4, expert_top_k=2,
+                            expert_capacity=4096, aux_loss_weight=0.0,
+                            segment_eos_id=5)
+    vp_cfg = _dc.replace(base, vocab_parallel=True)
+    params = llama_init(jax.random.key(0), base)
+    ids = np.array(jax.random.randint(jax.random.key(3), (4, 16), 0,
+                                      VOCAB), np.int32)  # writable copy
+    ids[:, 6] = 5  # separator inside every row, off the sp boundary
+    batch = (jnp.asarray(ids), jnp.asarray(ids))
+
+    ref = llama_model_spec(base).loss_fn(params, batch)
+
+    cfg = _config([2, 2, 2], ["tp", "sp", "ep"])
+    strat = get_strategy("auto", cfg)
+    model = llama_model_spec(vp_cfg)
+    opt = optax.sgd(0.05)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch(batch, model)
+    step = strat.make_train_step(model, opt)
+    _, _, loss = step(p, s, b)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-4)
