@@ -340,3 +340,31 @@ def test_gpt2_segment_isolation_pp_raises():
 
     with pytest.raises(NotImplementedError, match="pipeline"):
         gpt2_pipeline_fns(GPT2Config.tiny(segment_eos_id=5))
+
+
+def test_trainer_packed_isolation_end_to_end():
+    """PackedLMDataset -> Trainer with segment_eos_id: the packed
+    pretraining loop with document isolation trains and reduces loss
+    (the llama_pretrain --isolate-docs path, in-process)."""
+    import optax  # noqa: F401  (trainer builds its own optimizer)
+
+    from quintnet_tpu.core.config import Config
+    from quintnet_tpu.data.datasets import ByteTokenizer, PackedLMDataset
+    from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_model_spec
+    from quintnet_tpu.parallel.strategy import get_strategy
+    from quintnet_tpu.train.trainer import Trainer
+
+    tok = ByteTokenizer()
+    texts = ["the quick brown fox " * 4, "jumps over lazy dogs " * 5,
+             "packing sequences tightly " * 3] * 8
+    ds = PackedLMDataset.from_texts(texts, tok, seq_len=32)
+    gcfg = GPT2Config.tiny(vocab_size=264, n_positions=32,
+                           segment_eos_id=tok.eos_token_id)
+    cfg = Config.from_dict({
+        "mesh_dim": [2], "mesh_name": ["dp"],
+        "training": {"batch_size": 8, "epochs": 2, "log_every": 0,
+                     "learning_rate": 3e-3, "optimizer": "adamw"}})
+    trainer = Trainer(cfg, gpt2_model_spec(gcfg),
+                      strategy=get_strategy("dp", cfg), task_type="clm")
+    hist = trainer.fit(lambda ep: ds.batches(8, seed=ep))
+    assert hist.train_loss[-1] < hist.train_loss[0]

@@ -408,3 +408,30 @@ def test_llama_vp_sp_segments_moe_composition():
     step = strat.make_train_step(model, opt)
     _, _, loss = step(p, s, b)
     np.testing.assert_allclose(float(loss), float(ref), rtol=1e-4)
+
+
+def test_gpt2_vp_sp_segments_composition():
+    """GPT-2 twin of the capstone: vocab_parallel + segment isolation
+    on tp x sp, loss golden vs single device."""
+    import dataclasses as _dc
+
+    gcfg = GPT2Config.tiny(vocab_size=VOCAB, segment_eos_id=5)
+    vp_cfg = _dc.replace(gcfg, vocab_parallel=True)
+    params = gpt2_init(jax.random.key(0), gcfg)
+    ids = np.array(jax.random.randint(jax.random.key(3), (4, 16), 0,
+                                      VOCAB), np.int32)
+    ids[:, 6] = 5
+    batch = (jnp.asarray(ids), jnp.asarray(ids))
+
+    ref = gpt2_model_spec(gcfg).loss_fn(params, batch)
+
+    cfg = _config([2, 2], ["tp", "sp"])
+    strat = get_strategy("auto", cfg)
+    model = gpt2_model_spec(vp_cfg)
+    opt = optax.sgd(0.05)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch(batch, model)
+    step = strat.make_train_step(model, opt)
+    _, _, loss = step(p, s, b)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-4)
