@@ -114,6 +114,13 @@ class TrainingConfig:
     # "bfloat16" stores Adam's FIRST moment in bf16 (halves that state;
     # nu stays f32 — second moments span too many decades for bf16)
     adam_mu_dtype: str = "float32"
+    # ZeRO-3/FSDP: block params STORED sharded over dp (one free dim per
+    # leaf) and all-gathered per layer inside the scan body — the
+    # all_gather's vjp is a reduce-scatter, so gradients and optimizer
+    # state arrive/live sharded too (ZeRO-1 falls out for free; use a
+    # plain adam/adamw optimizer name with this, not zero1_*/zero2_*).
+    # Requires dp > 1; not wired under pp (stage fns — loud error).
+    fsdp: bool = False
     # LR schedule (the reference trains at a constant lr everywhere —
     # trainer.py:89, GPT2_Trainer.py:100-104; schedules are an upgrade):
     # constant | cosine | linear. warmup_steps prepends a linear 0->lr

@@ -24,6 +24,7 @@ gpt2_block,gpt2_stage}.py). Notable differences:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
@@ -276,7 +277,7 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
                 ep_axis: Optional[str] = None,
                 remat: "bool | str" = False, use_flash: bool = False,
-                key=None, segment_ids=None):
+                key=None, segment_ids=None, fsdp=None):
     """Returns ``h`` for dense configs, ``(h, moe_aux)`` when
     ``cfg.n_experts > 0``. ``key`` enables training dropout."""
     tp = 1 if tp_axis is None else jax.lax.axis_size(tp_axis)
@@ -298,6 +299,7 @@ def gpt2_blocks(params_blocks, h, cfg: GPT2Config, *,
         key=key,
         scan_unroll=cfg.scan_unroll,
         segment_ids=segment_ids,
+        fsdp=fsdp,
     )
 
 
@@ -334,7 +336,8 @@ def gpt2_hidden(params, input_ids, cfg: GPT2Config, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None, sp_mode: str = "ring",
                 ep_axis: Optional[str] = None,
-                remat: "bool | str" = False, use_flash: bool = False, key=None):
+                remat: "bool | str" = False, use_flash: bool = False,
+                key=None, fsdp=None):
     """embed + blocks -> (final hidden states [B, T, D], moe_aux); the
     pre-lm-head half of :func:`gpt2_forward` (chunked-CE computes the
     loss straight from these, never building full logits)."""
@@ -348,7 +351,7 @@ def gpt2_hidden(params, input_ids, cfg: GPT2Config, *,
     out = gpt2_blocks(params["blocks"], h, cfg, tp_axis=tp_axis,
                       sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
                       remat=remat, use_flash=use_flash, key=k_blocks,
-                      segment_ids=seg)
+                      segment_ids=seg, fsdp=fsdp)
     return out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
 
 
@@ -356,12 +359,14 @@ def gpt2_forward(params, input_ids, cfg: GPT2Config, *,
                  tp_axis: Optional[str] = None,
                  sp_axis: Optional[str] = None, sp_mode: str = "ring",
                  ep_axis: Optional[str] = None,
-                 remat: "bool | str" = False, use_flash: bool = False, key=None):
+                 remat: "bool | str" = False, use_flash: bool = False,
+                 key=None, fsdp=None):
     """-> (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs.
     ``key``: training-dropout key (None -> deterministic/eval)."""
     h, aux = gpt2_hidden(params, input_ids, cfg, tp_axis=tp_axis,
                          sp_axis=sp_axis, sp_mode=sp_mode, ep_axis=ep_axis,
-                         remat=remat, use_flash=use_flash, key=key)
+                         remat=remat, use_flash=use_flash, key=key,
+                         fsdp=fsdp)
     return gpt2_logits(params, h, cfg), aux
 
 
@@ -535,7 +540,8 @@ def perplexity(loss):
 def gpt2_partition_specs(cfg: Optional[GPT2Config] = None, *,
                          tp_axis: Optional[str] = "tp",
                          pp_axis: Optional[str] = None,
-                         ep_axis: Optional[str] = None):
+                         ep_axis: Optional[str] = None,
+                         fsdp_axis: Optional[str] = None):
     from jax.sharding import PartitionSpec as P
 
     from quintnet_tpu.parallel.tp import block_specs
@@ -547,6 +553,10 @@ def gpt2_partition_specs(cfg: Optional[GPT2Config] = None, *,
         del bspecs["mlp"]
         bspecs["moe"] = moe_specs(ep_axis=ep_axis, tp_axis=tp_axis,
                                   stacked=True, pp_axis=pp_axis)
+    if fsdp_axis is not None:
+        from quintnet_tpu.parallel.tp import fsdp_shard_specs
+
+        bspecs = fsdp_shard_specs(bspecs, fsdp_axis)
     wte_spec = P()
     if cfg is not None and cfg.vocab_parallel and tp_axis is not None:
         # vocab dim sharded over tp; grads stay un-psummed over tp by
@@ -694,6 +704,13 @@ def gpt2_pipeline_fns(cfg: GPT2Config, *, tp_axis: Optional[str] = None,
     return embed_fn, stage_fn, head_loss_fn
 
 
+def _fsdp_info(cfg: "GPT2Config", tp_axis, ep_axis, fsdp_axis):
+    from quintnet_tpu.parallel.tp import fsdp_info
+
+    return fsdp_info(functools.partial(gpt2_partition_specs, cfg),
+                     fsdp_axis, tp_axis=tp_axis, ep_axis=ep_axis)
+
+
 def gpt2_model_spec(cfg: GPT2Config, *, remat: "bool | str" = False,
                     use_flash: bool = False, sp_mode: str = "ring",
                     compute_dtype=None):
@@ -702,21 +719,23 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: "bool | str" = False,
     from quintnet_tpu.parallel.strategy import ModelSpec
 
     def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
-                key=None):
+                key=None, fsdp_axis=None):
         input_ids, labels = batch
         p = _cast_tree(params, compute_dtype)
+        fsdp = _fsdp_info(cfg, tp_axis, ep_axis, fsdp_axis)
         vp = cfg.vocab_parallel and tp_axis is not None
         if cfg.loss_chunk > 0 and not vp and sp_axis is None:
             h, aux = gpt2_hidden(p, input_ids, cfg, tp_axis=tp_axis,
                                  sp_axis=sp_axis, sp_mode=sp_mode,
                                  ep_axis=ep_axis, remat=remat,
-                                 use_flash=use_flash, key=key)
+                                 use_flash=use_flash, key=key, fsdp=fsdp)
             return clm_loss_chunked(p, h, labels, cfg,
                                     chunk=cfg.loss_chunk) + aux
         logits, aux = gpt2_forward(p, input_ids, cfg, tp_axis=tp_axis,
                                    sp_axis=sp_axis, sp_mode=sp_mode,
                                    ep_axis=ep_axis, remat=remat,
-                                   use_flash=use_flash, key=key)
+                                   use_flash=use_flash, key=key,
+                                   fsdp=fsdp)
         if vp:
             return clm_loss_vp(
                 logits, labels, tp_axis=tp_axis, sp_axis=sp_axis,
@@ -740,9 +759,10 @@ def gpt2_model_spec(cfg: GPT2Config, *, remat: "bool | str" = False,
     return ModelSpec(
         init=lambda key: gpt2_init(key, cfg),
         loss_fn=loss_fn,
-        partition_specs=lambda tp_axis=None, pp_axis=None, ep_axis=None:
+        partition_specs=lambda tp_axis=None, pp_axis=None, ep_axis=None, \
+                fsdp_axis=None:
             gpt2_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
-                                 ep_axis=ep_axis),
+                                 ep_axis=ep_axis, fsdp_axis=fsdp_axis),
         pipeline_fns=pipeline_fns,
         to_tp_layout=lambda p, tp: gpt2_to_tp_layout(p, cfg, tp),
         depth=cfg.n_layer,

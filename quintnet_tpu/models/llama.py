@@ -448,7 +448,8 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
                  tp_axis: Optional[str] = None,
                  sp_axis: Optional[str] = None, sp_mode: str = "ring",
                  ep_axis: Optional[str] = None,
-                 remat: "bool | str" = False, use_flash: bool = False):
+                 remat: "bool | str" = False, use_flash: bool = False,
+                 fsdp=None):
     """-> (final hidden states, moe aux total — 0.0 for dense)."""
     b, s = input_ids.shape
     if cfg.vocab_parallel and tp_axis is not None:
@@ -472,7 +473,7 @@ def llama_hidden(params, input_ids, cfg: LlamaConfig, *,
     out = stacked_blocks_apply(
         params["blocks"], h, num_heads=0, body_fn=body, remat=remat,
         moe_args=cfg.moe_args, sp_axis=sp_axis,
-        scan_unroll=cfg.scan_unroll)
+        scan_unroll=cfg.scan_unroll, fsdp=fsdp)
     return out if cfg.n_experts > 0 else (out, jnp.zeros((), jnp.float32))
 
 
@@ -510,7 +511,8 @@ def llama_apply(params, input_ids, cfg: LlamaConfig, *,
 def llama_partition_specs(cfg: Optional[LlamaConfig] = None, *,
                           tp_axis: Optional[str] = "tp",
                           pp_axis: Optional[str] = None,
-                          ep_axis: Optional[str] = None):
+                          ep_axis: Optional[str] = None,
+                          fsdp_axis: Optional[str] = None):
     from jax.sharding import PartitionSpec as P
 
     t = tp_axis
@@ -530,6 +532,10 @@ def llama_partition_specs(cfg: Optional[LlamaConfig] = None, *,
     else:
         blocks["mlp"] = {"gate": {"w": col}, "up": {"w": col},
                          "down": {"w": row}}
+    if fsdp_axis is not None:
+        from quintnet_tpu.parallel.tp import fsdp_shard_specs
+
+        blocks = fsdp_shard_specs(blocks, fsdp_axis)
     vp = cfg is not None and cfg.vocab_parallel and tp_axis is not None
     specs = {
         # vp: vocab dim sharded over tp; grads stay un-psummed over tp
@@ -567,13 +573,19 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
         return cast_floating(p, compute_dtype) if compute_dtype else p
 
     def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
-                key=None):
+                key=None, fsdp_axis=None):
         del key
         input_ids, labels = batch
+        import functools as _ft
+
+        from quintnet_tpu.parallel.tp import fsdp_info
+
+        fsdp = fsdp_info(_ft.partial(llama_partition_specs, cfg),
+                         fsdp_axis, tp_axis=tp_axis, ep_axis=ep_axis)
         h, aux = llama_hidden(cast(params), input_ids, cfg,
                               tp_axis=tp_axis, sp_axis=sp_axis,
                               sp_mode=sp_mode, ep_axis=ep_axis,
-                              remat=remat, use_flash=use_flash)
+                              remat=remat, use_flash=use_flash, fsdp=fsdp)
         logits = llama_logits(cast(params), h, cfg)
         if cfg.vocab_parallel and tp_axis is not None:
             return clm_loss_vp(
@@ -652,9 +664,10 @@ def llama_model_spec(cfg: LlamaConfig, *, remat: "bool | str" = False,
     return ModelSpec(
         init=lambda key: llama_init(key, cfg),
         loss_fn=loss_fn,
-        partition_specs=lambda tp_axis=None, pp_axis=None, ep_axis=None:
+        partition_specs=lambda tp_axis=None, pp_axis=None, ep_axis=None, \
+                fsdp_axis=None:
             llama_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
-                                  ep_axis=ep_axis),
+                                  ep_axis=ep_axis, fsdp_axis=fsdp_axis),
         pipeline_fns=pipeline_fns,
         to_tp_layout=lambda p, tp: _validate_tp(cfg, tp, p),
         depth=cfg.n_layers,

@@ -159,6 +159,7 @@ def vit_forward(
     remat: bool = False,
     compute_dtype=None,
     key=None,
+    fsdp=None,
 ):
     """[B, H, W, C] (or [B, C, H, W] — auto-detected) ->
     (logits, moe_aux). ``moe_aux`` is 0.0 for dense configs; with
@@ -201,6 +202,7 @@ def vit_forward(
         resid_pdrop=cfg.dropout,
         key=k_blocks,
         scan_unroll=cfg.scan_unroll,
+        fsdp=fsdp,
     )
     x, aux = out if cfg.n_experts > 0 else (out,
                                             jnp.zeros((), jnp.float32))
@@ -220,7 +222,8 @@ def vit_apply(params, images, cfg: ViTConfig, *,
 def vit_partition_specs(cfg: Optional[ViTConfig] = None, *,
                         tp_axis: Optional[str] = "tp",
                         pp_axis: Optional[str] = None,
-                        ep_axis: Optional[str] = None):
+                        ep_axis: Optional[str] = None,
+                        fsdp_axis: Optional[str] = None):
     """PartitionSpec tree matching :func:`vit_init`'s param tree.
 
     Embedding and head are small -> replicated (the reference replicates
@@ -239,6 +242,10 @@ def vit_partition_specs(cfg: Optional[ViTConfig] = None, *,
         del bspecs["mlp"]
         bspecs["moe"] = moe_specs(ep_axis=ep_axis, tp_axis=tp_axis,
                                   stacked=True, pp_axis=pp_axis)
+    if fsdp_axis is not None:
+        from quintnet_tpu.parallel.tp import fsdp_shard_specs
+
+        bspecs = fsdp_shard_specs(bspecs, fsdp_axis)
     return {
         "embedding": {
             "patch": {"w": P(), "b": P()},
@@ -324,28 +331,40 @@ def vit_model_spec(cfg: ViTConfig, *, remat: bool = False):
     (parallel/strategy.py)."""
     from quintnet_tpu.parallel.strategy import ModelSpec
 
+    def _fsdp(tp_axis, ep_axis, fsdp_axis):
+        import functools as _ft
+
+        from quintnet_tpu.parallel.tp import fsdp_info
+
+        return fsdp_info(_ft.partial(vit_partition_specs, cfg),
+                         fsdp_axis, tp_axis=tp_axis, ep_axis=ep_axis)
+
     def loss_fn(params, batch, tp_axis=None, sp_axis=None, ep_axis=None,
-                key=None):
+                key=None, fsdp_axis=None):
         x, y = batch
         logits, aux = vit_forward(params, x, cfg, tp_axis=tp_axis,
-                                  ep_axis=ep_axis, remat=remat, key=key)
+                                  ep_axis=ep_axis, remat=remat, key=key,
+                                  fsdp=_fsdp(tp_axis, ep_axis, fsdp_axis))
         return cross_entropy_loss(logits, y) + aux
 
     def pipeline_fns(tp_axis=None, sp_axis=None, ep_axis=None):
         return vit_pipeline_fns(cfg, tp_axis=tp_axis, ep_axis=ep_axis,
                                 remat=remat)
 
-    def partition_specs(tp_axis=None, pp_axis=None, ep_axis=None):
+    def partition_specs(tp_axis=None, pp_axis=None, ep_axis=None,
+                        fsdp_axis=None):
         return vit_partition_specs(cfg, tp_axis=tp_axis, pp_axis=pp_axis,
-                                   ep_axis=ep_axis)
+                                   ep_axis=ep_axis, fsdp_axis=fsdp_axis)
 
     def to_tp_layout(params, tp):
         return vit_to_tp_layout(params, cfg, tp)
 
     def eval_metrics_fn(params, batch, tp_axis=None, sp_axis=None,
-                        ep_axis=None):
+                        ep_axis=None, fsdp_axis=None):
         x, y = batch
-        logits = vit_apply(params, x, cfg, tp_axis=tp_axis, remat=remat)
+        logits, _ = vit_forward(params, x, cfg, tp_axis=tp_axis,
+                                ep_axis=ep_axis, remat=remat,
+                                fsdp=_fsdp(tp_axis, ep_axis, fsdp_axis))
         return {"loss": cross_entropy_loss(logits, y),
                 "accuracy": accuracy(logits, y)}
 

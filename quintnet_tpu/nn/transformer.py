@@ -124,6 +124,7 @@ def stacked_blocks_apply(
     scan_unroll: int = 1,
     body_fn: Optional[Callable] = None,
     segment_ids=None,
+    fsdp=None,
 ):
     """Run a [depth, ...]-stacked block pytree with lax.scan.
 
@@ -150,6 +151,19 @@ def stacked_blocks_apply(
 
     ``key``: dropout base key; split into one key per layer (rides the
     scan alongside the params). None -> deterministic.
+
+    ``fsdp``: ``(axis_name, gather_dims_tree)`` — ZeRO-3/FSDP: the
+    stacked block params arrive SHARDED over the axis (one dim per
+    leaf, parallel/tp.py fsdp_shard_specs) and each layer is
+    all-gathered HERE, inside the scan body, just before use — O(one
+    layer) transient full weights instead of the whole stack. The
+    all_gather's vjp is a reduce-scatter, so gradients leave the body
+    already sharded (train_step's reduce rule divides the dp sum back
+    to a mean) and optimizer state shards for free. The gather sits
+    INSIDE the remat boundary, so backward re-gathers rather than
+    storing full layers. ``gather_dims_tree``: per-leaf PER-LAYER dim
+    to gather (-1 = leaf not sharded; parallel/tp.py
+    fsdp_gather_dims).
     """
     depth = jax.tree.leaves(stacked_params)[0].shape[0]
     body = body_fn if body_fn is not None else partial(
@@ -167,6 +181,19 @@ def stacked_blocks_apply(
         resid_pdrop=resid_pdrop,
         segment_ids=segment_ids,
     )
+    if fsdp is not None:
+        from quintnet_tpu.core import collectives as cc
+
+        f_axis, f_dims = fsdp
+        inner_body = body
+
+        def body(blk_p, h, key=None):
+            blk_p = jax.tree.map(
+                lambda x, dim: (cc.all_gather(x, f_axis, gather_dim=dim)
+                                if dim >= 0 else x),
+                blk_p, f_dims)
+            return inner_body(blk_p, h, key=key)
+
     if remat == "dots":
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.dots_saveable)

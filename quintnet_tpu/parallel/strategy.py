@@ -115,12 +115,24 @@ class Strategy:
     def axis_or_none(self, axis: str) -> Optional[str]:
         return axis if self.mesh.shape.get(axis, 1) > 1 else None
 
+    @property
+    def fsdp_axis(self) -> Optional[str]:
+        """ZeRO-3/FSDP (training.fsdp): block params stored dp-sharded,
+        per-layer all-gather inside the scan (nn/transformer.py)."""
+        if self.config.training.fsdp and self.mesh.shape.get("dp", 1) > 1:
+            return "dp"
+        return None
+
     # -- placement helpers -------------------------------------------------
     def param_specs(self, model: ModelSpec):
+        kw = {}
+        if self.fsdp_axis is not None:
+            kw["fsdp_axis"] = self.fsdp_axis
         return model.partition_specs(
             tp_axis=self.axis_or_none("tp"),
             pp_axis=self.axis_or_none("pp"),
             ep_axis=self.axis_or_none("ep"),
+            **kw,
         )
 
     @property
@@ -229,6 +241,24 @@ class Strategy:
         tp_axis = self.axis_or_none("tp")
         sp_axis = self.axis_or_none("sp")
         ep_axis = self.axis_or_none("ep")
+        if self.config.training.fsdp and self.fsdp_axis is None:
+            raise ValueError(
+                "training.fsdp requires a dp mesh axis of size > 1 "
+                f"(mesh: {dict(self.mesh.shape)}); with no dp axis "
+                "there is nothing to shard over — remove the flag or "
+                "add dp")
+        if self.fsdp_axis is not None:
+            if self.uses_pp:
+                raise NotImplementedError(
+                    "training.fsdp under pipeline parallelism is not "
+                    "wired (stage fns receive raw block shards); use "
+                    "dp/tp/sp/ep meshes, or zero1_*/zero2_* optimizers "
+                    "with pp")
+            if self.zero1_axis is not None:
+                raise ValueError(
+                    "training.fsdp already shards gradients and "
+                    "optimizer state over dp (ZeRO-3 subsumes 1/2); "
+                    "use a plain adam/adamw optimizer name with fsdp")
         specs = self.param_specs(model)
 
         if self.uses_pp:
@@ -267,9 +297,13 @@ class Strategy:
                 needs_rng=model.needs_rng,
             )
 
+        fsdp_kw = ({"fsdp_axis": self.fsdp_axis}
+                   if self.fsdp_axis is not None else {})
+
         def loss(params, batch, key=None):
             return model.loss_fn(params, batch, tp_axis=tp_axis,
-                                 sp_axis=sp_axis, ep_axis=ep_axis, key=key)
+                                 sp_axis=sp_axis, ep_axis=ep_axis, key=key,
+                                 **fsdp_kw)
 
         return make_parallel_train_step(
             self.mesh, loss, optimizer, specs,

@@ -188,3 +188,61 @@ def block_specs(*, tp_axis="tp", stacked=True, pp_axis=None):
             "proj": row_spec(tp_axis=tp_axis, **kw),
         },
     }
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-3 / FSDP spec transforms
+
+
+def fsdp_shard_specs(specs_tree, axis: str):
+    """Insert ``axis`` into the first free (None) dim >= 1 of every
+    stacked-leaf PartitionSpec — the ZeRO-3/FSDP storage layout: each
+    block leaf keeps 1/axis_size of one dimension resident, and the
+    scan body all-gathers the layer just before use
+    (nn/transformer.py stacked_blocks_apply ``fsdp``). Leaves with no
+    free dim (e.g. a tp-sharded bias vector) stay replicated — still
+    correct, just not sharded."""
+
+    def one(spec):
+        parts = list(spec)
+        for i in range(1, len(parts)):
+            if parts[i] is None:
+                parts[i] = axis
+                return jax.sharding.PartitionSpec(*parts)
+        return spec
+
+    return jax.tree.map(one, specs_tree,
+                        is_leaf=lambda x: isinstance(
+                            x, jax.sharding.PartitionSpec))
+
+
+def fsdp_gather_dims(specs_tree, axis: str):
+    """Per-leaf gather dim for the PER-LAYER view (stacked dim 0
+    removed): index of ``axis`` in the spec minus 1, or -1 when the
+    leaf is not fsdp-sharded (no gather)."""
+
+    def one(spec):
+        for i, part in enumerate(spec):
+            present = (part == axis if not isinstance(part, (tuple, list))
+                       else axis in part)
+            if present:
+                return i - 1
+        return -1
+
+    return jax.tree.map(one, specs_tree,
+                        is_leaf=lambda x: isinstance(
+                            x, jax.sharding.PartitionSpec))
+
+
+def fsdp_info(partition_specs_fn, fsdp_axis, **spec_kw):
+    """(axis, per-leaf gather dims) for stacked_blocks_apply, or None.
+
+    One derivation for every model family: rebuilds the blocks subtree
+    through the SAME spec builder that lays the storage out (pp_axis
+    None — fsdp+pp is refused upstream), so gather dims can never drift
+    from the sharding."""
+    if fsdp_axis is None:
+        return None
+    bspecs = partition_specs_fn(pp_axis=None, fsdp_axis=fsdp_axis,
+                                **spec_kw)["blocks"]
+    return (fsdp_axis, fsdp_gather_dims(bspecs, fsdp_axis))

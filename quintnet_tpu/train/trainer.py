@@ -279,6 +279,8 @@ class Trainer:
         tp_axis = strat.axis_or_none("tp")
         sp_axis = strat.axis_or_none("sp")
         ep_axis = strat.axis_or_none("ep")
+        fsdp_kw = ({"fsdp_axis": strat.fsdp_axis}
+                   if strat.fsdp_axis is not None else {})
 
         if strat.uses_pp:
             from quintnet_tpu.parallel.pp import (PipelineSpec,
@@ -309,11 +311,13 @@ class Trainer:
         elif self.model.eval_metrics_fn is not None:
             def metrics_fn(p, b):
                 return self.model.eval_metrics_fn(
-                    p, b, tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)
+                    p, b, tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis,
+                    **fsdp_kw)
         else:
             def metrics_fn(p, b):
                 return {"loss": self.model.loss_fn(
-                    p, b, tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis)}
+                    p, b, tp_axis=tp_axis, sp_axis=sp_axis, ep_axis=ep_axis,
+                    **fsdp_kw)}
 
         def local_eval(p, b):
             mets = metrics_fn(p, b)

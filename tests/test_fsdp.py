@@ -1,0 +1,220 @@
+"""ZeRO-3 / FSDP golden tests (training.fsdp).
+
+Block params are STORED dp-sharded (parallel/tp.py fsdp_shard_specs)
+and all-gathered per layer inside the scan body
+(nn/transformer.py stacked_blocks_apply) — the all_gather's vjp is a
+reduce-scatter, so gradients and the optimizer state live sharded too.
+The reference's ZeRO file is an empty stub (optimizers/zero.py); this
+is the stage-3 capability on top of the round-4 ZeRO-1/2.
+
+Golden bar: same as every other axis — loss AND updated parameters
+must match single-device training exactly (up to float reassociation).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from quintnet_tpu.core.config import Config
+from quintnet_tpu.models.gpt2 import (GPT2Config, gpt2_init,
+                                      gpt2_model_spec,
+                                      gpt2_partition_specs, gpt2_to_tp_layout)
+from quintnet_tpu.parallel.strategy import get_strategy
+from quintnet_tpu.parallel.tp import fsdp_gather_dims, fsdp_shard_specs
+
+VOCAB = 128
+TINY = GPT2Config.tiny(vocab_size=VOCAB)
+
+
+def _config(mesh_dim, mesh_name, fsdp=True, optimizer="adamw"):
+    return Config.from_dict({
+        "mesh_dim": list(mesh_dim), "mesh_name": list(mesh_name),
+        "training": {"batch_size": 8, "fsdp": fsdp,
+                     "optimizer": optimizer, "grad_clip_norm": 1.0},
+    })
+
+
+def _data(n=8, t=16, seed=3):
+    ids = jax.random.randint(jax.random.key(seed), (n, t), 0, VOCAB)
+    return ids, ids
+
+
+@pytest.mark.fast
+def test_fsdp_spec_transform():
+    """First free dim >= 1 gets the axis; full specs stay untouched."""
+    specs = gpt2_partition_specs(TINY, tp_axis="tp", fsdp_axis="dp")
+    b = specs["blocks"]
+    assert b["attn"]["qkv"]["w"] == P(None, "dp", "tp")
+    assert b["attn"]["proj"]["w"] == P(None, "tp", "dp")
+    assert b["ln1"]["scale"] == P(None, "dp")
+    # column bias [L, 3d/tp] has no free dim -> stays as-is
+    assert "dp" not in (b["attn"]["qkv"]["b"] or ())
+    # embedding/head replicate (vp is the knob for those)
+    assert specs["embedding"]["wte"] == P()
+
+    dims = fsdp_gather_dims(b, "dp")
+    assert dims["attn"]["qkv"]["w"] == 0   # per-layer dim 0
+    assert dims["attn"]["proj"]["w"] == 1  # per-layer dim 1 (tp on 0)
+    assert dims["ln1"]["scale"] == 0
+    assert dims["attn"]["qkv"]["b"] == -1  # not sharded, no gather
+
+
+def _reference_update(params, batch, opt, steps=2):
+    model = gpt2_model_spec(TINY)
+    losses, state = [], opt.init(params)
+    for _ in range(steps):
+        loss, g = jax.value_and_grad(model.loss_fn)(params, batch)
+        g, _ = optax.clip_by_global_norm(1.0).update(g, None)
+        up, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, up)
+        losses.append(float(loss))
+    return losses, params
+
+
+@pytest.mark.parametrize("mesh_dim,mesh_name,name", [
+    ([2], ["dp"], "dp"),
+    ([4], ["dp"], "dp"),
+    ([2, 2], ["dp", "tp"], "dp_tp"),
+    ([2, 2], ["dp", "sp"], "dp_sp"),
+])
+def test_fsdp_matches_single_device(mesh_dim, mesh_name, name):
+    """FSDP training == single-device training: loss and params.
+
+    SGD for the parameter-exactness bar: FSDP grads arrive through a
+    reduce-scatter whose summation order differs from the single-device
+    sum, and Adam's g/sqrt(v) amplifies that reassociation noise on
+    near-zero grads beyond any sensible tolerance (same reasoning as
+    tests/test_zero.py); Adam coverage is the trainer/opt-state tests.
+    """
+    cfg = _config(mesh_dim, mesh_name)
+    params = gpt2_init(jax.random.key(0), TINY)
+    batch = _data()
+    opt = optax.sgd(0.05)
+
+    losses_ref, p_ref = _reference_update(params, batch, opt)
+
+    strat = get_strategy(name, cfg)
+    assert strat.fsdp_axis == "dp"
+    model = gpt2_model_spec(TINY)
+    p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+    s = strat.init_opt_state(model, opt, p)
+    b = strat.shard_batch(batch, model)
+    step = strat.make_train_step(model, opt)
+    losses = []
+    for _ in range(2):
+        p, s, loss = step(p, s, b)
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-4)
+    tp = strat.mesh.shape.get("tp", 1)
+    ref = dict(jax.tree_util.tree_leaves_with_path(
+        gpt2_to_tp_layout(p_ref, TINY, tp)))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(p):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(leaf)), np.asarray(ref[path]),
+            rtol=2e-4, atol=1e-5,
+            err_msg=f"{name}:{jax.tree_util.keystr(path)}")
+
+
+def test_fsdp_params_and_opt_state_are_sharded():
+    """The whole point: resident block params AND adam m/v hold 1/dp of
+    the fsdp-sharded leaves per device."""
+    cfg = _config([2], ["dp"])
+    strat = get_strategy("dp", cfg)
+    model = gpt2_model_spec(TINY)
+    params = strat.shard_params(model, gpt2_init(jax.random.key(0), TINY))
+    opt = optax.adamw(1e-3)
+    state = strat.init_opt_state(model, opt, params)
+
+    w = params["blocks"]["attn"]["qkv"]["w"]       # [L, d, 3d]
+    shard = w.sharding.shard_shape(w.shape)
+    assert shard[1] == w.shape[1] // 2             # dp=2 shards dim 1
+    mu = state[0].mu["blocks"]["attn"]["qkv"]["w"]
+    assert mu.sharding.shard_shape(mu.shape)[1] == mu.shape[1] // 2
+
+
+def test_fsdp_trainer_fit_eval():
+    """Trainer.fit + evaluate under fsdp (eval path gathers too)."""
+    from quintnet_tpu.train.trainer import Trainer
+
+    cfg = Config.from_dict({
+        "mesh_dim": [2], "mesh_name": ["dp"],
+        "training": {"batch_size": 8, "fsdp": True, "optimizer": "adamw",
+                     "learning_rate": 1e-3, "epochs": 1, "log_every": 0},
+    })
+    strat = get_strategy("dp", cfg)
+    trainer = Trainer(cfg, gpt2_model_spec(TINY), strategy=strat,
+                      task_type="clm")
+    ids = np.asarray(_data()[0])
+    hist = trainer.fit(lambda _e: [(ids, ids)], epochs=1,
+                       val_batches_fn=lambda _e: [(ids, ids)])
+    assert np.isfinite(hist.train_loss[0])
+    assert np.isfinite(hist.val_loss[0])
+
+
+def test_fsdp_llama_and_vit_match_single_device():
+    """The other two families run the same scan machinery."""
+    from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
+                                           llama_model_spec)
+    from quintnet_tpu.models.vit import ViTConfig, vit_init, vit_model_spec
+
+    cfg = _config([2], ["dp"])
+    opt = optax.sgd(0.05)
+
+    lcfg = LlamaConfig.tiny(vocab_size=VOCAB)
+    lmodel = llama_model_spec(lcfg)
+    lparams = llama_init(jax.random.key(0), lcfg)
+    batch = _data()
+    ref = lmodel.loss_fn(lparams, batch)
+
+    strat = get_strategy("dp", cfg)
+    p = strat.shard_params(lmodel, jax.tree.map(jnp.copy, lparams))
+    s = strat.init_opt_state(lmodel, opt, p)
+    b = strat.shard_batch(batch, lmodel)
+    _, _, loss = strat.make_train_step(lmodel, opt)(p, s, b)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    vcfg = ViTConfig(image_size=14, patch_size=7, hidden_dim=16, depth=2,
+                     num_heads=2)
+    vmodel = vit_model_spec(vcfg)
+    vparams = vit_init(jax.random.key(0), vcfg)
+    x = jax.random.normal(jax.random.key(1), (8, 14, 14, 1))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 10)
+    vref = vmodel.loss_fn(vparams, (x, y))
+    p = strat.shard_params(vmodel, jax.tree.map(jnp.copy, vparams))
+    s = strat.init_opt_state(vmodel, opt, p)
+    b = strat.shard_batch((x, y), vmodel)
+    _, _, loss = strat.make_train_step(vmodel, opt)(p, s, b)
+    np.testing.assert_allclose(float(loss), float(vref), rtol=1e-5)
+
+
+@pytest.mark.fast
+def test_fsdp_guards():
+    """pp + fsdp and zero-optimizer + fsdp are refused loudly."""
+    model = gpt2_model_spec(TINY)
+    opt = optax.adamw(1e-3)
+
+    cfg = Config.from_dict({
+        "mesh_dim": [2, 2], "mesh_name": ["dp", "pp"],
+        "training": {"batch_size": 8, "fsdp": True,
+                     "gradient_accumulation_steps": 2}})
+    with pytest.raises(NotImplementedError, match="fsdp under pipeline"):
+        get_strategy("dp_pp", cfg).make_train_step(model, opt)
+
+    cfg = _config([2], ["dp"], optimizer="zero1_adamw")
+    with pytest.raises(ValueError, match="subsumes"):
+        get_strategy("dp", cfg).make_train_step(model, opt)
+
+
+@pytest.mark.fast
+def test_fsdp_without_dp_axis_raises():
+    model = gpt2_model_spec(TINY)
+    cfg = _config([2], ["tp"])
+    with pytest.raises(ValueError, match="requires a dp mesh axis"):
+        get_strategy("tp", cfg).make_train_step(model,
+                                                optax.adamw(1e-3))
