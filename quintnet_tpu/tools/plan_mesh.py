@@ -81,7 +81,7 @@ class Plan:
 
 def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
              seq: int, zero1: bool = False, zero_stage: int = 1,
-             remat: bool = True) -> Plan:
+             remat: bool = True, fsdp: bool = False) -> Plan:
     """Per-chip memory + per-step ICI-traffic estimate for one mesh.
 
     Mirrors the real sharding rules: blocks are [tp column/row] x
@@ -102,14 +102,24 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
     b_loc = max(batch // dp, 1)
     s_loc = max(seq // sp, 1)
 
-    master = 4 * local_params                      # f32 master copy
-    compute = 2 * local_params                     # bf16 cast-at-use copy
-    opt = 8 * (local_params // dp if zero1 else local_params)  # adam m+v
-    # ZeRO-2 (zero_stage=2): gradients reduce-scatter into the rank's
-    # chunk and the grad-accumulation buffer is chunk-sized too
-    # (parallel/zero.py accumulate_grads_zero2)
-    grads = 4 * (local_params // dp if (zero1 and zero_stage == 2)
-                 else local_params)
+    if fsdp:
+        # ZeRO-3 (training.fsdp): BLOCK params/grads/opt stored over dp;
+        # embeddings/head replicate (vp is their knob). Transient
+        # full-layer gathers live in the activation working set.
+        resident = block_params // dp + embed_params + 2 * d
+        master = 4 * resident
+        compute = 2 * resident + 2 * (block_params * pp // max(L, 1))
+        opt = 8 * resident
+        grads = 4 * resident
+    else:
+        master = 4 * local_params                  # f32 master copy
+        compute = 2 * local_params                 # bf16 cast-at-use copy
+        opt = 8 * (local_params // dp if zero1 else local_params)  # m+v
+        # ZeRO-2 (zero_stage=2): gradients reduce-scatter into the
+        # rank's chunk and the grad-accumulation buffer is chunk-sized
+        # too (parallel/zero.py accumulate_grads_zero2)
+        grads = 4 * (local_params // dp if (zero1 and zero_stage == 2)
+                     else local_params)
     # activations: the scan stores one residual-stream tensor per layer
     # (bf16) even under full remat (carry boundaries), plus the block
     # working set; dense CE materialises f32 logits unless vp/sp/chunked
@@ -135,7 +145,9 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
     if tp > 1:
         comm += 4 * (L // pp) * act_bytes * 2 * (tp - 1) // tp
     if dp > 1:
-        comm += 2 * 4 * local_params * (dp - 1) // dp
+        # fsdp: per-layer all-gather fwd + (remat) bwd re-gather +
+        # reduce-scatter grads ~ 3x the one grad allreduce's volume
+        comm += (3 if fsdp else 2) * 4 * local_params * (dp - 1) // dp
     if sp > 1:
         comm += (L // pp) * 2 * act_bytes * 2 * (sp - 1) // sp
     if pp > 1:
@@ -146,7 +158,7 @@ def estimate(cfg: GPT2Config, mesh: Dict[str, int], *, batch: int,
 
 def plan(cfg: GPT2Config, *, n_devices: int, batch: int, seq: int,
          hbm_gb: float = DEFAULT_HBM_GB, zero1: bool = False,
-         zero_stage: int = 1,
+         zero_stage: int = 1, fsdp: bool = False,
          remat: bool = True, max_pp: Optional[int] = None,
          use_sp: bool = True) -> List[Plan]:
     """All legal meshes over ``n_devices``, fitting ones first, each
@@ -175,7 +187,8 @@ def plan(cfg: GPT2Config, *, n_devices: int, batch: int, seq: int,
                 out.append(estimate(cfg, {"dp": dp, "tp": tp,
                                           "pp": pp, "sp": sp},
                                     batch=batch, seq=seq, zero1=zero1,
-                                    zero_stage=zero_stage, remat=remat))
+                                    zero_stage=zero_stage, remat=remat,
+                                    fsdp=fsdp))
     out.sort(key=lambda p: (p.bytes_per_chip > hbm,
                             p.comm_bytes_per_step, p.bytes_per_chip))
     return out
@@ -210,6 +223,9 @@ def main(argv=None):
                     help="additionally shard gradients/accumulators "
                          "over dp (implies --zero1)")
     ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3 (training.fsdp): block params stored "
+                         "dp-sharded, per-layer gather in the scan")
     ap.add_argument("--vocab-parallel", action="store_true")
     ap.add_argument("--top", type=int, default=5)
     args = ap.parse_args(argv)
@@ -226,7 +242,7 @@ def main(argv=None):
     plans = plan(cfg, n_devices=args.devices, batch=args.batch,
                  seq=args.seq, hbm_gb=args.hbm_gb,
                  zero1=args.zero1 or args.zero2,
-                 zero_stage=2 if args.zero2 else 1,
+                 zero_stage=2 if args.zero2 else 1, fsdp=args.fsdp,
                  remat=not args.no_remat)
     hbm = args.hbm_gb * GB
     fitting = [p for p in plans if p.bytes_per_chip <= hbm]

@@ -120,3 +120,26 @@ def test_cli_llama_smoke(capsys):
           "--seq", "2048", "--zero1", "--vocab-parallel"])
     out = capsys.readouterr().out
     assert "llama32-1b" in out and "legal meshes fit" in out
+
+
+def test_fsdp_divides_block_param_memory():
+    """--fsdp: master/opt/grads of the block share divide by dp; a
+    dp-heavy fsdp plan needs far less memory than replicated."""
+    from quintnet_tpu.tools.plan_mesh import estimate
+
+    from quintnet_tpu.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config.medium()
+    rep = estimate(cfg, {"dp": 8}, batch=32, seq=512)
+    fs = estimate(cfg, {"dp": 8}, batch=32, seq=512, fsdp=True)
+    assert fs.bytes_per_chip < 0.5 * rep.bytes_per_chip
+    # embeddings stay replicated: fsdp can't go below the embed share
+    assert fs.breakdown["master"] > 0
+
+
+def test_cli_fsdp_smoke(capsys):
+    from quintnet_tpu.tools.plan_mesh import main
+
+    main(["--model", "llama32-1b", "--devices", "16", "--batch", "64",
+          "--seq", "2048", "--fsdp", "--vocab-parallel"])
+    assert "legal meshes fit" in capsys.readouterr().out
