@@ -52,6 +52,8 @@ SLOW_FILES = {
                             # policy goldens promoted fast)
     "test_segments.py",   # packed-segment matrix incl. sp modes (~3 min;
                           # sdpa/host-helper goldens promoted fast)
+    "test_fsdp.py",       # ZeRO-3 golden matrix (~4 min; spec-transform
+                          # + guard tests promoted fast)
 }
 
 
