@@ -30,6 +30,9 @@ def main():
     ap.add_argument("--experts", type=int, default=0,
                     help="n_experts: Mixtral-style SwiGLU-MoE blocks "
                          "(add an 'ep' axis to --mesh to shard them)")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3: store block params dp-sharded, gather "
+                         "per layer in the scan (training.fsdp)")
     ap.add_argument("--isolate-docs", action="store_true",
                     help="mask cross-document attention in the packed "
                          "rows (segment ids derived from the EOS "
@@ -66,10 +69,12 @@ def main():
         "mesh_dim": dims, "mesh_name": names,
         "training": {
             "batch_size": args.batch, "epochs": args.epochs,
-            "optimizer": "zero2_adamw", "learning_rate": 3e-3,
+            "optimizer": ("adamw" if args.fsdp else "zero2_adamw"),
+            "learning_rate": 3e-3,
             "lr_schedule": "cosine", "warmup_steps": 10,
             "decay_steps": 200, "grad_clip_norm": 1.0,
             "sp_mode": "zigzag", "log_every": 20,
+            "fsdp": args.fsdp,
         },
     })
     # vocab 257+pad to 264 covers the byte tokenizer; n_kv < n_heads
