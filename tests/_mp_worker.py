@@ -66,27 +66,41 @@ def main():
             out_shardings=NamedSharding(mesh, P()))
         return float(fn(p))
 
+    cfg_fsdp = Config.from_dict({
+        "mesh_dim": [4, 2],
+        "mesh_name": ["dp", "tp"],
+        "training": {"batch_size": 16, "fsdp": True,
+                     "gradient_accumulation_steps": 1,
+                     "grad_clip_norm": None},
+    })
+    strat_fsdp = get_strategy("dp_tp", cfg_fsdp)
+    step_fsdp = strat_fsdp.make_train_step(model, opt)
+
     results = {}
-    for mode in ("global", "local"):
-        params = strat.shard_params(model, vit_init(jax.random.key(0),
-                                                    cfg_model))
-        opt_state = strat.init_opt_state(model, opt, params)
+    for mode in ("global", "local", "fsdp"):
+        st = strat_fsdp if mode == "fsdp" else strat
+        stp = step_fsdp if mode == "fsdp" else step
+        params = st.shard_params(model, vit_init(jax.random.key(0),
+                                                 cfg_model))
+        opt_state = st.init_opt_state(model, opt, params)
         losses = []
         for _ in range(2):
-            if mode == "global":
-                b = strat.shard_batch((x, y), model)
-            else:
+            if mode == "local":
                 # true per-host feeding: this process passes ONLY its rows
                 from quintnet_tpu.core.runtime import host_local_slice
 
-                specs = strat.batch_partition_specs(model)
-                shard_x = NamedSharding(strat.mesh, specs)
+                specs = st.batch_partition_specs(model)
+                shard_x = NamedSharding(st.mesh, specs)
                 sl = host_local_slice(shard_x, x.shape)
-                b = strat.shard_batch_local((x[sl], y[sl[:1]]), model)
-            params, opt_state, loss = step(params, opt_state, b)
+                b = st.shard_batch_local((x[sl], y[sl[:1]]), model)
+            else:
+                # "fsdp": ZeRO-3 param storage over the multi-process dp
+                # axis — gathers cross the process boundary (gloo)
+                b = st.shard_batch((x, y), model)
+            params, opt_state, loss = stp(params, opt_state, b)
             losses.append(float(loss))
         results[mode] = {"losses": losses,
-                         "param_sqsum": param_sqsum(strat.mesh, params)}
+                         "param_sqsum": param_sqsum(st.mesh, params)}
 
     with open(outfile, "w") as f:
         json.dump({"process": proc_id, **results}, f)

@@ -84,7 +84,7 @@ def test_two_process_dp_tp_matches_single_process(tmp_path):
     for i in range(2):
         with open(outs[i]) as f:
             res = json.load(f)
-        for mode in ("global", "local"):
+        for mode in ("global", "local", "fsdp"):
             np.testing.assert_allclose(
                 res[mode]["losses"], ref_losses, rtol=1e-5,
                 err_msg=f"worker {i} mode {mode} losses")
