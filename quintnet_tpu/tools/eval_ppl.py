@@ -101,14 +101,31 @@ def main():
                                                llama_init)
 
         if args.checkpoint:
-            # a silently-random model masquerading as the checkpoint is
-            # worse than an error; llama loading takes an HF DIRECTORY
-            # (config + weights), not a bare safetensors file
-            raise SystemExit(
-                "--checkpoint with --family llama is not supported by "
-                "this tool yet — load via transformers + "
-                "models/llama.llama_from_hf_state (see "
-                "tools/verify_llama.py --hf-dir for the pattern)")
+            # llama loading takes an HF DIRECTORY (config + weights);
+            # load via transformers, import through llama_from_hf_state
+            import os as _os
+
+            if not _os.path.isdir(args.checkpoint):
+                raise SystemExit(
+                    f"--family llama --checkpoint wants an HF model "
+                    f"DIRECTORY, got {args.checkpoint!r}")
+            import torch
+            import transformers
+
+            from quintnet_tpu.models.llama import llama_from_hf_state
+
+            hf = transformers.LlamaForCausalLM.from_pretrained(
+                args.checkpoint, torch_dtype=torch.float32).eval()
+            cfg = LlamaConfig.from_hf_config(hf.config)
+            params = llama_from_hf_state(hf.state_dict(), cfg)
+            if args.isolate_docs:
+                import dataclasses
+
+                cfg = dataclasses.replace(cfg, segment_eos_id=eos)
+            apply_fn = lambda p, ids: llama_apply(p, ids, cfg)  # noqa: E731
+            _run_eval(args, jax, jnp, np, clm_loss, IGNORE_INDEX, rows,
+                      labels, params, apply_fn)
+            return
         v = -(-max(getattr(tok, "vocab_size", 257), 128) // 8) * 8
         cfg = LlamaConfig.tiny(vocab_size=v,
                                n_positions=max(64, args.seq))
@@ -119,6 +136,12 @@ def main():
         params = llama_init(jax.random.key(0), cfg)
         apply_fn = lambda p, ids: llama_apply(p, ids, cfg)  # noqa: E731
 
+    _run_eval(args, jax, jnp, np, clm_loss, IGNORE_INDEX, rows, labels,
+              params, apply_fn)
+
+
+def _run_eval(args, jax, jnp, np, clm_loss, IGNORE_INDEX, rows, labels,
+              params, apply_fn):
     @jax.jit
     def batch_loss(p, ids, lab):
         return clm_loss(apply_fn(p, ids), lab)
