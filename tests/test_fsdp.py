@@ -311,3 +311,31 @@ def test_fsdp_moe_ep_matches_single_device():
     b = strat.shard_batch(batch, model)
     _, _, loss = strat.make_train_step(model, opt)(p, s, b)
     np.testing.assert_allclose(float(loss), float(ref), rtol=1e-4)
+
+
+@pytest.mark.parametrize("remat", [True, "dots"])
+def test_fsdp_remat_matches_plain(remat):
+    """The per-layer gather sits INSIDE the checkpoint boundary —
+    backward re-gathers. Loss under remat must equal the plain fsdp
+    path exactly."""
+    cfg = _config([2], ["dp"])
+    params = gpt2_init(jax.random.key(0), TINY)
+    batch = _data()
+    opt = optax.sgd(0.05)
+
+    def run(model):
+        strat = get_strategy("dp", cfg)
+        p = strat.shard_params(model, jax.tree.map(jnp.copy, params))
+        s = strat.init_opt_state(model, opt, p)
+        b = strat.shard_batch(batch, model)
+        p, s, loss = strat.make_train_step(model, opt)(p, s, b)
+        return float(loss), p
+
+    loss_plain, p_plain = run(gpt2_model_spec(TINY))
+    loss_remat, p_remat = run(gpt2_model_spec(TINY, remat=remat))
+    np.testing.assert_allclose(loss_remat, loss_plain, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+            rtol=1e-5, atol=1e-6),
+        p_remat, p_plain)
