@@ -40,6 +40,25 @@ COMMITTED_BASELINES = {
 HEADLINE_METRIC = "gpt2_124m_seq512_train_samples_per_sec_per_chip"
 
 
+def _parse_as_of(s):
+    """ISO timestamp -> aware UTC datetime for ordering. Git emits
+    committer-local offsets (`%cI`), mtime fallbacks are naive local
+    time; lexicographic comparison of such mixed strings picks the
+    wrong "newest" (e.g. "2026-07-01T09:00:00+09:00" sorts before
+    "2026-06-30T21:00:00-08:00" despite being later). Parse, treat
+    naive as local, normalize to UTC. Unparseable -> epoch (never
+    beats a real timestamp)."""
+    import datetime
+
+    try:
+        dt = datetime.datetime.fromisoformat(s)
+    except (TypeError, ValueError):
+        return datetime.datetime.fromtimestamp(0, datetime.timezone.utc)
+    if dt.tzinfo is None:
+        dt = dt.astimezone()  # naive (mtime fallback) = local time
+    return dt.astimezone(datetime.timezone.utc)
+
+
 def last_known_result(art_dir=None, metric=HEADLINE_METRIC):
     """Most recent committed measurement of ``metric`` from
     artifacts/*.json, clearly labelled stale.
@@ -85,11 +104,14 @@ def last_known_result(art_dir=None, metric=HEADLINE_METRIC):
 
             as_of = datetime.datetime.fromtimestamp(
                 os.path.getmtime(path)).isoformat()
+        as_of_dt = _parse_as_of(as_of)
         for r in hits:
-            # prefer newest artifact, then records measured under the
-            # committed-baseline config (extras.baseline set), then rate
+            # prefer newest artifact (by PARSED timestamp — mixed git
+            # offsets / naive mtimes don't sort lexicographically), then
+            # records measured under the committed-baseline config
+            # (extras.baseline set), then rate
             default_cfg = (r.get("extras") or {}).get("baseline") is not None
-            key = (as_of, default_cfg, r.get("value", 0.0))
+            key = (as_of_dt, default_cfg, r.get("value", 0.0))
             if best is None or key > best[0]:
                 best = (key, {
                     "stale": True,

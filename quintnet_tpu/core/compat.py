@@ -1,0 +1,55 @@
+"""JAX version-compat shims, installed once at package import.
+
+The library is written against the current jax API; older releases in
+the supported window miss a few late additions. Everything here is a
+no-op on a recent jax — each shim checks for the real attribute first
+and installs a semantically identical fallback only when absent, so the
+~40 call sites across the codebase stay on the canonical spelling
+(``lax.axis_size`` etc.) instead of importing a compat veneer.
+
+Shimmed:
+- ``jax.lax.axis_size(name)`` — static named-axis size. Older jax
+  exposes it as ``jax.core.axis_frame(name)`` (which, pre-0.5, returns
+  the size int directly for a string axis name).
+- ``jax.shard_map`` — older jax only has ``jax.experimental.
+  shard_map.shard_map`` with the ``check_rep`` knob. A plain attribute
+  alias would be wrong (the kwarg was renamed to ``check_vma``), so the
+  shim is a translating wrapper.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _axis_size_fallback(axis_name):
+    """Static size of a named mesh axis inside shard_map (old-jax path:
+    ``jax.core.axis_frame`` resolves the name in the current axis env
+    and hands back the python-int size — usable for shape math)."""
+    from jax import core
+
+    frame = core.axis_frame(axis_name)
+    # pre-0.5 returns the int size; guard in case of a frame object
+    return frame if isinstance(frame, int) else frame.size
+
+
+def _shard_map_fallback(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` spelled via the experimental module: same
+    semantics, with the current ``check_vma`` kwarg translated to the
+    old name ``check_rep``."""
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check_vma)
+
+
+def install() -> None:
+    """Idempotently install the shims. Called from quintnet_tpu/__init__;
+    safe to call again (re-checks, never double-wraps)."""
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size_fallback
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_fallback
+
+
+install()

@@ -37,8 +37,8 @@ def main():
                     help="mask cross-document attention in the packed "
                          "rows (segment ids derived from the EOS "
                          "separator; default: GPT-2-style cross-doc "
-                         "attention). Not compatible with an sp mesh "
-                         "axis.")
+                         "attention). Works under any mesh incl. sp "
+                         "(sp-aware segment ids are golden-tested).")
     args = ap.parse_args()
 
     from quintnet_tpu.examples.common import setup_platform

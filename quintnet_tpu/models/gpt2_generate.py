@@ -63,8 +63,12 @@ def sample_logits(logits, key, *, temperature: float = 1.0,
         srt, idx = lax.top_k(logits, logits.shape[-1])  # desc sort
         probs = jax.nn.softmax(srt, axis=-1)
         # drop tokens whose preceding cumulative mass already reached
-        # top_p (the first token crossing the threshold is KEPT)
-        drop = jnp.cumsum(probs, axis=-1) - probs > top_p
+        # top_p (the first token crossing the threshold is KEPT). The
+        # few-ulp slack keeps the boundary decision stable across jax
+        # versions: softmax(log(p)) can land a hair under an exactly-
+        # representable threshold (e.g. 0.79999995 vs top_p=0.8).
+        tol = 16 * jnp.finfo(probs.dtype).eps
+        drop = jnp.cumsum(probs, axis=-1) - probs > top_p - tol
         srt = jnp.where(drop, neg, srt)
         # un-sort: position j of the sorted row goes back to column
         # idx[j]; argsort(idx) inverts the permutation

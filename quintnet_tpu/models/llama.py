@@ -414,19 +414,42 @@ def llama_block_prefill(p, x, cfg: LlamaConfig, cos, sin,
 
 
 def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
-                       tp_axis: Optional[str] = None):
+                       tp_axis: Optional[str] = None,
+                       block_tables=None, block_size: Optional[int] = None):
     """One cached token: x [B, 1, D], caches [B, Hkv(/tp), T, hd] ->
-    (x, updated caches). Masked attention over cache[:pos]."""
+    (x, updated caches). Masked attention over cache[:pos].
+
+    Paged path (``block_tables``/``block_size`` set, quintnet_tpu/serve):
+    caches are flat pool views [N_blocks*block_size, Hkv(/tp), hd]
+    shared across requests, ``pos`` is a [B] vector, and the caller
+    supplies per-row rope tables (cos/sin [B, 1, 1, hd]). The cache
+    stays UNrepeated either way — kv-head repeat happens on the
+    gathered view."""
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
-    kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos, axis=2)
-    vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos, axis=2)
-    rep = q.shape[1] // kc.shape[1]
-    kf, vf = repeat_kv(kc, rep), repeat_kv(vc, rep)
+    if block_tables is None:
+        kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos,
+                                             axis=2)
+        vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos,
+                                             axis=2)
+        rep = q.shape[1] // kc.shape[1]
+        kf, vf = repeat_kv(kc, rep), repeat_kv(vc, rep)
+        valid = jnp.arange(kf.shape[2])[None, None, None, :] <= pos
+    else:
+        from quintnet_tpu.nn.attention import paged_cache_update, paged_gather
+
+        kc, vc = paged_cache_update(
+            kc, vc, k[:, :, 0].astype(kc.dtype), v[:, :, 0].astype(vc.dtype),
+            pos, block_tables=block_tables, block_size=block_size)
+        kg = paged_gather(kc, block_tables, block_size=block_size)
+        vg = paged_gather(vc, block_tables, block_size=block_size)
+        rep = q.shape[1] // kg.shape[1]
+        kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
+        valid = (jnp.arange(kf.shape[2])[None, :]
+                 <= pos[:, None])[:, None, None, :]
     scores = (jnp.einsum("bhqd,bhtd->bhqt", q, kf).astype(jnp.float32)
               / math.sqrt(cfg.head_dim))
-    valid = jnp.arange(kf.shape[2])[None, None, None, :] <= pos
     scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
     o = jnp.einsum("bhqt,bhtd->bhqd",
                    jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)

@@ -202,16 +202,62 @@ def mha_apply(
     return y
 
 
+def paged_cache_update(k_cache, v_cache, k, v, pos, *, block_tables,
+                       block_size: int):
+    """Write one token's (k, v) into a PAGED pool at each row's own
+    position. ``k_cache``/``v_cache``: [N_blocks*block_size, H, Dh] flat
+    pool views shared by every request; ``k``/``v``: [B, H, Dh];
+    ``pos``: [B] per-row write positions; ``block_tables``: [B, M]
+    logical-block -> pool-block indirection (serve/kv_pool.py).
+
+    Block 0 is the pool's reserved null block: inactive rows carry an
+    all-zero table row and pos 0, so their writes land at flat index 0
+    — garbage nobody reads (their scores are masked and the engine
+    drops their outputs). Duplicate index-0 scatters are benign for the
+    same reason."""
+    blk = jnp.take_along_axis(block_tables,
+                              (pos // block_size)[:, None], axis=1)[:, 0]
+    idx = blk * block_size + pos % block_size            # [B] flat slots
+    return k_cache.at[idx].set(k), v_cache.at[idx].set(v)
+
+
+def paged_gather(cache, block_tables, *, block_size: int):
+    """[N_blocks*block_size, H, Dh] pool + [B, M] tables -> the
+    position-ordered per-row view [B, H, M*block_size, Dh]. Token
+    position t of a row lives at (table[t // bs], t % bs), so the
+    gathered view is exactly position-ordered and the usual
+    ``arange <= pos`` length mask applies unchanged."""
+    nb = cache.shape[0] // block_size
+    pages = cache.reshape(nb, block_size, *cache.shape[1:])[block_tables]
+    # [B, M, bs, H, Dh] -> [B, H, M*bs, Dh]
+    b, m, bs, h, dh = pages.shape
+    return pages.transpose(0, 3, 1, 2, 4).reshape(b, h, m * bs, dh)
+
+
 def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
-               tp_axis: Optional[str] = None):
-    """Single-token cached attention: x [B, 1, D], caches [B, H, T, Dh],
-    ``pos`` the (dynamic) write position. Returns (y, k_cache, v_cache).
+               tp_axis: Optional[str] = None,
+               block_tables=None, block_size: Optional[int] = None):
+    """Single-token cached attention. Returns (y, k_cache, v_cache).
+
+    Dense (single-request fast path, ``block_tables=None``): x [B, 1, D],
+    caches [B, H, T, Dh], ``pos`` the (dynamic, scalar) write position
+    shared by the whole batch.
+
+    Paged (continuous-batching path): caches are FLAT POOL VIEWS
+    [N_blocks*block_size, H, Dh] shared by all requests, ``pos`` is a
+    [B] vector (each row decodes at its own depth) and ``block_tables``
+    [B, M] maps each row's logical blocks to pool blocks
+    (serve/kv_pool.py). Writes scatter through the table
+    (:func:`paged_cache_update`); reads gather the row's blocks back
+    into a position-ordered view (:func:`paged_gather`). Same math as
+    the dense path on the gathered view — tests/test_serve.py holds the
+    two token-for-token equal.
 
     The reference's generation loop re-runs the full prefix every step
     (utils/metrics.py:74-149, O(T^2) per token); here one token attends
     against the cache — O(T) per token, fully jittable (static shapes,
-    dynamic_update_slice for the cache write, masked softmax over the
-    not-yet-written tail).
+    dynamic_update_slice / table-scatter for the cache write, masked
+    softmax over the not-yet-written tail).
 
     ``tp_axis``: head-sharded decode — ``num_heads`` is LOCAL heads, the
     cache holds this rank's heads, and the output projection psums over
@@ -223,17 +269,27 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
-    k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+    if block_tables is None:
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+        k_all, v_all = k_cache, v_cache
+        valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, :]  # [1, T]
+    else:
+        # pool layout is [slot, H, Dh]: k here is [B, H, 1, Dh]
+        k_cache, v_cache = paged_cache_update(
+            k_cache, v_cache, k[:, :, 0], v[:, :, 0], pos,
+            block_tables=block_tables, block_size=block_size)
+        k_all = paged_gather(k_cache, block_tables, block_size=block_size)
+        v_all = paged_gather(v_cache, block_tables, block_size=block_size)
+        valid = jnp.arange(k_all.shape[2])[None, :] <= pos[:, None]
 
     dh = q.shape[-1]
-    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_cache).astype(jnp.float32)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_all).astype(jnp.float32)
     scores = scores / math.sqrt(dh)
-    valid = jnp.arange(k_cache.shape[2]) <= pos  # [T]
-    scores = jnp.where(valid[None, None, None, :], scores,
+    scores = jnp.where(valid[:, None, None, :], scores,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_cache)
+    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])

@@ -254,11 +254,17 @@ def block_prefill(p, x, *, num_heads: int, act: Callable = gelu,
 def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                  act: Callable = gelu,
                  moe_args: Optional[MoEArgs] = None,
-                 tp_axis: Optional[str] = None):
-    """Single-token cached block step (nn/attention.py mha_decode)."""
+                 tp_axis: Optional[str] = None,
+                 block_tables=None, block_size: Optional[int] = None):
+    """Single-token cached block step (nn/attention.py mha_decode).
+
+    With ``block_tables``/``block_size`` the caches are paged-pool flat
+    views and ``pos`` is per-row — the continuous-batching decode path
+    (quintnet_tpu/serve/); default is the dense single-request cache."""
     a, k_cache, v_cache = mha_decode(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache, pos,
-        num_heads=num_heads, tp_axis=tp_axis)
+        num_heads=num_heads, tp_axis=tp_axis,
+        block_tables=block_tables, block_size=block_size)
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
                       tp_axis=tp_axis), k_cache, v_cache

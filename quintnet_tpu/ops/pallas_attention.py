@@ -81,8 +81,11 @@ def _segment_mask(s, sq_ref, sk_ref):
 
 def _segment_overlap(sq_ref, sk_ref):
     """False when the q and k tiles cannot share any segment id (their
-    id RANGES are disjoint — exact for any ids, and for the monotone
-    packed-document layout it prunes every fully-cross-document tile).
+    id RANGES are disjoint — conservative for arbitrary ids; exact for
+    the monotone packed-document layout, where it prunes every
+    fully-cross-document tile. Non-monotone ids may keep a tile live
+    whose entries are all masked, which costs work but never
+    correctness — _segment_mask still zeroes the cross pairs).
     Combined into the pl.when liveness so pruned tiles skip all three
     MXU matmuls, the same treatment the causal grid pruning gets."""
     sq = sq_ref[0][:, 0]

@@ -1,0 +1,47 @@
+"""Continuous-batching inference engine with a paged KV-cache pool.
+
+The batch decoders (models/gpt2_generate.py, models/llama_generate.py)
+serve ONE request batch at a time: every prompt padded to the longest,
+one dense [L, B, H, T_max, Dh] cache sized for the worst case, no way to
+admit work while a batch is mid-decode. This package turns the same
+TP-sharded prefill/decode kernels into an engine that sustains many
+concurrent, variably-sized requests (Orca-style iteration-level
+scheduling; vLLM-style paged KV blocks):
+
+- :mod:`kv_pool` — fixed-size KV blocks per layer, free-list allocator,
+  per-request block tables (no per-batch T_max padding);
+- :mod:`scheduler` — waiting queue, admission by free-block budget,
+  FCFS + optional priority, preemption-by-eviction of the youngest
+  request when the pool is exhausted;
+- :mod:`engine` — the step loop: ONE jitted decode-step program over a
+  static MAX_SLOTS batch (masked empty slots — no recompiles as
+  requests come and go), prefill for newly admitted requests, EOS /
+  max-len retirement;
+- :mod:`families` — the GPT-2 / Llama model adapters (thin reuse of
+  nn/attention.mha_decode's paged path and the generate modules'
+  embed/logits helpers);
+- :mod:`api` — blocking ``generate()`` + streaming per-token callbacks;
+- :mod:`metrics` — per-step counters and TTFT / tok/s percentiles.
+
+tools/serve_bench.py replays a synthetic Poisson trace through the
+engine and emits a one-line JSON throughput/latency report.
+"""
+
+from quintnet_tpu.serve.api import generate, generate_stream
+from quintnet_tpu.serve.engine import ServeEngine
+from quintnet_tpu.serve.families import gpt2_family, llama_family
+from quintnet_tpu.serve.kv_pool import KVPool
+from quintnet_tpu.serve.metrics import ServeMetrics
+from quintnet_tpu.serve.scheduler import Request, Scheduler
+
+__all__ = [
+    "KVPool",
+    "Request",
+    "Scheduler",
+    "ServeEngine",
+    "ServeMetrics",
+    "generate",
+    "generate_stream",
+    "gpt2_family",
+    "llama_family",
+]

@@ -1,0 +1,70 @@
+"""Front-end entry points over the engine.
+
+``generate`` is the blocking batch surface — submit everything, drive
+the loop to completion, return completions in submission order. It is
+the drop-in serving analogue of ``gpt2_generate``'s one-shot API, but
+requests of wildly different lengths share the machine instead of
+padding to the longest.
+
+``generate_stream`` is the incremental surface: tokens are delivered
+through a callback as each engine step produces them (the hook a
+network front-end would pump into an SSE/gRPC stream).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from quintnet_tpu.serve.engine import ServeEngine
+
+
+def generate(engine: ServeEngine, prompts: Sequence, *,
+             max_new_tokens, keys=None, priorities=None,
+             max_steps: Optional[int] = None) -> List[np.ndarray]:
+    """Run ``prompts`` through the engine to completion; returns one
+    [T0_i + n_generated_i] array per prompt (order preserved).
+
+    ``max_new_tokens``: int (shared) or per-prompt sequence.
+    ``keys``: optional per-prompt sampling keys — pass the keys the
+    equivalent independent ``gpt2_generate``/``llama_generate`` calls
+    would use to get token-identical output (the golden contract).
+    Rows stop early at the engine's ``eos_token_id``, so unlike the
+    dense decoder the output is NOT padded to a rectangle."""
+    n = len(prompts)
+    if isinstance(max_new_tokens, int):
+        max_new_tokens = [max_new_tokens] * n
+    if keys is None:
+        keys = [None] * n
+    if priorities is None:
+        priorities = [0] * n
+    if not (len(max_new_tokens) == len(keys) == len(priorities) == n):
+        raise ValueError("per-prompt argument lengths must match prompts")
+    rids = [engine.submit(p, m, key=k, priority=pr)
+            for p, m, k, pr in zip(prompts, max_new_tokens, keys,
+                                   priorities)]
+    engine.run(max_steps=max_steps)
+    return [engine.result(r) for r in rids]
+
+
+def generate_stream(engine: ServeEngine, prompt, *, max_new_tokens: int,
+                    on_token: Callable[[int, int, bool], None],
+                    key=None, priority: int = 0,
+                    max_steps: Optional[int] = None) -> np.ndarray:
+    """Streaming single-request generation: ``on_token(rid, token,
+    is_last)`` fires as each token is produced (including the prefill-
+    sampled first token). Blocks until the request finishes; returns
+    the full sequence. Other requests already queued on the engine keep
+    making progress in the same steps — streaming does not reserve the
+    machine."""
+    rid = engine.submit(prompt, max_new_tokens, key=key,
+                        priority=priority, on_token=on_token)
+    steps = 0
+    while engine.request(rid).state != "finished":
+        if max_steps is not None and steps >= max_steps:
+            raise RuntimeError(
+                f"request {rid} unfinished after {max_steps} steps")
+        engine.step()
+        steps += 1
+    return engine.result(rid)

@@ -1,0 +1,450 @@
+"""Continuous-batching step loop over the paged KV pool.
+
+The engine multiplexes many requests onto TWO compiled programs:
+
+- ``prefill``: one request at a time, prompt right-padded to the static
+  ``prefill_len`` (causality makes pad columns inert; logits are read
+  at the dynamic true length), writes the prompt's KV into its assigned
+  pool blocks and samples the first token;
+- ``decode``: ONE step for ALL ``max_slots`` rows at once — static
+  shapes, inactive slots masked (they point at the pool's null block
+  and their outputs are dropped), per-row positions/block tables/PRNG
+  keys. Requests come and go across steps without any retracing: the
+  no-recompile invariant is asserted by tests/test_serve.py via a
+  jax.monitoring compile hook.
+
+Sampling reproduces models/gpt2_generate.autoregress EXACTLY per
+request (split-per-step key discipline, same sample_logits call
+shapes), so continuous batching is token-for-token identical to N
+independent ``gpt2_generate``/``llama_generate`` calls — the golden
+contract. Preemption checkpoints a request's generated tokens + evolved
+key host-side and resumes by prefilling ``prompt + generated``; the
+continuation samples from the checkpointed key state, so even sampled
+runs survive eviction bit-identically.
+
+All host<->device traffic per step is O(max_slots) scalars plus the
+sampled tokens — the pool and parameters never leave the device. Under
+a TP mesh the whole step runs in one shard_map (head-sharded pool,
+RowParallel psum per layer, replicated tokens), exactly the
+``gpt2_generate_tp`` arrangement.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quintnet_tpu.serve.families import Family
+from quintnet_tpu.serve.kv_pool import KVPool
+from quintnet_tpu.serve.metrics import ServeMetrics
+from quintnet_tpu.serve.scheduler import FINISHED, Request, Scheduler
+
+
+class ServeEngine:
+    def __init__(self, family: Family, params, *, max_slots: int = 8,
+                 block_size: int = 16, num_blocks: int = 64,
+                 max_seq_len: Optional[int] = None,
+                 prefill_len: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, policy: str = "fcfs",
+                 mesh=None, tp_axis: str = "tp", kv_dtype=None,
+                 logger=None, log_every: int = 0,
+                 clock=time.monotonic):
+        self.family = family
+        self.params = params
+        self.max_slots = int(max_slots)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.mesh = mesh
+        self.tp_axis = tp_axis if mesh is not None else None
+        self.logger = logger
+        self.log_every = int(log_every)
+        self.clock = clock
+
+        self.max_seq_len = int(max_seq_len or family.max_positions)
+        if self.max_seq_len > family.max_positions:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"n_positions {family.max_positions}")
+        self.prefill_len = int(prefill_len or self.max_seq_len)
+
+        sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(mesh, P(None, None, tp_axis, None))
+        self.pool = KVPool(
+            n_layers=family.n_layers, n_kv_heads=family.n_kv_heads,
+            head_dim=family.head_dim, block_size=block_size,
+            num_blocks=num_blocks,
+            dtype=kv_dtype if kv_dtype is not None else family.kv_dtype,
+            sharding=sharding)
+        self.table_width = self.pool.blocks_for(self.max_seq_len)
+        self.scheduler = Scheduler(self.pool, policy=policy)
+        self.metrics = ServeMetrics(clock=clock)
+
+        S, M = self.max_slots, self.table_width
+        # host-side slot state (tiny; shipped to device each step)
+        self._tok = np.zeros((S,), np.int32)
+        self._pos = np.zeros((S,), np.int32)
+        self._tables = np.zeros((S, M), np.int32)
+        self._key_data = np.array(
+            jax.random.key_data(jax.random.split(jax.random.key(0), S)))
+        self._slot_req: List[Optional[Request]] = [None] * S
+        self._slot_blocks: List[List[int]] = [[] for _ in range(S)]
+
+        self._results: Dict[int, Request] = {}
+        self._rid_counter = 0
+        self._arrival_counter = 0
+
+        self._prefill = self._build_prefill()
+        self._decode = self._build_decode()
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _sample_rows(self, logits, subkeys):
+        """Per-row sampling, bit-identical to what autoregress does for
+        a [1, V] batch with each row's own key (vmap of the same
+        sample_logits call — models/gpt2_generate.py)."""
+        from quintnet_tpu.models.gpt2_generate import sample_logits
+
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.vmap(
+            lambda lg, kk: sample_logits(
+                lg[None], kk, temperature=self.temperature,
+                top_k=self.top_k, top_p=self.top_p)[0]
+        )(logits, subkeys).astype(jnp.int32)
+
+    def _build_prefill(self):
+        family, bs = self.family, self.pool.block_size
+        tp_axis = self.tp_axis
+
+        def body(params, k_pool, v_pool, ids, t0, table_row, key_data):
+            from quintnet_tpu.models.gpt2_generate import sample_logits
+
+            logits, (ks, vs) = family.prefill(params, ids, t0,
+                                              tp_axis=tp_axis)
+            # ks [L, 1, H, P, Dh] -> slot-ordered [L, P, H, Dh]
+            P_ = ids.shape[1]
+            kst = ks[:, 0].transpose(0, 2, 1, 3)
+            vst = vs[:, 0].transpose(0, 2, 1, 3)
+            t = jnp.arange(P_)
+            idx = jnp.where(t < t0, table_row[t // bs] * bs + t % bs, 0)
+            k_pool = k_pool.at[:, idx].set(kst.astype(k_pool.dtype))
+            v_pool = v_pool.at[:, idx].set(vst.astype(v_pool.dtype))
+
+            key = jax.random.wrap_key_data(key_data)
+            key2, sub = jax.random.split(key)
+            tok = sample_logits(logits, sub, temperature=self.temperature,
+                                top_k=self.top_k, top_p=self.top_p)[0]
+            return (k_pool, v_pool, tok.astype(jnp.int32),
+                    jax.random.key_data(key2))
+
+        return self._wrap(body, n_pool_args=2)
+
+    def _build_decode(self):
+        family, bs = self.family, self.pool.block_size
+        tp_axis = self.tp_axis
+
+        def body(params, k_pool, v_pool, tok, pos, tables, key_data):
+            logits, k_pool, v_pool = family.decode(
+                params, k_pool, v_pool, tok, pos, tables, bs,
+                tp_axis=tp_axis)
+            keys = jax.random.wrap_key_data(key_data)
+            pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            nxt = self._sample_rows(logits, pairs[:, 1])
+            return (k_pool, v_pool, nxt,
+                    jax.random.key_data(pairs[:, 0]))
+
+        return self._wrap(body, n_pool_args=2)
+
+    def _wrap(self, body, *, n_pool_args: int):
+        """jit (donating the pool buffers — decode-state updates are
+        in-place on device); under a mesh, shard_map first: params in
+        their training layout, pool head-sharded, everything else
+        replicated."""
+        if self.mesh is None:
+            return jax.jit(body, donate_argnums=tuple(
+                range(1, 1 + n_pool_args)))
+        from jax.sharding import PartitionSpec as P
+
+        from quintnet_tpu.core import collectives as cc
+
+        pool_spec = P(None, None, self.tp_axis, None)
+        pspecs = self.family.partition_specs(self.tp_axis)
+
+        def in_specs_for(n_rest):
+            return ((pspecs,) + (pool_spec,) * n_pool_args
+                    + (P(),) * n_rest)
+
+        # prefill body: (params, kp, vp, ids, t0, row, key) -> 4 outs
+        # decode  body: (params, kp, vp, tok, pos, tables, key) -> 4 outs
+        n_rest = 4
+        smapped = cc.shard_map_fn(
+            body, self.mesh,
+            in_specs=in_specs_for(n_rest),
+            out_specs=(pool_spec,) * n_pool_args + (P(), P()))
+        return jax.jit(smapped, donate_argnums=tuple(
+            range(1, 1 + n_pool_args)))
+
+    # ------------------------------------------------------------------
+    # submission / results
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               key=None, on_token=None) -> int:
+        """Queue one request; returns its id. ``key``: per-request
+        sampling key (defaults to fold_in(key(0), rid)) — pass the SAME
+        key an independent ``gpt2_generate`` call would get to reproduce
+        it token-for-token."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new_tokens} "
+                f"exceeds max_seq_len={self.max_seq_len}")
+        # a preemption-resume prefills prompt + generated (up to
+        # total - 1 tokens), so prefill_len must cover that, not just
+        # the prompt
+        if total - 1 > self.prefill_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new_tokens} - 1 "
+                f"exceeds prefill_len={self.prefill_len} (resume after "
+                f"preemption prefills prompt + generated tokens)")
+        # fail fast on requests the pool can NEVER admit: admission
+        # needs blocks_for(total_len + 1), and after a worst-case
+        # preemption total_len is total - 1 — otherwise the scheduler
+        # would return None forever and run() would spin
+        worst = self.pool.blocks_for(total)
+        if worst > self.pool.usable_blocks:
+            raise ValueError(
+                f"KV pool too small for this request: needs up to "
+                f"{worst} blocks, pool has {self.pool.usable_blocks} "
+                f"usable (block_size={self.pool.block_size})")
+        rid = self._rid_counter
+        self._rid_counter += 1
+        if key is None:
+            key = jax.random.fold_in(jax.random.key(0), rid)
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      priority=int(priority),
+                      arrival=self._arrival_counter, on_token=on_token)
+        self._arrival_counter += 1
+        req.key_data = np.asarray(jax.random.key_data(key))
+        req.submit_time = self.clock()
+        self._results[rid] = req
+        self.scheduler.submit(req)
+        return rid
+
+    def result(self, rid: int) -> np.ndarray:
+        req = self._results[rid]
+        if req.state != FINISHED:
+            raise RuntimeError(f"request {rid} not finished "
+                               f"(state={req.state})")
+        return req.output_ids()
+
+    def request(self, rid: int) -> Request:
+        return self._results[rid]
+
+    @property
+    def has_work(self) -> bool:
+        return (bool(self.scheduler.waiting)
+                or any(r is not None for r in self._slot_req))
+
+    # ------------------------------------------------------------------
+    # step loop
+    # ------------------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _active_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is not None]
+
+    def _emit(self, req: Request, token: int, *, last: bool) -> None:
+        if req.on_token is not None:
+            req.on_token(req.rid, int(token), last)
+
+    def _clear_slot(self, slot: int) -> None:
+        self._slot_req[slot] = None
+        self._slot_blocks[slot] = []
+        self._tables[slot] = 0
+        self._tok[slot] = 0
+        self._pos[slot] = 0
+
+    def _retire(self, slot: int) -> int:
+        req = self._slot_req[slot]
+        self.pool.free(self._slot_blocks[slot])
+        self._clear_slot(slot)
+        req.state = FINISHED
+        req.finish_time = self.clock()
+        self.metrics.record_finish(req.finish_time - req.submit_time)
+        return req.rid
+
+    def _preempt(self, slot: int) -> None:
+        """Evict: checkpoint progress host-side (generated tokens are
+        already there; the evolved PRNG key rides key_data), free the
+        blocks, requeue at the head of the line."""
+        req = self._slot_req[slot]
+        req.key_data = self._key_data[slot].copy()
+        self.pool.free(self._slot_blocks[slot])
+        self._clear_slot(slot)
+        req.preemptions += 1
+        self.metrics.record_preempt()
+        self.scheduler.push_front(req)
+
+    def _append_token(self, slot: int, token: int) -> bool:
+        """Record one sampled token; returns True when the request is
+        done (EOS or token budget)."""
+        req = self._slot_req[slot]
+        req.generated.append(int(token))
+        if req.first_token_time is None:
+            req.first_token_time = self.clock()
+            self.metrics.record_first_token(
+                req.first_token_time - req.submit_time)
+        done = (req.remaining_new_tokens <= 0
+                or (self.eos_token_id is not None
+                    and int(token) == self.eos_token_id))
+        self._emit(req, token, last=done)
+        return done
+
+    def _admit_one(self, slot: int, req: Request) -> int:
+        """Prefill an admitted request into ``slot``; returns the
+        number of prefilled tokens."""
+        t0 = req.total_len
+        blocks = self.pool.alloc(self.scheduler.blocks_to_admit(req))
+        assert blocks is not None  # admission checked the budget
+        self._slot_req[slot] = req
+        self._slot_blocks[slot] = blocks
+        row = np.zeros((self.table_width,), np.int32)
+        row[:len(blocks)] = blocks
+        self._tables[slot] = row
+
+        ids = np.zeros((1, self.prefill_len), np.int32)
+        ids[0, :t0] = req.output_ids()
+        kp, vp, tok0, key2 = self._prefill(
+            self.params, *self.pool.caches(), jnp.asarray(ids),
+            jnp.int32(t0), jnp.asarray(row), jnp.asarray(req.key_data))
+        self.pool.update(kp, vp)
+        self._key_data[slot] = np.asarray(key2)
+        tok0 = int(tok0)
+        self._tok[slot] = tok0
+        self._pos[slot] = t0
+        self.metrics.record_admit()
+        if self._append_token(slot, tok0):
+            self._retire(slot)
+        return t0
+
+    def _grow_or_preempt(self) -> None:
+        """Ensure every active slot holds the block its next write
+        position needs; evict the youngest admission when the pool is
+        dry. Oldest requests are grown first so eviction pressure lands
+        on the youngest (least sunk work)."""
+        order = sorted(self._active_slots(),
+                       key=lambda s: self._slot_req[s].admit_seq)
+        for slot in order:
+            while self._slot_req[slot] is not None:
+                need = self.pool.blocks_for(int(self._pos[slot]) + 1)
+                if len(self._slot_blocks[slot]) >= need:
+                    break
+                got = self.pool.alloc(1)
+                if got is not None:
+                    self._tables[slot][len(self._slot_blocks[slot])] = got[0]
+                    self._slot_blocks[slot].extend(got)
+                    continue
+                running = [self._slot_req[s] for s in self._active_slots()]
+                victim = Scheduler.preempt_victim(running)
+                if victim is self._slot_req[slot] and len(running) == 1:
+                    raise RuntimeError(
+                        f"KV pool too small for a single request of "
+                        f"length {int(self._pos[slot]) + 1} "
+                        f"(usable blocks: {self.pool.usable_blocks}, "
+                        f"block_size: {self.pool.block_size})")
+                vslot = next(s for s in self._active_slots()
+                             if self._slot_req[s] is victim)
+                self._preempt(vslot)
+
+    def step(self) -> List[int]:
+        """One scheduler iteration: admit -> grow/preempt -> one decode
+        step for every active slot -> retire finished rows. Returns the
+        request ids that finished this step."""
+        finished: List[int] = []
+        prefill_tokens = 0
+
+        # 1. admissions (prefill; may retire instantly on EOS/budget)
+        while True:
+            free = self._free_slots()
+            req = self.scheduler.next_admission(len(free))
+            if req is None:
+                break
+            slot = free[0]
+            prefill_tokens += self._admit_one(slot, req)
+            if self._slot_req[slot] is None:  # instant retire
+                finished.append(req.rid)
+
+        # 2. block growth / preemption for the upcoming writes
+        self._grow_or_preempt()
+
+        # 3. one decode step for all active slots
+        active = self._active_slots()
+        decode_tokens = 0
+        if active:
+            kp, vp, nxt, key2 = self._decode(
+                self.params, *self.pool.caches(), jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._tables),
+                jnp.asarray(self._key_data))
+            self.pool.update(kp, vp)
+            nxt = np.asarray(nxt)
+            self._key_data = np.array(key2)
+            for slot in active:
+                token = int(nxt[slot])
+                self._tok[slot] = token
+                self._pos[slot] += 1
+                decode_tokens += 1
+                if self._append_token(slot, token):
+                    finished.append(self._retire(slot))
+
+        # 4. metrics
+        self.metrics.record_step(
+            running=len(self._active_slots()),
+            waiting=len(self.scheduler.waiting),
+            kv_blocks_used=self.pool.num_used,
+            kv_blocks_total=self.pool.usable_blocks,
+            prefill_tokens=prefill_tokens,
+            decode_tokens=decode_tokens)
+        if self.log_every:
+            self.metrics.log_step(self.logger, every=self.log_every)
+        return finished
+
+    def run(self, *, max_steps: Optional[int] = None) -> None:
+        """Step until all submitted work is finished (or ``max_steps``)."""
+        steps = 0
+        while self.has_work:
+            if max_steps is not None and steps >= max_steps:
+                break
+            self.step()
+            steps += 1
+
+    # ------------------------------------------------------------------
+    def compile_stats(self) -> Dict[str, int]:
+        """Compiled-program counts for the no-recompile invariant
+        (tests/test_serve.py): both entries must stay at 1 no matter
+        how requests come and go."""
+        def n(f):
+            try:
+                return int(f._cache_size())
+            except AttributeError:  # pragma: no cover - old jit objects
+                return -1
+
+        return {"prefill": n(self._prefill), "decode": n(self._decode)}

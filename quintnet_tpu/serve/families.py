@@ -1,0 +1,151 @@
+"""Model-family adapters for the serving engine.
+
+One tiny record per family (GPT-2, Llama) giving the engine a uniform
+(prefill, paged-decode, partition-specs) surface. Nothing here forks
+model math: prefill scans the SAME nn/transformer.block_prefill /
+models/llama.llama_block_prefill bodies the batch decoders use, paged
+decode scans block_decode / llama_block_decode with ``block_tables``
+(the nn/attention.mha_decode paged path), and embedding/logits reuse
+the generate modules' vocab-parallel-aware helpers — a fix in any of
+those fixes serving too.
+
+Prefill contract: ``prefill(params, ids [1, P], t0, tp_axis) ->
+(logits [1, V] at position t0-1, (ks, vs) each [L, 1, H_kv(/tp), P, Dh])``
+— ids are right-padded to the engine's static P; causality makes the
+pad columns inert, and the returned logits are read at the DYNAMIC
+index t0-1, so one compiled prefill serves every prompt length.
+
+Decode contract: ``decode(params, k_pool, v_pool, tok [S], pos [S],
+tables [S, M], block_size, tp_axis) -> (logits [S, V], k_pool, v_pool)``
+— per-row positions, paged pool views, static S.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class Family:
+    name: str
+    cfg: Any
+    n_layers: int
+    n_kv_heads: int          # GLOBAL kv heads (pool head dim)
+    head_dim: int
+    max_positions: int
+    prefill: Callable        # (params, ids, t0, tp_axis) -> (logits, (ks, vs))
+    decode: Callable         # (params, kp, vp, tok, pos, tables, bs, tp_axis)
+    partition_specs: Callable  # (tp_axis) -> param pytree specs
+    kv_dtype: Any = jnp.float32
+
+
+# --------------------------------------------------------------------
+# GPT-2
+# --------------------------------------------------------------------
+
+def gpt2_family(cfg) -> Family:
+    from quintnet_tpu.models.gpt2 import gpt2_partition_specs
+    from quintnet_tpu.models.gpt2_generate import (_embed_tok, _local_heads,
+                                                   _logits)
+    from quintnet_tpu.nn.layers import gelu
+    from quintnet_tpu.nn.transformer import block_decode, block_prefill
+
+    def prefill(params, ids, t0, tp_axis=None):
+        B, P = ids.shape
+        emb = params["embedding"]
+        h = _embed_tok(emb, ids, cfg, tp_axis) + emb["wpe"][None, :P, :]
+        heads = _local_heads(cfg, tp_axis)
+
+        def body(x, blk):
+            x, (k, v) = block_prefill(blk, x, num_heads=heads, act=gelu,
+                                      moe_args=cfg.moe_args, tp_axis=tp_axis)
+            return x, (k, v)
+
+        h, (ks, vs) = lax.scan(body, h, params["blocks"])
+        h_last = lax.dynamic_slice_in_dim(h, t0 - 1, 1, axis=1)
+        return _logits(params, h_last, cfg, tp_axis)[:, 0, :], (ks, vs)
+
+    def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
+               tp_axis=None):
+        emb = params["embedding"]
+        x = (_embed_tok(emb, tok[:, None], cfg, tp_axis)
+             + jnp.take(emb["wpe"], pos, axis=0)[:, None, :])
+        heads = _local_heads(cfg, tp_axis)
+
+        def body(h, layer):
+            blk, kc, vc = layer
+            h, kc, vc = block_decode(blk, h, kc, vc, pos, num_heads=heads,
+                                     act=gelu, moe_args=cfg.moe_args,
+                                     tp_axis=tp_axis, block_tables=tables,
+                                     block_size=block_size)
+            return h, (kc, vc)
+
+        h, (k_pool, v_pool) = lax.scan(body, x, (params["blocks"],
+                                                 k_pool, v_pool))
+        return _logits(params, h, cfg, tp_axis)[:, 0, :], k_pool, v_pool
+
+    return Family(
+        name="gpt2", cfg=cfg, n_layers=cfg.n_layer, n_kv_heads=cfg.n_head,
+        head_dim=cfg.n_embd // cfg.n_head, max_positions=cfg.n_positions,
+        prefill=prefill, decode=decode,
+        partition_specs=lambda tp_axis: gpt2_partition_specs(
+            cfg, tp_axis=tp_axis),
+    )
+
+
+# --------------------------------------------------------------------
+# Llama (GQA: the pool holds UNrepeated kv heads)
+# --------------------------------------------------------------------
+
+def llama_family(cfg) -> Family:
+    from quintnet_tpu.models.llama import (llama_block_decode,
+                                           llama_block_prefill,
+                                           llama_partition_specs,
+                                           llama_rope_tables)
+    from quintnet_tpu.models.llama_generate import _embed, _full_logits
+
+    def prefill(params, ids, t0, tp_axis=None):
+        B, P = ids.shape
+        h = _embed(params, ids, cfg, tp_axis)
+        cos, sin = llama_rope_tables(jnp.arange(P), cfg)
+
+        def body(x, blk):
+            x, kv = llama_block_prefill(blk, x, cfg, cos, sin,
+                                        tp_axis=tp_axis)
+            return x, kv
+
+        h, (ks, vs) = lax.scan(body, h, params["blocks"])
+        h_last = lax.dynamic_slice_in_dim(h, t0 - 1, 1, axis=1)
+        return _full_logits(params, h_last, cfg, tp_axis)[:, 0, :], (ks, vs)
+
+    def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
+               tp_axis=None):
+        x = _embed(params, tok[:, None], cfg, tp_axis)        # [S, 1, D]
+        cos, sin = llama_rope_tables(pos, cfg)                # [S, hd]
+        cos, sin = cos[:, None, None, :], sin[:, None, None, :]
+
+        def body(h, layer):
+            blk, kc, vc = layer
+            h, (kc, vc) = llama_block_decode(
+                blk, h, kc, vc, pos, cfg, cos, sin, tp_axis=tp_axis,
+                block_tables=tables, block_size=block_size)
+            return h, (kc, vc)
+
+        h, (k_pool, v_pool) = lax.scan(body, x, (params["blocks"],
+                                                 k_pool, v_pool))
+        return _full_logits(params, h, cfg, tp_axis)[:, 0, :], \
+            k_pool, v_pool
+
+    return Family(
+        name="llama", cfg=cfg, n_layers=cfg.n_layers,
+        n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
+        max_positions=cfg.n_positions,
+        prefill=prefill, decode=decode,
+        partition_specs=lambda tp_axis: llama_partition_specs(
+            cfg, tp_axis=tp_axis),
+    )

@@ -1,0 +1,120 @@
+"""Paged KV-cache pool: fixed-size blocks + free-list allocator.
+
+The dense decoders allocate [L, B, H, T_max, Dh] per batch — every
+request pays for the longest possible sequence. Here KV memory is a
+single pool of ``num_blocks`` blocks of ``block_size`` token slots,
+shared by all in-flight requests; each request owns just the blocks its
+current length needs (vLLM's PagedAttention memory model). Fragmentation
+is bounded to < 1 block per request and T_max padding disappears.
+
+Device layout (per k and v): ``[L, num_blocks * block_size, H_kv, Dh]``
+— the flat "slot" dim is what nn/attention.paged_cache_update scatters
+into and paged_gather pages out of; keeping L leading lets the decode
+step lax.scan over layers exactly like the dense path. Under TP the
+H_kv dim is head-sharded over the mesh (each rank holds its local
+heads' pool, same invariant as the dense TP cache).
+
+Block 0 is permanently reserved as the NULL block: inactive engine
+slots point their table rows (and positions) at it, so masked rows'
+scatters land in memory nobody reads and the decode step needs no
+dynamic shapes. The allocator therefore hands out blocks [1, num_blocks).
+
+Allocation is host-side bookkeeping (a free list of ints) — the device
+arrays never reshape; "allocating" a block just means an engine slot's
+block table starts referencing it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+NULL_BLOCK = 0
+
+
+class KVPool:
+    """Free-list allocator over paged per-layer KV storage.
+
+    ``n_kv_heads`` is the GLOBAL kv-head count; pass ``sharding`` (a
+    ``jax.sharding.NamedSharding`` with the head dim on the tp axis) to
+    lay the pool out head-sharded for a TP engine.
+    """
+
+    def __init__(self, *, n_layers: int, n_kv_heads: int, head_dim: int,
+                 block_size: int, num_blocks: int, dtype=jnp.float32,
+                 sharding=None):
+        if block_size < 1 or num_blocks < 2:
+            raise ValueError(
+                f"need block_size >= 1 and num_blocks >= 2 (block 0 is "
+                f"the reserved null block); got {block_size}, {num_blocks}")
+        self.n_layers = n_layers
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        shape = (n_layers, num_blocks * block_size, n_kv_heads, head_dim)
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if sharding is not None:
+            import jax
+
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        self.k = k
+        self.v = v
+        # LIFO free list: reuse recently-freed blocks first (warm pages)
+        self._free: List[int] = list(range(num_blocks - 1, NULL_BLOCK, -1))
+
+    # ---- accounting -------------------------------------------------
+    @property
+    def usable_blocks(self) -> int:
+        """Blocks available to requests (null block excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.usable_blocks - self.num_free
+
+    @property
+    def utilization(self) -> float:
+        return self.num_used / max(self.usable_blocks, 1)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` token slots."""
+        return -(-n_tokens // self.block_size)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= self.num_free
+
+    # ---- alloc/free -------------------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` blocks off the free list, or None (caller decides
+        whether to wait or preempt — the pool never partially
+        allocates)."""
+        if n > len(self._free):
+            return None
+        taken = [self._free.pop() for _ in range(n)]
+        return taken
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not (NULL_BLOCK < b < self.num_blocks):
+                raise ValueError(f"freeing invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+
+    # ---- device views ----------------------------------------------
+    def caches(self):
+        """The (k, v) device arrays, as carried through the jitted step
+        functions (the engine writes the returned/donated results back
+        via :meth:`update`)."""
+        return self.k, self.v
+
+    def update(self, k, v) -> None:
+        self.k, self.v = k, v

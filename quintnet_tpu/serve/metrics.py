@@ -1,0 +1,133 @@
+"""Serving metrics: per-step gauges + per-request latency percentiles.
+
+Counters the engine records every step (running/waiting/preempted,
+KV-block utilization, prefill vs decode tokens) and per-request marks
+(submit, first token, finish) from which TTFT and tok/s percentiles are
+derived. Emission goes through utils/logger.py — the same stdout+file
+tee the trainer uses — so a serving process logs like a training one.
+
+All timing uses a caller-injectable clock so tests and the synthetic
+trace replayer (tools/serve_bench.py) can drive deterministic
+"wall time" without sleeping.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _pcts(xs: List[float]) -> Dict[str, float]:
+    if not xs:
+        return {"p50": 0.0, "p95": 0.0}
+    a = np.asarray(xs, np.float64)
+    return {"p50": float(np.percentile(a, 50)),
+            "p95": float(np.percentile(a, 95))}
+
+
+@dataclass
+class ServeMetrics:
+    clock: "callable" = time.monotonic
+
+    # step gauges (overwritten each step) ----------------------------
+    running: int = 0
+    waiting: int = 0
+    kv_blocks_used: int = 0
+    kv_blocks_total: int = 0
+
+    # monotone counters ----------------------------------------------
+    steps: int = 0
+    admitted: int = 0
+    preempted: int = 0
+    finished: int = 0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    peak_kv_utilization: float = 0.0
+    peak_running: int = 0
+
+    # per-request marks ----------------------------------------------
+    ttfts: List[float] = field(default_factory=list)
+    latencies: List[float] = field(default_factory=list)
+    _t0: Optional[float] = None
+    _t_end: Optional[float] = None
+
+    # ---- recording --------------------------------------------------
+    def record_step(self, *, running: int, waiting: int,
+                    kv_blocks_used: int, kv_blocks_total: int,
+                    prefill_tokens: int, decode_tokens: int) -> None:
+        now = self.clock()
+        if self._t0 is None:
+            self._t0 = now
+        self._t_end = now
+        self.steps += 1
+        self.running = running
+        self.waiting = waiting
+        self.kv_blocks_used = kv_blocks_used
+        self.kv_blocks_total = kv_blocks_total
+        self.prefill_tokens += prefill_tokens
+        self.decode_tokens += decode_tokens
+        util = kv_blocks_used / max(kv_blocks_total, 1)
+        self.peak_kv_utilization = max(self.peak_kv_utilization, util)
+        self.peak_running = max(self.peak_running, running)
+
+    def record_admit(self) -> None:
+        self.admitted += 1
+
+    def record_preempt(self) -> None:
+        self.preempted += 1
+
+    def record_first_token(self, ttft_s: float) -> None:
+        self.ttfts.append(ttft_s)
+
+    def record_finish(self, latency_s: float) -> None:
+        self.finished += 1
+        self.latencies.append(latency_s)
+
+    # ---- reporting --------------------------------------------------
+    @property
+    def wall_s(self) -> float:
+        if self._t0 is None or self._t_end is None:
+            return 0.0
+        return max(self._t_end - self._t0, 0.0)
+
+    def summary(self) -> Dict:
+        """One JSON-able dict: throughput, TTFT/latency percentiles,
+        peak pool pressure. tok/s counts GENERATED (decode + prefill-
+        sampled) tokens — the serving-throughput number, not prompt
+        reading speed."""
+        wall = self.wall_s
+        # every admission samples exactly one (prefill) token; the rest
+        # come from decode steps
+        gen_tokens = self.decode_tokens + self.admitted
+        return {
+            "steps": self.steps,
+            "admitted": self.admitted,
+            "finished": self.finished,
+            "preempted": self.preempted,
+            "prefill_tokens": self.prefill_tokens,
+            "decode_tokens": self.decode_tokens,
+            "wall_s": round(wall, 4),
+            "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0
+            else 0.0,
+            "ttft_s": _pcts(self.ttfts),
+            "latency_s": _pcts(self.latencies),
+            "peak_kv_utilization": round(self.peak_kv_utilization, 4),
+            "peak_running": self.peak_running,
+        }
+
+    def log_step(self, logger: Optional[logging.Logger], *,
+                 every: int = 1) -> None:
+        if logger is None or self.steps % max(every, 1):
+            return
+        logger.info(
+            "serve step=%d running=%d waiting=%d kv=%d/%d (%.0f%%) "
+            "prefill_toks=%d decode_toks=%d preempted=%d finished=%d",
+            self.steps, self.running, self.waiting, self.kv_blocks_used,
+            self.kv_blocks_total,
+            100.0 * self.kv_blocks_used / max(self.kv_blocks_total, 1),
+            self.prefill_tokens, self.decode_tokens, self.preempted,
+            self.finished)

@@ -1,0 +1,141 @@
+"""Iteration-level request scheduler (Orca-style continuous batching).
+
+Decisions are made every engine step, not every request batch: newly
+arrived requests are admitted mid-flight whenever a slot and enough KV
+blocks are free, finished rows retire individually, and when the pool
+runs dry the YOUNGEST running request is evicted (its blocks freed, its
+progress checkpointed host-side) and goes back to the head of the
+waiting queue — recompute-style preemption, the vLLM default.
+
+Policies: ``fcfs`` (arrival order) or ``priority`` (lower value first,
+arrival breaks ties). Preempted requests keep their original arrival
+stamp, so they resume ahead of anything that arrived after them.
+
+The scheduler owns request lifecycle state only; device state (block
+tables, keys, token buffers) lives in the engine. The split keeps this
+module trivially unit-testable (tests/test_serve.py) with a stub pool.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from quintnet_tpu.serve.kv_pool import KVPool
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One generation request and its host-side progress.
+
+    ``prompt`` is the ORIGINAL prompt (never mutated); ``generated``
+    accumulates sampled tokens across preemptions, so the resume prefill
+    runs over ``prompt + generated`` and continuation is exact
+    (token-for-token equal to an uninterrupted run — the sampling key
+    state is checkpointed in ``key_data`` at eviction)."""
+
+    rid: int
+    prompt: np.ndarray                      # [T0] int32, immutable
+    max_new_tokens: int
+    priority: int = 0                       # lower = more urgent
+    arrival: int = 0                        # monotone submit stamp
+    on_token: Optional[Callable] = None     # streaming callback
+
+    # --- runtime (engine-managed) ---
+    state: str = WAITING
+    generated: List[int] = field(default_factory=list)
+    key_data: Optional[np.ndarray] = None   # evolved PRNG key (resume)
+    admit_seq: int = -1                     # last admission stamp
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    preemptions: int = 0
+
+    @property
+    def total_len(self) -> int:
+        """Tokens whose KV the request holds when running: the resume
+        prefill covers prompt + already-generated tokens."""
+        return len(self.prompt) + len(self.generated)
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated, the completed sequence."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+
+class Scheduler:
+    """Waiting queue + admission control + preemption victim selection."""
+
+    def __init__(self, pool: KVPool, *, policy: str = "fcfs"):
+        if policy not in ("fcfs", "priority"):
+            raise ValueError(f"unknown policy {policy!r}; "
+                             "expected 'fcfs' or 'priority'")
+        self.pool = pool
+        self.policy = policy
+        self.waiting: List[Request] = []
+        self._admit_counter = itertools.count()
+
+    # ---- queue ------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        req.state = WAITING
+        self.waiting.append(req)
+        self._sort()
+
+    def push_front(self, req: Request) -> None:
+        """Re-queue a preempted request. It keeps its original arrival
+        stamp, so _sort naturally places it ahead of younger work."""
+        req.state = WAITING
+        self.waiting.append(req)
+        self._sort()
+
+    def _key(self, r: Request):
+        if self.policy == "priority":
+            return (r.priority, r.arrival)
+        return (r.arrival,)
+
+    def _sort(self) -> None:
+        self.waiting.sort(key=self._key)
+
+    # ---- admission --------------------------------------------------
+    def blocks_to_admit(self, req: Request) -> int:
+        """Blocks a request needs at admission: its whole prefill
+        (prompt + any checkpointed generation) PLUS the first decode
+        write slot, so an admitted request can always take at least one
+        step before growth/preemption kicks in."""
+        return self.pool.blocks_for(req.total_len + 1)
+
+    def next_admission(self, free_slots: int) -> Optional[Request]:
+        """Pop the best admissible waiting request, or None. Head-of-
+        line blocking is intentional (strict FCFS/priority): if the
+        front request does not fit, nothing behind it jumps the queue —
+        predictable latency ordering over maximal packing."""
+        if free_slots <= 0 or not self.waiting:
+            return None
+        head = self.waiting[0]
+        if not self.pool.can_alloc(self.blocks_to_admit(head)):
+            return None
+        self.waiting.pop(0)
+        head.state = RUNNING
+        head.admit_seq = next(self._admit_counter)
+        return head
+
+    # ---- preemption -------------------------------------------------
+    @staticmethod
+    def preempt_victim(running: List[Request]) -> Optional[Request]:
+        """Youngest admission goes first (LIFO eviction): it has the
+        least sunk prefill work to redo and the oldest requests keep
+        their latency promise."""
+        if not running:
+            return None
+        return max(running, key=lambda r: r.admit_seq)

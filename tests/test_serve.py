@@ -1,0 +1,415 @@
+"""Continuous-batching serving goldens (quintnet_tpu/serve/).
+
+THE contract: the engine's output for every request is token-for-token
+identical to an independent ``gpt2_generate``/``llama_generate`` call —
+no matter how requests are staggered, packed into slots, grown across
+KV blocks, preempted and resumed, or sharded over a tp mesh. Plus the
+operational invariants: one compiled decode step per engine (no
+recompiles as requests come and go), free-list/pool accounting, FCFS
+vs priority admission, EOS retirement, streaming callbacks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.models.gpt2_generate import gpt2_generate
+from quintnet_tpu.serve import (KVPool, Request, Scheduler, ServeEngine,
+                                generate, generate_stream, gpt2_family)
+
+CFG = GPT2Config.tiny(n_layer=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+def _prompts(rng, lengths):
+    return [np.asarray(rng.integers(0, CFG.vocab_size, (t,)), np.int32)
+            for t in lengths]
+
+
+def _engine(params, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 48)
+    kw.setdefault("max_seq_len", 40)
+    return ServeEngine(gpt2_family(CFG), params, **kw)
+
+
+def _run_staggered(eng, prompts, max_new, keys, arrivals):
+    """Submit request i when the engine has taken ``arrivals[i]`` steps;
+    run to completion; return outputs in submission order."""
+    order = np.argsort(np.asarray(arrivals), kind="stable")
+    rids = {}
+    submitted, step = 0, 0
+    while submitted < len(prompts) or eng.has_work:
+        while (submitted < len(prompts)
+               and arrivals[order[submitted]] <= step):
+            i = order[submitted]
+            rids[i] = eng.submit(prompts[i], max_new[i], key=keys[i])
+            submitted += 1
+        eng.step()
+        step += 1
+        assert step < 2000, "engine failed to drain"
+    return [eng.result(rids[i]) for i in range(len(prompts))]
+
+
+# ---------------------------------------------------------------------
+# pool + scheduler units
+# ---------------------------------------------------------------------
+
+class TestKVPool:
+    def _pool(self, num_blocks=8):
+        return KVPool(n_layers=2, n_kv_heads=2, head_dim=4, block_size=4,
+                      num_blocks=num_blocks)
+
+    def test_null_block_reserved(self):
+        p = self._pool()
+        got = p.alloc(p.usable_blocks)
+        assert got is not None and 0 not in got
+        assert p.alloc(1) is None  # exhausted, never hands out block 0
+
+    def test_alloc_free_roundtrip(self):
+        p = self._pool()
+        a = p.alloc(3)
+        assert p.num_used == 3
+        p.free(a)
+        assert p.num_used == 0 and p.num_free == p.usable_blocks
+
+    def test_alloc_never_partial(self):
+        p = self._pool(num_blocks=4)  # 3 usable
+        assert p.alloc(5) is None
+        assert p.num_free == 3  # nothing leaked
+
+    def test_double_free_raises(self):
+        p = self._pool()
+        a = p.alloc(1)
+        p.free(a)
+        with pytest.raises(ValueError, match="double free"):
+            p.free(a)
+
+    def test_blocks_for_and_utilization(self):
+        p = self._pool()
+        assert p.blocks_for(1) == 1
+        assert p.blocks_for(4) == 1
+        assert p.blocks_for(5) == 2
+        p.alloc(7)
+        assert p.utilization == 1.0
+
+    def test_paged_write_gather_roundtrip(self):
+        """paged_cache_update + paged_gather give back a position-
+        ordered dense view through an arbitrary block table."""
+        from quintnet_tpu.nn.attention import (paged_cache_update,
+                                               paged_gather)
+
+        bs, nb, H, Dh = 4, 6, 2, 3
+        k = jnp.zeros((nb * bs, H, Dh))
+        v = jnp.zeros_like(k)
+        tables = jnp.asarray([[3, 1, 0], [5, 2, 4]], jnp.int32)
+        # write token at position 5 of row 0 (block 1, offset 1) and
+        # position 2 of row 1 (block 5, offset 2)
+        pos = jnp.asarray([5, 2], jnp.int32)
+        kin = jnp.arange(2 * H * Dh, dtype=jnp.float32).reshape(2, H, Dh)
+        k, v = paged_cache_update(k, v, kin, kin, pos,
+                                  block_tables=tables, block_size=bs)
+        view = paged_gather(k, tables, block_size=bs)  # [2, H, 12, Dh]
+        np.testing.assert_array_equal(np.asarray(view[0, :, 5]),
+                                      np.asarray(kin[0]))
+        np.testing.assert_array_equal(np.asarray(view[1, :, 2]),
+                                      np.asarray(kin[1]))
+        assert float(jnp.abs(view[0, :, :5]).sum()) == 0.0
+
+
+class TestScheduler:
+    def _mk(self, policy="fcfs", num_blocks=16):
+        pool = KVPool(n_layers=1, n_kv_heads=1, head_dim=2, block_size=4,
+                      num_blocks=num_blocks)
+        return Scheduler(pool, policy=policy), pool
+
+    def _req(self, rid, t0=4, arrival=None, priority=0):
+        return Request(rid=rid, prompt=np.zeros((t0,), np.int32),
+                       max_new_tokens=4, priority=priority,
+                       arrival=arrival if arrival is not None else rid)
+
+    def test_fcfs_order(self):
+        s, _ = self._mk()
+        for i in (0, 1, 2):
+            s.submit(self._req(i))
+        assert [s.next_admission(1).rid for _ in range(3)] == [0, 1, 2]
+
+    def test_priority_order_with_arrival_tiebreak(self):
+        s, _ = self._mk(policy="priority")
+        s.submit(self._req(0, priority=5))
+        s.submit(self._req(1, priority=0))
+        s.submit(self._req(2, priority=0))
+        assert [s.next_admission(1).rid for _ in range(3)] == [1, 2, 0]
+
+    def test_admission_budget_head_of_line(self):
+        """If the FRONT request does not fit, nothing jumps the queue."""
+        s, pool = self._mk(num_blocks=4)  # 3 usable
+        pool.alloc(2)                     # only 1 block left
+        s.submit(self._req(0, t0=8))      # needs 3 blocks
+        s.submit(self._req(1, t0=2))      # would fit, but is behind
+        assert s.next_admission(4) is None
+        assert len(s.waiting) == 2
+
+    def test_no_free_slots_blocks_admission(self):
+        s, _ = self._mk()
+        s.submit(self._req(0))
+        assert s.next_admission(0) is None
+
+    def test_preempt_victim_is_youngest_admission(self):
+        s, _ = self._mk()
+        rs = [self._req(i) for i in range(3)]
+        for r in rs:
+            s.submit(r)
+        for _ in range(3):
+            s.next_admission(1)
+        assert Scheduler.preempt_victim(rs).rid == 2
+        # preempted request resumes ahead of younger arrivals
+        s.submit(self._req(9, arrival=99))
+        s.push_front(rs[2])
+        assert s.waiting[0].rid == 2
+
+
+# ---------------------------------------------------------------------
+# golden parity (the acceptance contract)
+# ---------------------------------------------------------------------
+
+LENGTHS = (5, 11, 3, 8, 6, 14, 4, 9)
+MAX_NEW = (10, 6, 12, 8, 5, 7, 11, 9)
+ARRIVALS = (0, 0, 1, 2, 4, 5, 7, 9)
+
+
+def _oracle(params, prompt, max_new, key, temperature=0.0, top_k=0,
+            eos=None):
+    return gpt2_generate(params, prompt[None], CFG, max_new_tokens=max_new,
+                         temperature=temperature, top_k=top_k,
+                         eos_token_id=eos, key=key)[0]
+
+
+def test_golden_parity_staggered_greedy(params, rng):
+    """8 staggered mixed-length requests, greedy: engine output ==
+    independent gpt2_generate per request, token for token."""
+    prompts = _prompts(rng, LENGTHS)
+    keys = [jax.random.key(40 + i) for i in range(len(prompts))]
+    eng = _engine(params)
+    outs = _run_staggered(eng, prompts, list(MAX_NEW), keys,
+                          list(ARRIVALS))
+    for p, m, k, o in zip(prompts, MAX_NEW, keys, outs):
+        np.testing.assert_array_equal(o, _oracle(params, p, m, k))
+    assert eng.metrics.finished == len(prompts)
+    assert eng.metrics.peak_running >= 2  # batching actually happened
+
+
+def test_golden_parity_staggered_sampling(params, rng):
+    """Same trace, fixed-seed temperature/top-k sampling."""
+    prompts = _prompts(rng, LENGTHS)
+    keys = [jax.random.key(70 + i) for i in range(len(prompts))]
+    eng = _engine(params, temperature=0.9, top_k=7)
+    outs = _run_staggered(eng, prompts, list(MAX_NEW), keys,
+                          list(ARRIVALS))
+    for p, m, k, o in zip(prompts, MAX_NEW, keys, outs):
+        np.testing.assert_array_equal(
+            o, _oracle(params, p, m, k, temperature=0.9, top_k=7))
+
+
+def test_golden_parity_llama(rng):
+    """Llama family (GQA cache, rope-at-position decode) through the
+    same engine: greedy parity vs llama_generate."""
+    from quintnet_tpu.models.llama import LlamaConfig, llama_init
+    from quintnet_tpu.models.llama_generate import llama_generate
+    from quintnet_tpu.serve import llama_family
+
+    cfg = LlamaConfig.tiny(n_layers=2)
+    lparams = llama_init(jax.random.key(1), cfg)
+    prompts = [np.asarray(rng.integers(0, cfg.vocab_size, (t,)), np.int32)
+               for t in (5, 9, 3, 12)]
+    eng = ServeEngine(llama_family(cfg), lparams, max_slots=3,
+                      block_size=4, num_blocks=32, max_seq_len=32)
+    keys = [jax.random.key(7)] * 4
+    outs = _run_staggered(eng, prompts, [8, 6, 10, 5], keys, [0, 1, 1, 3])
+    for p, m, o in zip(prompts, [8, 6, 10, 5], outs):
+        ref = llama_generate(lparams, p[None], cfg, max_new_tokens=m)[0]
+        np.testing.assert_array_equal(o, ref)
+
+
+# ---------------------------------------------------------------------
+# scheduling behaviors
+# ---------------------------------------------------------------------
+
+def test_staggered_admission_waits_for_slots(params, rng):
+    """More requests than slots: the overflow sits in the waiting
+    queue and is admitted FCFS as rows retire."""
+    prompts = _prompts(rng, (4, 4, 4, 4, 4, 4))
+    eng = _engine(params, max_slots=2)
+    rids = [eng.submit(p, 5) for p in prompts]
+    eng.step()
+    assert eng.metrics.running == 2 and eng.metrics.waiting == 4
+    eng.run()
+    assert eng.metrics.finished == 6
+    # FCFS: admission order must follow submission order
+    seqs = [eng.request(r).admit_seq for r in rids]
+    assert seqs == sorted(seqs)
+
+
+def test_pool_exhaustion_preemption_and_resume(params, rng):
+    """A pool too small for the working set forces eviction of the
+    youngest request; the evicted request resumes and still produces
+    golden output (recompute + checkpointed key state)."""
+    prompts = _prompts(rng, (6, 6, 6))
+    keys = [jax.random.key(90 + i) for i in range(3)]
+    # 8 usable blocks of 2 tokens = 16 token slots; three requests
+    # need up to 3 * (6 + 8) = 42 slots -> guaranteed pressure
+    eng = _engine(params, max_slots=3, block_size=2, num_blocks=9,
+                  max_seq_len=16, temperature=0.8, top_k=5)
+    outs = generate(eng, prompts, max_new_tokens=8, keys=keys)
+    assert eng.metrics.preempted >= 1
+    for p, k, o in zip(prompts, keys, outs):
+        np.testing.assert_array_equal(
+            o, _oracle(params, p, 8, k, temperature=0.8, top_k=5))
+    # all blocks returned to the pool at the end
+    assert eng.pool.num_used == 0
+
+
+def test_pool_too_small_for_one_request_rejected_at_submit(params, rng):
+    """A request the pool can never hold is rejected up front — were it
+    queued, admission would return None forever and run() would spin."""
+    eng = _engine(params, max_slots=1, block_size=2, num_blocks=3,
+                  max_seq_len=16)  # 2 usable blocks = 4 slots
+    with pytest.raises(ValueError, match="KV pool too small"):
+        eng.submit(_prompts(rng, (3,))[0], 8)
+    assert not eng.has_work  # nothing was queued
+
+
+def test_resume_overflow_of_prefill_len_rejected_at_submit(params, rng):
+    """With prefill_len < max_seq_len, a request whose preemption-resume
+    prefill (prompt + generated) could exceed prefill_len is rejected —
+    mid-run it would be a shape error inside the engine."""
+    eng = _engine(params, max_seq_len=40, prefill_len=16)
+    with pytest.raises(ValueError, match="exceeds prefill_len"):
+        eng.submit(_prompts(rng, (10,))[0], 8)  # 10 + 8 - 1 > 16
+    # the same prompt with a budget that fits runs fine
+    out = generate(eng, _prompts(rng, (10,))[0:1], max_new_tokens=7)[0]
+    assert len(out) == 17
+
+
+def test_eos_retirement(params, rng):
+    """Rows retire at their first EOS: output is the oracle's row
+    truncated at EOS (the oracle pads with EOS to max_new), and the
+    engine frees the row's blocks early."""
+    prompt = _prompts(rng, (6,))[0]
+    key = jax.random.key(5)
+    plain = _oracle(params, prompt, 12, key)
+    eos = int(plain[len(prompt) + 4])  # forces a mid-stream EOS hit
+    ref = _oracle(params, prompt, 12, key, eos=eos)
+
+    eng = _engine(params, eos_token_id=eos)
+    out = generate(eng, [prompt], max_new_tokens=12, keys=[key])[0]
+    assert len(out) < len(prompt) + 12  # actually retired early
+    np.testing.assert_array_equal(out, ref[:len(out)])
+    assert (np.asarray(ref[len(out):]) == eos).all()
+    assert eng.pool.num_used == 0
+
+
+def test_priority_policy_jumps_queue(params, rng):
+    prompts = _prompts(rng, (4, 4, 4))
+    eng = _engine(params, max_slots=1, policy="priority")
+    r0 = eng.submit(prompts[0], 3)            # admitted first
+    r1 = eng.submit(prompts[1], 3, priority=5)
+    r2 = eng.submit(prompts[2], 3, priority=0)
+    eng.run()
+    assert (eng.request(r2).admit_seq < eng.request(r1).admit_seq)
+    assert eng.request(r0).admit_seq == 0
+
+
+def test_streaming_callback(params, rng):
+    prompt = _prompts(rng, (5,))[0]
+    got = []
+    eng = _engine(params)
+    out = generate_stream(eng, prompt, max_new_tokens=6,
+                          on_token=lambda rid, tok, last:
+                          got.append((tok, last)))
+    toks = [t for t, _ in got]
+    np.testing.assert_array_equal(out[len(prompt):], toks)
+    assert [last for _, last in got] == [False] * 5 + [True]
+
+
+def test_submit_validation(params):
+    eng = _engine(params)
+    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+        eng.submit(np.zeros(39, np.int32), 2)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.zeros(4, np.int32), 0)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros(0, np.int32), 2)
+
+
+# ---------------------------------------------------------------------
+# the one-compiled-program invariant
+# ---------------------------------------------------------------------
+
+def test_no_recompilation_over_20_step_trace(params, rng):
+    """Admitting/retiring/preempting across a 20-step trace must hit
+    the SAME two compiled programs: zero backend compiles observed via
+    jax.monitoring after warmup, jit cache size stays 1 per program."""
+    import jax.monitoring as monitoring
+
+    eng = _engine(params, max_slots=3, block_size=2, num_blocks=12,
+                  max_seq_len=16)
+    # warmup: one full lifecycle (admission/prefill, decode, retire)
+    eng.submit(_prompts(rng, (4,))[0], 3)
+    eng.run()
+    assert eng.compile_stats() == {"prefill": 1, "decode": 1}
+
+    compiles = []
+
+    def listener(name, **kw):
+        if "backend_compile" in name:
+            compiles.append(name)
+
+    monitoring.register_event_duration_secs_listener(
+        lambda name, dur, **kw: listener(name))
+    try:
+        prompts = _prompts(rng, (3, 5, 4, 6, 3, 5))
+        arrivals = [0, 1, 3, 6, 10, 14]
+        submitted, step = 0, 0
+        rids = []
+        for step in range(20):
+            while (submitted < len(prompts)
+                   and arrivals[submitted] <= step):
+                rids.append(eng.submit(prompts[submitted], 4))
+                submitted += 1
+            eng.step()
+        assert submitted == len(prompts)
+        assert eng.metrics.finished >= 4  # retirements happened mid-trace
+    finally:
+        monitoring.clear_event_listeners()
+    assert compiles == []
+    assert eng.compile_stats() == {"prefill": 1, "decode": 1}
+
+
+# ---------------------------------------------------------------------
+# TP-sharded engine
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tp2_engine_matches_single_device(params, rng):
+    """The whole engine step under a tp=2 shard_map (head-sharded pool,
+    RowParallel psum per cached layer): outputs identical to the
+    unsharded engine's — which are themselves golden vs gpt2_generate."""
+    from quintnet_tpu.core.mesh import mesh_from_sizes
+    from quintnet_tpu.models.gpt2 import gpt2_to_tp_layout
+
+    prompts = _prompts(rng, (5, 9, 3))
+    keys = [jax.random.key(50 + i) for i in range(3)]
+    mesh = mesh_from_sizes(tp=2)
+    tp_params = gpt2_to_tp_layout(params, CFG, 2)
+    eng = _engine(tp_params, mesh=mesh)
+    outs = generate(eng, prompts, max_new_tokens=[8, 6, 10], keys=keys)
+    for p, m, k, o in zip(prompts, (8, 6, 10), keys, outs):
+        np.testing.assert_array_equal(o, _oracle(params, p, m, k))

@@ -1,0 +1,72 @@
+"""tools/serve_bench.py must never rot unexecuted: the fast suite runs
+the CLI end-to-end (CPU, tiny config, 3 steps) and checks the JSON
+contract, and the bench.py staleness scanner (test_bench_stale.py
+machinery) must surface the committed serve-bench artifact the same way
+it surfaces training-throughput records.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, REPO)
+import bench  # noqa: E402
+
+SERVE_METRIC = "serve_gpt2_tiny_tokens_per_sec"
+
+
+@pytest.mark.fast
+def test_serve_bench_smoke_cli():
+    """`serve_bench.py --steps 3 --synthetic` runs end-to-end on CPU and
+    emits one well-formed JSON line with the acceptance fields."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--steps", "3", "--synthetic"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == SERVE_METRIC
+    assert rec["rc"] == 0
+    assert rec["unit"] == "tok/s"
+    for k in ("ttft_p50_s", "ttft_p95_s", "peak_kv_utilization",
+              "decode_tokens", "prefill_tokens"):
+        assert k in rec["extras"], k
+
+
+@pytest.mark.fast
+def test_committed_serve_artifact_surfaces_in_staleness_scan():
+    """The committed serve artifact is discoverable through the same
+    last_known_result scanner the training bench uses, so a dead
+    backend can fall back to the last real serving number too."""
+    last = bench.last_known_result(metric=SERVE_METRIC)
+    assert last is not None
+    assert last["stale"] is True
+    assert last["metric"] == SERVE_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_mixed_offset_timestamps_ordered_correctly():
+    """ADVICE r5: lexicographic ISO-string comparison picks the wrong
+    newest across timezone offsets; the parsed ordering must not."""
+    # lexicographically "2026-01-01T09:00:00+09:00" > "2026-01-01T01:30.."
+    # but in UTC it is 00:00 vs 01:30 — the +09:00 stamp is OLDER
+    a = "2026-01-01T09:00:00+09:00"
+    b = "2026-01-01T01:30:00+00:00"
+    dt_a, dt_b = bench._parse_as_of(a), bench._parse_as_of(b)
+    assert dt_b > dt_a  # parsed ordering disagrees with string ordering
+    assert a > b
+
+    # naive stamps (mtime fallback) are treated as local time, not UTC
+    naive = bench._parse_as_of("2026-01-01T01:30:00")
+    assert naive.tzinfo is not None
+
+    # and unparseable strings lose to any real timestamp
+    assert bench._parse_as_of("not-a-date") < dt_a

@@ -1,0 +1,176 @@
+"""Serving benchmark: replay a synthetic Poisson request trace through
+the continuous-batching engine (quintnet_tpu/serve/) and report
+throughput + latency as ONE JSON line:
+
+  {"metric": "serve_gpt2_tiny_tokens_per_sec", "value": N,
+   "unit": "tok/s", "rc": 0, "extras": {"ttft_p50_s": ..,
+   "ttft_p95_s": .., "peak_kv_utilization": .., ...}}
+
+Arrivals are a Poisson process in ENGINE-STEP time (inter-arrival ~
+Exp(rate)), prompt lengths uniform in [min_prompt, max_prompt] — the
+mixed-length staggered workload the one-shot batch decoders
+(models/gpt2_generate.py) cannot serve without padding everything to
+the longest request.
+
+Modes:
+  python tools/serve_bench.py --synthetic              # tiny cfg, CPU-ok
+  python tools/serve_bench.py --synthetic --model llama
+  python tools/serve_bench.py --model gpt2             # 124M random init
+  python tools/serve_bench.py --synthetic --steps 3    # smoke (CI runs
+      this — tests/test_serve_bench.py — so the CLI can never rot)
+
+``--steps N`` caps the engine-step budget (unfinished requests are
+reported, not an error); default runs the trace to completion.
+``--out FILE`` appends the record to an artifacts JSON list the same
+way bench.py artifacts are kept (bench.last_known_result scans them —
+the serve bench gets the same staleness story as the training bench).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_engine(args):
+    import jax
+
+    from quintnet_tpu.serve import ServeEngine, gpt2_family, llama_family
+
+    if args.model == "gpt2":
+        from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+        cfg = (GPT2Config.tiny(n_layer=2) if args.synthetic
+               else GPT2Config.base())
+        params = gpt2_init(jax.random.key(args.seed), cfg)
+        family = gpt2_family(cfg)
+    elif args.model == "llama":
+        from quintnet_tpu.models.llama import LlamaConfig, llama_init
+
+        cfg = (LlamaConfig.tiny(n_layers=2) if args.synthetic
+               else LlamaConfig())
+        params = llama_init(jax.random.key(args.seed), cfg)
+        family = llama_family(cfg)
+    else:
+        raise SystemExit(f"unknown --model {args.model}")
+
+    max_seq = min(args.max_prompt + args.max_new, family.max_positions)
+    return ServeEngine(
+        family, params, max_slots=args.slots, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_seq_len=max_seq,
+        eos_token_id=args.eos, temperature=args.temperature,
+        policy=args.policy)
+
+
+def poisson_trace(args, vocab_size: int):
+    """[(arrival_step, prompt, max_new)] sorted by arrival."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    t = 0.0
+    trace = []
+    for _ in range(args.requests):
+        t += rng.exponential(1.0 / args.rate)
+        n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
+        prompt = rng.integers(0, vocab_size, (n,)).astype(np.int32)
+        trace.append((int(t), prompt, args.max_new))
+    return trace
+
+
+def run(args) -> dict:
+    engine = build_engine(args)
+    vocab = engine.family.cfg.vocab_size
+    trace = poisson_trace(args, vocab)
+
+    submitted = 0
+    step = 0
+    while submitted < len(trace) or engine.has_work:
+        if args.steps is not None and step >= args.steps:
+            break
+        while submitted < len(trace) and trace[submitted][0] <= step:
+            _, prompt, max_new = trace[submitted]
+            engine.submit(prompt, max_new)
+            submitted += 1
+        engine.step()
+        step += 1
+
+    s = engine.metrics.summary()
+    tag = "tiny" if args.synthetic else "full"
+    return {
+        "metric": f"serve_{args.model}_{tag}_tokens_per_sec",
+        "value": s["tokens_per_sec"],
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "rc": 0,
+        "extras": {
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "ttft_p95_s": s["ttft_s"]["p95"],
+            "latency_p50_s": s["latency_s"]["p50"],
+            "latency_p95_s": s["latency_s"]["p95"],
+            "peak_kv_utilization": s["peak_kv_utilization"],
+            "peak_running": s["peak_running"],
+            "steps": s["steps"],
+            "requests": args.requests,
+            "submitted": submitted,
+            "finished": s["finished"],
+            "preempted": s["preempted"],
+            "decode_tokens": s["decode_tokens"],
+            "prefill_tokens": s["prefill_tokens"],
+            "wall_s": s["wall_s"],
+            "model": args.model,
+            "synthetic": bool(args.synthetic),
+            "slots": args.slots,
+            "block_size": args.block_size,
+            "num_blocks": args.num_blocks,
+            "rate": args.rate,
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2", choices=("gpt2", "llama"))
+    ap.add_argument("--synthetic", action="store_true",
+                    help="tiny random-init config (CPU-testable)")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--rate", type=float, default=0.5,
+                    help="Poisson arrival rate (requests per engine step)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="cap on engine steps (default: run to completion)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=64)
+    ap.add_argument("--min-prompt", type=int, default=4)
+    ap.add_argument("--max-prompt", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--eos", type=int, default=None)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--policy", default="fcfs", choices=("fcfs", "priority"))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="append the record to this artifacts JSON file")
+    args = ap.parse_args()
+
+    out = run(args)
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        records = []
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    prev = json.load(f)
+                records = prev if isinstance(prev, list) else [prev]
+            except (OSError, json.JSONDecodeError):
+                records = []
+        records.append(out)
+        with open(args.out, "w") as f:
+            json.dump(records, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
