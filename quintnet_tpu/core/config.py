@@ -165,6 +165,12 @@ class TrainingConfig:
     # host-side batch prefetch depth (data/datasets.prefetch_batches):
     # overlaps tokenisation/stacking with device steps. 0 disables.
     prefetch: int = 2
+    # step-granular checkpoint cadence (quintnet_tpu/ft/): save the full
+    # train state + cursor every N optimizer steps and/or T seconds
+    # (OR-combined), async, on top of the end-of-epoch saves. 0 = only
+    # epoch boundaries. Preemptible-pod guidance: docs/fault_tolerance.md.
+    save_every_steps: int = 0
+    save_every_seconds: float = 0.0
 
     @property
     def remat_mode(self):

@@ -10,6 +10,7 @@ from quintnet_tpu.data.datasets import (
     make_batches,
     pack_documents,
     prefetch_batches,
+    skip_batches,
 )
 
 __all__ = [
@@ -21,4 +22,5 @@ __all__ = [
     "make_batches",
     "pack_documents",
     "prefetch_batches",
+    "skip_batches",
 ]

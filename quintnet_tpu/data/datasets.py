@@ -122,17 +122,48 @@ class ArrayDataset:
 
 
 def make_batches(ds: ArrayDataset, batch_size: int, *, seed: int = 0,
-                 shuffle: bool = True,
-                 drop_last: bool = True) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+                 shuffle: bool = True, drop_last: bool = True,
+                 start_batch: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Simple epoch iterator. Batches are GLOBAL; sharding over dp happens
-    on device via Strategy.shard_batch."""
+    on device via Strategy.shard_batch (so a mid-epoch resume needs no
+    per-host bookkeeping — every process sees the same global stream).
+
+    ``start_batch`` skips the first K batches by index arithmetic over
+    the (seeded, already-shuffled) permutation — the skip-to-cursor path
+    for step-granular resume (quintnet_tpu/ft/): no skipped sample is
+    ever materialised, and batch ``start_batch + n`` is bit-identical to
+    batch ``start_batch + n`` of a fresh epoch."""
     idx = np.arange(len(ds))
     if shuffle:
         np.random.default_rng(seed).shuffle(idx)
     end = len(idx) - (len(idx) % batch_size) if drop_last else len(idx)
-    for i in range(0, end, batch_size):
+    for i in range(start_batch * batch_size, end, batch_size):
         j = idx[i:i + batch_size]
         yield ds.x[j], ds.y[j]
+
+
+def skip_batches(batches: Iterator, n: int) -> Iterator:
+    """Generic skip-to-cursor for arbitrary batch iterables: consume and
+    discard the first ``n`` batches (each IS materialised — correct for
+    any iterator, including streaming ones, but pays the host data
+    cost). Map-style datasets should prefer their ``start_batch=``
+    argument, which skips by index arithmetic instead.
+
+    A stream that ends BEFORE ``n`` batches raises ``ValueError``: the
+    resume cursor points past the data, which means the dataset or
+    batch size changed since the checkpoint — silently resuming there
+    would corrupt the run. (A stream of exactly ``n`` batches is fine —
+    that is a legitimate resume at the epoch's end.)"""
+    it = iter(batches)
+    for k in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            raise ValueError(
+                f"resume cursor skips {n} batches but the stream ended "
+                f"after {k} — dataset or batch size changed since the "
+                "checkpoint was written?") from None
+    return it
 
 
 def load_hf_dataset(path: str, split: str = "train"):
@@ -314,13 +345,14 @@ class PackedLMDataset:
         return len(self.rows)
 
     def batches(self, batch_size: int, *, seed: int = 0,
-                shuffle: bool = True, drop_last: bool = True
+                shuffle: bool = True, drop_last: bool = True,
+                start_batch: int = 0
                 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         idx = np.arange(len(self.rows))
         if shuffle:
             np.random.default_rng(seed).shuffle(idx)
         end = len(idx) - (len(idx) % batch_size) if drop_last else len(idx)
-        for i in range(0, end, batch_size):
+        for i in range(start_batch * batch_size, end, batch_size):
             b = self.rows[idx[i:i + batch_size]]
             yield b, b.copy()
 
@@ -393,13 +425,15 @@ class SummarizationDataset:
         return (np.asarray(ids, np.int32), np.asarray(labels, np.int32))
 
     def batches(self, batch_size: int, *, seed: int = 0, shuffle: bool = True,
-                drop_last: bool = True
+                drop_last: bool = True, start_batch: int = 0
                 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         idx = np.arange(len(self.rows))
         if shuffle:
             np.random.default_rng(seed).shuffle(idx)
         end = len(idx) - (len(idx) % batch_size) if drop_last else len(idx)
-        for i in range(0, end, batch_size):
+        # start_batch skips by index — no skipped row is ever tokenised
+        # (the win over generic skip_batches is largest here)
+        for i in range(start_batch * batch_size, end, batch_size):
             enc = [self.encode_row(*self.rows[j]) for j in idx[i:i + batch_size]]
             yield (np.stack([e[0] for e in enc]),
                    np.stack([e[1] for e in enc]))

@@ -1,0 +1,47 @@
+"""Fault tolerance: preemption-safe training with step-granular resume.
+
+Preemptible TPU pods make worker loss an EXPECTED event, not a crash
+(Oobleck/Varuna treat it the same way). This package makes a training
+run survive kills with results bit-identical to an uninterrupted run:
+
+- :mod:`cursor`  — ``TrainCursor``: the host-side piece of train state
+  (epoch, step, epoch losses so far, ``History``) checkpointed as a
+  JSON item next to params/opt in the same Orbax step directory;
+- :mod:`preempt` — SIGTERM/SIGINT handler (finish the in-flight step,
+  emergency synchronous snapshot, sentinel exit code) and the
+  save-every-N-steps/T-seconds cadence controller;
+- :mod:`chaos`   — deterministic fault injection (kill-at-step-K,
+  checkpoint truncation/corruption, restore-failure) for tests and the
+  ``tools/ft_run.py`` supervisor;
+- :mod:`restore` — integrity-checked restore that falls back to the
+  previous good step when the latest checkpoint is corrupt;
+- :mod:`goodput` — useful-step-time / wall-time accounting (checkpoint
+  overhead, work lost per fault) for the one-line JSON goodput report.
+
+The hooks enter the training loop through one object::
+
+    from quintnet_tpu.ft import FTContext, PreemptionHandler
+    with PreemptionHandler() as handler:
+        trainer.fit(batches_fn, ft=FTContext(preemption=handler))
+
+``Trainer.fit`` works unchanged without an ``FTContext`` — cadence
+saves alone are driven by ``training.save_every_steps`` /
+``training.save_every_seconds`` in the config.
+"""
+
+from quintnet_tpu.ft.chaos import (  # noqa: F401
+    CHAOS_KILL_EXIT_CODE,
+    ChaosKilled,
+    ChaosMonkey,
+    corrupt_checkpoint,
+)
+from quintnet_tpu.ft.context import FTContext  # noqa: F401
+from quintnet_tpu.ft.cursor import TrainCursor  # noqa: F401
+from quintnet_tpu.ft.goodput import GoodputMeter  # noqa: F401
+from quintnet_tpu.ft.preempt import (  # noqa: F401
+    PREEMPTED_EXIT_CODE,
+    CadenceController,
+    PreemptionHandler,
+    TrainingPreempted,
+)
+from quintnet_tpu.ft.restore import restore_with_fallback  # noqa: F401

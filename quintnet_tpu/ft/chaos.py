@@ -1,0 +1,149 @@
+"""Deterministic fault injection for the fault-tolerance test story.
+
+A resume path that is never exercised is broken by default; this module
+makes faults repeatable so tests and the ``tools/ft_run.py`` supervisor
+can inject them at an exact step and assert bit-identical recovery.
+
+Three fault families:
+
+- **kill-at-step-K** (:class:`ChaosMonkey`): after step K completes,
+  die. ``mode='hard'`` is ``os._exit`` — no atexit, no finally, no
+  flush, the closest a test gets to a yanked node; ``mode='sigterm'``
+  delivers a real SIGTERM to self, exercising the graceful
+  :class:`~quintnet_tpu.ft.preempt.PreemptionHandler` path;
+  ``mode='raise'`` raises :class:`ChaosKilled` for in-process tests
+  that need to keep the interpreter (and then build a fresh Trainer to
+  resume).
+- **checkpoint corruption** (:func:`corrupt_checkpoint`): truncate or
+  scribble over an array file inside a committed Orbax step directory —
+  the restore path must detect it and fall back to the previous step
+  (ft/restore.py).
+- **restore failure** (``fail_restores=N``): the first N restore
+  attempts raise, exercising the fallback loop without touching disk.
+
+Configuration is programmatic or via the ``QT_CHAOS`` env var (JSON,
+e.g. ``{"kill_at_step": 7, "mode": "hard"}``) — the env route is how
+the supervisor arms a fault in a child process it is about to launch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+# Distinct from PREEMPTED_EXIT_CODE (graceful): a hard chaos kill looks
+# like an unannounced node loss. Supervisors restart on both.
+CHAOS_KILL_EXIT_CODE = 113
+
+CHAOS_ENV = "QT_CHAOS"
+
+
+class ChaosKilled(Exception):
+    """In-process stand-in for a hard kill (``mode='raise'``)."""
+
+    def __init__(self, global_step: int):
+        super().__init__(f"chaos kill after global step {global_step}")
+        self.global_step = global_step
+
+
+@dataclass
+class ChaosMonkey:
+    """Kill/fail injector polled by the train loop (via ``FTContext``).
+
+    ``kill_at_step`` counts GLOBAL steps (monotone across epochs and
+    restarts), so a relaunched run armed with a later step resumes,
+    passes its old death point, and dies at the new one — exactly the
+    repeated-preemption scenario the supervisor test replays.
+    """
+
+    kill_at_step: Optional[int] = None
+    mode: str = "hard"  # hard | sigterm | raise
+    fail_restores: int = 0
+    killed: bool = field(default=False, init=False)
+    restore_failures_injected: int = field(default=0, init=False)
+
+    @staticmethod
+    def from_env(env: Optional[dict] = None) -> Optional["ChaosMonkey"]:
+        raw = (env if env is not None else os.environ).get(CHAOS_ENV)
+        if not raw:
+            return None
+        spec = json.loads(raw)
+        return ChaosMonkey(
+            kill_at_step=spec.get("kill_at_step"),
+            mode=spec.get("mode", "hard"),
+            fail_restores=int(spec.get("fail_restores", 0)))
+
+    def on_step_end(self, global_step: int) -> None:
+        """Die if the armed step was just completed (idempotent: the
+        sigterm path keeps stepping until the handler-driven snapshot
+        lands, and must not re-signal every step)."""
+        if self.killed or self.kill_at_step is None:
+            return
+        if global_step < self.kill_at_step:
+            return
+        self.killed = True
+        if self.mode == "raise":
+            raise ChaosKilled(global_step)
+        if self.mode == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        # hard: emit the one marker line the supervisor uses to account
+        # lost work, then vanish without cleanup.
+        print(json.dumps({"ft_kill": {"global_step": global_step}}),
+              flush=True)
+        sys.stdout.flush()
+        os._exit(CHAOS_KILL_EXIT_CODE)
+
+    def on_restore_attempt(self, step: int) -> None:
+        """Raise for the first ``fail_restores`` attempts (counted across
+        steps — the fallback loop's retry IS the next attempt)."""
+        if self.restore_failures_injected < self.fail_restores:
+            self.restore_failures_injected += 1
+            raise OSError(
+                f"chaos: injected restore failure for step {step} "
+                f"({self.restore_failures_injected}/{self.fail_restores})")
+
+
+def _step_array_files(ckpt_dir: str, step: int) -> List[str]:
+    """Array-payload files inside one committed Orbax step directory,
+    largest first (corrupting metadata would be caught by a cheaper
+    parse; the interesting fault is a torn data write)."""
+    root = os.path.join(ckpt_dir, str(step))
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no step directory {root}")
+    files = []
+    for r, _dirs, names in os.walk(root):
+        for n in names:
+            p = os.path.join(r, n)
+            files.append((os.path.getsize(p), p))
+    if not files:
+        raise FileNotFoundError(f"step directory {root} has no files")
+    return [p for _sz, p in sorted(files, reverse=True)]
+
+
+def corrupt_checkpoint(ckpt_dir: str, step: int, *,
+                       kind: str = "truncate") -> str:
+    """Damage a committed checkpoint step in place; returns the path hit.
+
+    ``truncate`` halves the largest payload file (torn write);
+    ``scribble`` flips bytes mid-file keeping the size (bit rot);
+    ``unlink`` removes the file outright (lost object).
+    """
+    path = _step_array_files(ckpt_dir, step)[0]
+    size = os.path.getsize(path)
+    if kind == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif kind == "scribble":
+        with open(path, "r+b") as f:
+            f.seek(max(size // 2 - 8, 0))
+            f.write(b"\xde\xad\xbe\xef" * 4)
+    elif kind == "unlink":
+        os.unlink(path)
+    else:
+        raise ValueError(f"unknown corruption kind {kind!r}")
+    return path

@@ -1,0 +1,29 @@
+"""FTContext: the one optional argument that fault-tolerance adds to
+``Trainer.fit``.
+
+Bundling keeps the trainer signature stable while the subsystem grows:
+the loop asks three questions per step — "record this step?"
+(goodput), "inject a fault?" (chaos), "were we asked to stop?"
+(preemption) — and the context answers them. Any member may be None;
+an all-None context is equivalent to not passing one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from quintnet_tpu.ft.chaos import ChaosMonkey
+from quintnet_tpu.ft.goodput import GoodputMeter
+from quintnet_tpu.ft.preempt import PreemptionHandler
+
+
+@dataclass
+class FTContext:
+    preemption: Optional[PreemptionHandler] = None
+    chaos: Optional[ChaosMonkey] = None
+    goodput: Optional[GoodputMeter] = None
+
+    @property
+    def preemption_requested(self) -> bool:
+        return self.preemption is not None and self.preemption.triggered

@@ -1,0 +1,73 @@
+"""TrainCursor: the host-side train state that makes resume step-granular.
+
+Params and optimizer state already survive a kill (train/checkpoint.py);
+what the epoch-granular resume lost was everything the HOST tracks —
+which step of which epoch comes next, the loss record accumulated so
+far this epoch, and the run's ``History`` (which ``to_jsonl`` used to
+rebuild from scratch after a restart, silently dropping the pre-crash
+record). The cursor packages exactly that and rides in the same Orbax
+step directory as the arrays (``CheckpointManager.save(cursor=...)``
+writes it as a JSON item via ``ocp.args.Composite``), so cursor and
+arrays commit atomically: a checkpoint either has both or neither.
+
+No device RNG state is needed: the per-step dropout seed is derived
+from (config seed, epoch, step) in ``Trainer.fit``, and the data order
+is a pure function of (epoch seed, step) for the map-style iterators in
+data/datasets.py — replaying from (epoch, step_in_epoch) reproduces the
+uninterrupted run bit-for-bit (tests/test_ft.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from quintnet_tpu.train.trainer import History
+
+CURSOR_VERSION = 1
+
+
+@dataclass
+class TrainCursor:
+    """Points at the NEXT unit of work: ``epoch`` / ``step_in_epoch`` are
+    where a resumed run picks up (end-of-epoch saves carry
+    ``(epoch + 1, 0)``; a cadence save after batch ``i`` carries
+    ``(epoch, i + 1)``).
+
+    ``loss_sum`` / ``loss_count`` carry the in-progress epoch's loss
+    record as a sequential float64 running sum: the resumed run
+    continues the SAME accumulation an uninterrupted run performs (JSON
+    round-trips binary64 exactly), so the epoch mean is bit-identical —
+    and the cursor stays O(1) however long the epoch is, keeping cadence
+    saves and the time-boxed SIGTERM emergency snapshot cheap.
+    """
+
+    epoch: int = 0
+    step_in_epoch: int = 0
+    global_step: int = 0
+    loss_sum: float = 0.0
+    loss_count: int = 0
+    history: History = field(default_factory=History)
+    seed: Optional[int] = None
+    version: int = CURSOR_VERSION
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["history"] = dataclasses.asdict(self.history)
+        return d
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> Optional["TrainCursor"]:
+        """Tolerant inverse of :meth:`to_dict` (unknown keys from a newer
+        writer are dropped, missing keys default)."""
+        if not d:
+            return None
+        d = dict(d)
+        hist_raw = d.pop("history", None) or {}
+        names = {f.name for f in dataclasses.fields(History)}
+        history = History(**{k: v for k, v in hist_raw.items() if k in names})
+        names = {f.name for f in dataclasses.fields(TrainCursor)}
+        cur = TrainCursor(**{k: v for k, v in d.items() if k in names})
+        cur.history = history
+        return cur

@@ -1,0 +1,63 @@
+"""Integrity-checked restore: fall back to the previous good checkpoint.
+
+A preemption can land mid-write; Orbax's atomic commit makes that
+*unlikely* to leave a bad latest step, but "unlikely" is not a recovery
+story — a truncated array file, a lost object, or a flaky filesystem
+must cost one checkpoint interval, not the run. The loop here walks the
+step index descending, attempts a full restore (arrays + cursor) of
+each, and returns the newest step that loads; corrupt steps are
+reported, not fatal, unless NO step loads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+from quintnet_tpu.train.checkpoint import (CheckpointManager,
+                                           CheckpointRestoreError)
+
+
+def restore_with_fallback(
+    mgr: CheckpointManager,
+    template: Any = None,
+    *,
+    chaos=None,
+    log: Callable[[str], None] = print,
+) -> Tuple[Any, Optional[dict], int, List[int]]:
+    """Restore the newest checkpoint that actually loads.
+
+    Returns ``(state, cursor_dict, step, skipped_steps)`` where
+    ``cursor_dict`` is None for checkpoints written without a cursor
+    (pre-ft saves — resume degrades to epoch granularity) and
+    ``skipped_steps`` lists newer steps that failed integrity (newest
+    first). Raises :class:`FileNotFoundError` when the directory holds
+    no steps at all, or the final :class:`CheckpointRestoreError` when
+    every step is bad.
+
+    ``chaos`` is an optional :class:`~quintnet_tpu.ft.chaos.ChaosMonkey`
+    whose ``on_restore_attempt`` can inject failures (tests /
+    tools/ft_run.py).
+    """
+    steps = sorted(mgr.all_steps(), reverse=True)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint in {mgr.directory}")
+    skipped: List[int] = []
+    last_err: Optional[Exception] = None
+    for step in steps:
+        try:
+            if chaos is not None:
+                chaos.on_restore_attempt(step)
+            state = mgr.restore(template, step=step)
+            cursor = mgr.restore_cursor(step=step)
+            if skipped:
+                log(f"checkpoint fallback: step(s) {skipped} corrupt, "
+                    f"resuming from previous good step {step}")
+            return state, cursor, step, skipped
+        except (CheckpointRestoreError, OSError, ValueError) as e:
+            log(f"checkpoint step {step} failed to restore: {e}")
+            skipped.append(step)
+            last_err = e
+    raise CheckpointRestoreError(
+        mgr.directory, steps[0], available=[],
+        cause=f"all {len(steps)} step(s) failed integrity "
+              f"(tried {steps}); last error: {last_err}")

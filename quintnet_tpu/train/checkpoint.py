@@ -24,6 +24,32 @@ except ImportError:  # pragma: no cover
     _HAVE_ORBAX = False
 
 
+class CheckpointRestoreError(RuntimeError):
+    """A specific checkpoint step failed to load — with the recovery
+    options spelled out, instead of a raw orbax/tensorstore traceback
+    from deep inside the array reader.
+
+    Attributes: ``directory``, ``step`` (the bad one), ``available``
+    (other steps present in the directory, newest first).
+    """
+
+    def __init__(self, directory: str, step: int, *, available, cause):
+        self.directory = directory
+        self.step = step
+        self.available = sorted(available, reverse=True)
+        msg = (f"checkpoint step {step} in {directory} failed to "
+               f"restore: {cause}")
+        if self.available:
+            msg += (f". Older steps exist: {self.available} — retry with "
+                    f"restore(step={self.available[0]}), or use "
+                    "quintnet_tpu.ft.restore.restore_with_fallback to "
+                    "resume from the newest step that loads")
+        else:
+            msg += (". No other steps exist in this directory; the run "
+                    "must re-init from scratch")
+        super().__init__(msg)
+
+
 class CheckpointManager:
     """Step-indexed train-state checkpoints (params + opt_state + step).
 
@@ -31,6 +57,11 @@ class CheckpointManager:
     ``template`` is a pytree of jax.ShapeDtypeStruct or arrays carrying
     the target shardings (restore onto ANY mesh — the capability the
     reference's merge_checkpoints.py CLI exists to approximate offline).
+
+    ``save(..., cursor=dict)`` additionally writes a JSON item into the
+    SAME step directory (``ocp.args.Composite``), so the host-side
+    train cursor (quintnet_tpu/ft/cursor.py) commits atomically with
+    the arrays: a checkpoint either has both or neither.
     """
 
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3):
@@ -43,13 +74,39 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True),
         )
 
-    def save(self, step: int, state: Any, *, wait: bool = True) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+    def save(self, step: int, state: Any, *, cursor: Optional[dict] = None,
+             wait: bool = True, force: bool = False) -> None:
+        if step in self._mgr.all_steps():
+            # never overwrite a committed step by default. A re-reached
+            # step is bit-identical by deterministic replay (rewriting
+            # buys nothing), and a delete-then-rewrite would hand a
+            # mid-write kill BOTH copies — a torn step must cost one
+            # fallback interval (ft/restore.py), never the data that
+            # still loads. ``force`` is for the two cases where the
+            # on-disk copy is known worthless or superseded: a step the
+            # restore fallback PROVED unreadable (deleting it loses
+            # nothing, and without the rewrite replay could never move
+            # the high-water mark past it), and an epoch-boundary
+            # rewrite of a same-step mid-epoch cursor (trainer
+            # save_state(boundary=True), done synchronously).
+            if not force:
+                return
+            # the doomed copy may still be mid-async-write (a cadence
+            # save moments ago) — barrier before deleting it
+            self._mgr.wait_until_finished()
+            self._mgr.delete(step)
+        items = {"state": ocp.args.StandardSave(state)}
+        if cursor is not None:
+            items["cursor"] = ocp.args.JsonSave(cursor)
+        self._mgr.save(step, args=ocp.args.Composite(**items))
         if wait:
             self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list:
+        return sorted(self._mgr.all_steps())
 
     def wait_until_finished(self) -> None:
         """Barrier on any in-flight async save."""
@@ -60,13 +117,52 @@ class CheckpointManager:
         """``template=None`` restores as plain host numpy arrays with the
         saved structure — the no-mesh reload path the single-device
         verifiers use (reference: examples/verify_model.py:23-60 reloads
-        with no distributed code)."""
+        with no distributed code).
+
+        An incomplete/corrupt step raises :class:`CheckpointRestoreError`
+        naming the bad step and the fallbacks, never a raw orbax
+        traceback."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        args = (ocp.args.StandardRestore(template)
-                if template is not None else ocp.args.StandardRestore())
-        return self._mgr.restore(step, args=args)
+        single = (ocp.args.StandardRestore(template)
+                  if template is not None else ocp.args.StandardRestore())
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(state=single))["state"]
+        except Exception as e:  # noqa: BLE001 — orbax/tensorstore raise
+            # a zoo of types for torn files; all mean "this step is bad"
+            try:
+                # pre-cursor checkpoints are a SINGLE StandardSave item,
+                # which orbax refuses to read through Composite — retry
+                # with the legacy layout before declaring the step bad
+                return self._mgr.restore(step, args=single)
+            except Exception:  # noqa: BLE001 — genuinely bad step;
+                pass           # report the ORIGINAL failure below
+            others = [s for s in self.all_steps() if s != step]
+            raise CheckpointRestoreError(self.directory, step,
+                                         available=others, cause=e) from e
+
+    def restore_cursor(self, *, step: Optional[int] = None
+                       ) -> Optional[dict]:
+        """The JSON train cursor saved next to the arrays, or None for
+        checkpoints written without one (resume then degrades to the
+        epoch-granular contract). A PRESENT-but-unreadable cursor raises
+        :class:`CheckpointRestoreError` — that step is damaged goods."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        if not os.path.isdir(os.path.join(self.directory, str(step),
+                                          "cursor")):
+            return None
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(
+                    cursor=ocp.args.JsonRestore()))["cursor"]
+        except Exception as e:  # noqa: BLE001 — see restore()
+            others = [s for s in self.all_steps() if s != step]
+            raise CheckpointRestoreError(self.directory, step,
+                                         available=others, cause=e) from e
 
     def close(self):
         self._mgr.close()

@@ -11,7 +11,10 @@ Differences from the reference worth knowing:
   metric propagation dance, trainer.py:168-187 — and no silent
   assumption that metrics are non-negative);
 - checkpoints save sharded via train/checkpoint.py and RESUME works
-  (the reference is save-only);
+  (the reference is save-only) — STEP-granular: the host-side cursor
+  (epoch, step, epoch losses, History) rides in the checkpoint
+  (quintnet_tpu/ft/), so a preempted run continues mid-epoch with
+  bit-identical results to an uninterrupted one (tests/test_ft.py);
 - a single process drives the whole mesh (SPMD), so "rank 0 only"
   logging guards are unnecessary.
 """
@@ -149,7 +152,14 @@ class History:
     def to_jsonl(self, path: str):
         """One JSON line per epoch (loss/metrics) + a final summary line
         — greppable run record (the reference's only run record is
-        stdout scrollback)."""
+        stdout scrollback).
+
+        Rewrites the whole file: safe because ``History`` is part of the
+        checkpointed train cursor (ft/cursor.py), so after a restart the
+        in-memory object holds the FULL run — pre-crash epochs included
+        — and ``wall_time_s`` accumulates across restarts. (Before the
+        cursor existed, this "w" open silently clobbered the pre-crash
+        record with a fresh one.)"""
         import json
 
         with open(path, "w") as f:
@@ -165,6 +175,41 @@ class History:
                 "wall_time_s": round(self.wall_time_s, 2),
                 "best_val_loss": self.best_val_loss,
                 "best_epoch": self.best_epoch}) + "\n")
+
+
+def _call_batches_fn(fn, epoch: int, skip: int):
+    """Call a train/val batches factory, passing the mid-epoch resume
+    offset to factories that accept it.
+
+    Returns ``(iterable, skip_consumed)``: the offset is handed to the
+    factory ONLY when it declares a parameter literally named ``start``
+    or ``start_batch`` (second positional, or keyword-only) — it then
+    handles the skip itself (the map-style iterators in
+    data/datasets.py slice the shuffled index — zero data touched).
+    Matching by NAME, not arity, keeps unrelated two-argument factories
+    (``lambda ep, shuffle=True: ...``) safe from a silently hijacked
+    second parameter. Everything else gets the generic
+    consume-and-discard skip in ``fit``. A matching offset parameter is
+    passed even when the offset is 0, so it may be a required one.
+    """
+    names = ("start", "start_batch")
+    try:
+        import inspect
+
+        ps = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):  # builtins/partials w/o signature
+        ps = None
+    if ps is not None:
+        if (len(ps) >= 2
+                and ps[1].kind in (ps[1].POSITIONAL_ONLY,
+                                   ps[1].POSITIONAL_OR_KEYWORD)
+                and ps[1].name in names):
+            return fn(epoch, skip), True
+        kw = next((p.name for p in ps
+                   if p.kind == p.KEYWORD_ONLY and p.name in names), None)
+        if kw is not None:
+            return fn(epoch, **{kw: skip}), True
+    return fn(epoch), False
 
 
 class Trainer:
@@ -194,6 +239,16 @@ class Trainer:
 
         self.step_fn = self.strategy.make_train_step(self.model, self.optimizer)
         self._eval_fn = None
+        self._last_ckpt_step = None  # newest orbax step written/restored
+        # steps the restore fallback proved unreadable: replay re-reaches
+        # them and must REWRITE (save force=True), or the corrupt step
+        # would shadow every future save attempt at that step and each
+        # new preemption would fall back to the same old good step
+        self._bad_ckpt_steps: set = set()
+        # whether the newest checkpoint carries a mid-epoch cursor —
+        # lets the epoch-boundary save heal a cadence save that landed
+        # on the epoch's final batch (same global_step, boundary shape)
+        self._last_ckpt_midepoch = False
 
     # -- state -------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None):
@@ -205,18 +260,68 @@ class Trainer:
         return params, opt_state
 
     def resume_or_init(self, seed: Optional[int] = None):
-        """Restore the latest checkpoint if one exists (absent from the
-        reference), else fresh init. Returns (params, opt_state, start_epoch)."""
+        """Epoch-level view of :meth:`resume_state` kept for callers that
+        only schedule whole epochs. Returns (params, opt_state,
+        start_epoch). A MID-EPOCH checkpoint (cadence save / emergency
+        snapshot) cannot be expressed as an epoch boundary — handing it
+        back as one would make an external epoch loop re-apply the
+        epoch's first steps on top of params that already contain them —
+        so this raises instead; drive the run through :meth:`fit`
+        (step-granular resume) or :meth:`resume_state` in that case."""
+        params, opt_state, cursor = self.resume_state(seed)
+        if cursor is not None and cursor.step_in_epoch:
+            raise RuntimeError(
+                f"latest checkpoint is mid-epoch (epoch {cursor.epoch} "
+                f"step {cursor.step_in_epoch}, global step "
+                f"{cursor.global_step}); resume_or_init only hands back "
+                "epoch boundaries — resume via Trainer.fit() "
+                "(step-granular), or resume_state() and pass its cursor "
+                "to fit(params=..., opt_state=..., cursor=...)")
+        return params, opt_state, (cursor.epoch if cursor is not None else 0)
+
+    def resume_state(self, seed: Optional[int] = None, *, goodput=None,
+                     chaos=None):
+        """Restore the newest checkpoint that loads (corrupt steps fall
+        back to the previous good one — ft/restore.py), else fresh init.
+
+        Returns ``(params, opt_state, cursor)`` where ``cursor`` is a
+        :class:`~quintnet_tpu.ft.cursor.TrainCursor` pointing at the
+        next (epoch, step) to run — None on fresh init. Checkpoints
+        written before the cursor existed degrade to epoch granularity.
+        """
         params, opt_state = self.init_state(seed)
-        if self.checkpoint_dir:
-            mgr = self._manager()
-            if mgr.latest_step() is not None:
-                restored = mgr.restore({"params": params, "opt": opt_state,
-                                        "epoch": 0})
-                self.log(f"resumed from epoch {int(restored['epoch'])}")
-                return (restored["params"], restored["opt"],
-                        int(restored["epoch"]) + 1)
-        return params, opt_state, 0
+        if not self.checkpoint_dir:
+            return params, opt_state, None
+        mgr = self._manager()
+        if mgr.latest_step() is None:
+            return params, opt_state, None
+        from quintnet_tpu.ft.cursor import TrainCursor
+        from quintnet_tpu.ft.restore import restore_with_fallback
+
+        t_restore = time.time()
+        state, cursor_dict, step, skipped = restore_with_fallback(
+            mgr, {"params": params, "opt": opt_state, "epoch": 0},
+            chaos=chaos, log=self.log)
+        self._last_ckpt_step = step
+        self._bad_ckpt_steps = set(skipped)
+        cursor = TrainCursor.from_dict(cursor_dict)
+        self._last_ckpt_midepoch = (cursor is not None
+                                    and cursor.step_in_epoch != 0)
+        if cursor is None:
+            # legacy cursor-less checkpoint: orbax steps were EPOCH
+            # indices. Anchor global_step at the restored index so new
+            # (global-step-indexed) saves — including an emergency
+            # snapshot on the very first resumed steps — sort strictly
+            # after it and are never skipped by the save_state guard.
+            cursor = TrainCursor(epoch=int(state["epoch"]) + 1,
+                                 global_step=step)
+        if goodput is not None:
+            goodput.on_resume(cursor.global_step, time.time() - t_restore,
+                              len(skipped))
+        self.log(f"resumed from checkpoint step {step}: continuing at "
+                 f"epoch {cursor.epoch} step {cursor.step_in_epoch} "
+                 f"(global step {cursor.global_step})")
+        return state["params"], state["opt"], cursor
 
     def _manager(self, *, best: bool = False):
         """Cached CheckpointManager(s) — one per directory, reused across
@@ -235,6 +340,9 @@ class Trainer:
         return self._mgrs[key]
 
     def save(self, epoch: int, params, opt_state):
+        """Epoch-indexed save without a cursor — external callers that
+        drive their own loop. ``fit`` itself saves via
+        :meth:`save_state` (global-step indexed, cursor attached)."""
         if not self.checkpoint_dir:
             return
         # async: orbax snapshots device arrays before returning, then
@@ -243,6 +351,48 @@ class Trainer:
         self._manager().save(
             epoch, {"params": params, "opt": opt_state, "epoch": epoch},
             wait=False)
+
+    def save_state(self, params, opt_state, cursor, *,
+                   wait: bool = False, boundary: bool = False) -> float:
+        """Checkpoint arrays + train cursor at orbax step
+        ``cursor.global_step``. Returns host-blocking seconds (goodput's
+        checkpoint-overhead figure). Skips steps already on disk — a
+        resumed run revisits the boundary it restored from (the state is
+        identical by construction, rewriting it buys nothing) — with two
+        exceptions: a step the restore fallback proved UNREADABLE is
+        rewritten in place (force), and an epoch-boundary save
+        (``boundary=True``) whose global step equals a just-written
+        mid-epoch cadence save rewrites it synchronously so the newest
+        on-disk cursor reflects the true epoch boundary
+        (:meth:`resume_or_init` would otherwise refuse a run that in
+        fact sits at one)."""
+        if not self.checkpoint_dir:
+            return 0.0
+        step = cursor.global_step
+        force = step in self._bad_ckpt_steps
+        if self._last_ckpt_step is not None and step <= self._last_ckpt_step:
+            heal = (boundary and step == self._last_ckpt_step
+                    and self._last_ckpt_midepoch)
+            if not heal:
+                return 0.0
+            # cadence landed on the epoch's final batch: same arrays,
+            # but the cursor on disk is mid-epoch-shaped. Rewrite with
+            # the boundary cursor (synchronous — the delete+rewrite
+            # window must not outlive this call).
+            force, wait = True, True
+        t = time.time()
+        # the state's "epoch" is the epoch the arrays were produced in
+        # (end-of-epoch cursors already point at epoch+1) — what the
+        # single-device verifiers report (tools/verify_vit.py)
+        epoch = (cursor.epoch - 1 if cursor.step_in_epoch == 0
+                 else cursor.epoch)
+        self._manager().save(
+            step, {"params": params, "opt": opt_state, "epoch": epoch},
+            cursor=cursor.to_dict(), wait=wait, force=force)
+        self._last_ckpt_step = step
+        self._last_ckpt_midepoch = cursor.step_in_epoch != 0
+        self._bad_ckpt_steps.discard(step)
+        return time.time() - t
 
     def save_best(self, epoch: int, params, opt_state, val_loss: float):
         """Best-by-val-loss retention in a sibling ``<dir>-best``
@@ -352,42 +502,140 @@ class Trainer:
     def fit(self, train_batches_fn: Callable[[int], Iterable],
             *, epochs: Optional[int] = None,
             val_batches_fn: Optional[Callable[[int], Iterable]] = None,
-            params=None, opt_state=None) -> History:
+            params=None, opt_state=None, cursor=None, ft=None) -> History:
         """``train_batches_fn(epoch) -> iterable of (x, y)`` host batches
-        (global batch size; sharding happens here)."""
+        (global batch size; sharding happens here). A factory whose
+        second parameter is named ``start`` or ``start_batch`` (second
+        positional or keyword-only) receives the mid-epoch resume
+        offset and lets map-style data skip to it for free
+        (data/datasets.py ``start_batch=``); other factories are
+        skipped generically (each skipped batch is materialised and
+        discarded).
+
+        Explicit state: ``fit(params=..., opt_state=...)`` skips the
+        automatic resume; pass the matching ``cursor`` from
+        :meth:`resume_state` to continue that state's run mid-stream
+        (without one, the explicit state is treated as a FRESH run from
+        epoch 0).
+
+        ``ft``: optional :class:`~quintnet_tpu.ft.FTContext` wiring in
+        preemption handling, fault injection, and goodput accounting.
+        Step-granular cadence saves are controlled by
+        ``training.save_every_steps`` / ``save_every_seconds`` and work
+        with or without an ``ft`` context.
+        """
+        from quintnet_tpu.ft.cursor import TrainCursor
+        from quintnet_tpu.ft.preempt import (CadenceController,
+                                             TrainingPreempted)
+
         epochs = epochs or self.config.training.epochs
+        if ft is not None and ft.preemption is not None \
+                and not self.checkpoint_dir:
+            # the preemption contract is "emergency snapshot saved, exit
+            # 75, relaunch me" — without a checkpoint_dir the snapshot
+            # writes nowhere and every relaunch would silently restart
+            # from epoch 0 while the logs claim snapshots were saved
+            raise ValueError(
+                "FTContext.preemption requires a checkpoint_dir: a "
+                "preemption snapshot with nowhere to write would make "
+                "the exit-75 relaunch contract silently discard the run "
+                "— pass checkpoint_dir= to Trainer, or drop the "
+                "PreemptionHandler from the context")
         if params is None:
-            params, opt_state, start = self.resume_or_init()
-        else:
-            start = 0
-        hist = History()
+            params, opt_state, cursor = self.resume_state(
+                goodput=ft.goodput if ft is not None else None,
+                chaos=ft.chaos if ft is not None else None)
+        elif cursor is None:
+            # explicit fresh state: its trajectory owes nothing to
+            # whatever checkpoint this trainer touched earlier — don't
+            # let a stale high-water mark suppress its saves
+            self._last_ckpt_step = None
+        if cursor is None:
+            cursor = TrainCursor(seed=self.config.training.seed)
+        if (cursor.seed is not None
+                and cursor.seed != self.config.training.seed):
+            raise RuntimeError(
+                f"checkpoint was written with training.seed="
+                f"{cursor.seed} but the config now says "
+                f"{self.config.training.seed}; dropout seeds and data "
+                "order derive from the seed, so resuming would silently "
+                "diverge from the original run — restore the original "
+                "seed (or start a fresh run directory)")
+        hist = cursor.history
+        # wall_time_s accumulates across restarts: this process adds its
+        # own elapsed time on top of what the cursor carried in
+        prior_wall = hist.wall_time_s
+        global_step = cursor.global_step
+        start_epoch, resume_step = cursor.epoch, cursor.step_in_epoch
         t0 = time.time()
         log_every = self.config.training.log_every
+        cadence = CadenceController(self.config.training.save_every_steps,
+                                    self.config.training.save_every_seconds)
+        # arm from the restored step: the state at global_step was just
+        # read from disk, re-saving it one step later buys nothing
+        cadence.saved(global_step)
 
-        for epoch in range(start, epochs):
+        for epoch in range(start_epoch, epochs):
             # losses stay DEVICE scalars during the epoch — no per-step
             # host sync blocking async dispatch (the reference blocks on
             # .item() every step; so did round 1's float(loss)). Host
-            # reads happen only at log boundaries and epoch end.
+            # reads (flushes into the running epoch sum) happen only at
+            # checkpoint boundaries and epoch end.
             losses = []
+            skip = resume_step if epoch == start_epoch else 0
+            # running float64 sum/count of this epoch's host-synced step
+            # losses. Sequential f64 accumulation is the SAME computation
+            # in an uninterrupted and a resumed run (JSON round-trips
+            # binary64 exactly), so the epoch mean is bit-identical while
+            # the cursor stays O(1) — no per-step list rides in it.
+            loss_sum = cursor.loss_sum if skip else 0.0
+            loss_count = cursor.loss_count if skip else 0
+            n_flushed = 0
+
+            def flush():
+                nonlocal n_flushed, loss_sum, loss_count
+                for dev_loss in losses[n_flushed:]:
+                    loss_sum += float(dev_loss)
+                    loss_count += 1
+                n_flushed = len(losses)
+
+            def cursor_at(next_epoch, next_step):
+                hist.wall_time_s = prior_wall + (time.time() - t0)
+                at_boundary = next_step == 0
+                return TrainCursor(
+                    epoch=next_epoch, step_in_epoch=next_step,
+                    global_step=global_step,
+                    # an epoch boundary starts the next epoch's record
+                    # fresh; mid-epoch cursors carry the sum so far
+                    loss_sum=0.0 if at_boundary else loss_sum,
+                    loss_count=0 if at_boundary else loss_count,
+                    history=hist, seed=self.config.training.seed)
+
             t_win = time.time()
             sync_every = self.config.training.sync_every
-            batches = train_batches_fn(epoch)
+            batches, skip_consumed = _call_batches_fn(
+                train_batches_fn, epoch, skip)
+            if skip and not skip_consumed:
+                from quintnet_tpu.data.datasets import skip_batches
+
+                batches = skip_batches(batches, skip)
             if self.config.training.prefetch:
                 from quintnet_tpu.data import prefetch_batches
 
                 batches = prefetch_batches(
                     iter(batches), n=self.config.training.prefetch)
-            for i, (xb, yb) in enumerate(batches):
+            for i, (xb, yb) in enumerate(batches, start=skip):
                 batch = self.strategy.shard_batch(
                     (jnp.asarray(xb), jnp.asarray(yb)), self.model)
                 # per-step dropout seed: deterministic in (config seed,
-                # epoch, step) so resume-from-epoch reproduces the run
+                # epoch, step) so a step-granular resume (ft/TrainCursor)
+                # replays the exact same dropout sequence mid-epoch
                 seed = (self.config.training.seed * 2_000_003
                         + epoch * 1_000_003 + i) & 0x7FFFFFFF
                 params, opt_state, loss = self.step_fn(params, opt_state,
                                                        batch, seed)
                 losses.append(loss)
+                global_step += 1
                 if sync_every and (i + 1) % sync_every == 0:
                     # bound async run-ahead (training.sync_every docs)
                     float(loss)
@@ -403,8 +651,39 @@ class Trainer:
                         msg += f" ({sps * xb.shape[1] / 1e3:.1f}k tok/s)"
                     self.log(msg)
                     t_win = time.time()
-            train_loss = (float(jnp.mean(jnp.stack(losses)))
-                          if losses else float("nan"))
+                # -- fault-tolerance boundary (after the step landed) --
+                if ft is not None:
+                    if ft.goodput is not None:
+                        ft.goodput.on_step(global_step)
+                    if ft.chaos is not None:
+                        # may os._exit / SIGTERM self / raise ChaosKilled
+                        ft.chaos.on_step_end(global_step)
+                if ft is not None and ft.preemption_requested:
+                    # finish-the-step-then-save: the in-flight step above
+                    # already landed; one SYNCHRONOUS emergency snapshot
+                    flush()
+                    blocked = self.save_state(
+                        params, opt_state, cursor_at(epoch, i + 1),
+                        wait=True)
+                    if ft.goodput is not None:
+                        ft.goodput.on_save(blocked)
+                    self.log(f"preempted: emergency snapshot at epoch "
+                             f"{epoch} step {i + 1} (global step "
+                             f"{global_step})")
+                    raise TrainingPreempted(epoch, i + 1, global_step)
+                if cadence.should_save(global_step):
+                    flush()
+                    blocked = self.save_state(
+                        params, opt_state, cursor_at(epoch, i + 1))
+                    if ft is not None and ft.goodput is not None:
+                        ft.goodput.on_save(blocked)
+                    cadence.saved(global_step)
+            flush()
+            # host-side sequential f64 mean (not a device jnp.mean):
+            # identical value whether the epoch ran in one process or
+            # resumed mid-way from the checkpointed running sum
+            train_loss = (loss_sum / loss_count if loss_count
+                          else float("nan"))
             hist.train_loss.append(train_loss)
             msg = f"epoch {epoch}: train_loss {train_loss:.4f}"
             if self.task_type == "clm":
@@ -425,10 +704,31 @@ class Trainer:
                     self.save_best(epoch, params, opt_state, ev["loss"])
                     msg += " (best)"
             self.log(msg)
-            self.save(epoch, params, opt_state)
+            blocked = self.save_state(params, opt_state,
+                                      cursor_at(epoch + 1, 0),
+                                      boundary=True)
+            if ft is not None and ft.goodput is not None:
+                ft.goodput.on_save(blocked)
+            cadence.saved(global_step)
+            if ft is not None and ft.preemption_requested:
+                # SIGTERM landed during eval / epoch-boundary work (the
+                # per-step poll only sees it after a step): the state at
+                # this boundary is already written above — barrier it to
+                # disk and hand control to the supervisor instead of
+                # starting an epoch we will not finish
+                t_b = time.time()
+                self.wait_for_saves()
+                if ft.goodput is not None:
+                    ft.goodput.on_save(time.time() - t_b)
+                self.log(f"preempted: epoch {epoch} checkpoint durable "
+                         f"(global step {global_step})")
+                raise TrainingPreempted(epoch + 1, 0, global_step)
 
+        t_barrier = time.time()
         self.wait_for_saves()
-        hist.wall_time_s = time.time() - t0
+        if ft is not None and ft.goodput is not None:
+            ft.goodput.on_save(time.time() - t_barrier)
+        hist.wall_time_s = prior_wall + (time.time() - t0)
         self._final_state = (params, opt_state)
         return hist
 

@@ -1,0 +1,522 @@
+"""Fault-tolerance goldens: a killed-and-resumed run must be
+BIT-IDENTICAL to an uninterrupted one.
+
+The contract under test (quintnet_tpu/ft/): params/opt arrays ride in
+orbax, the host-side ``TrainCursor`` (epoch, step, epoch losses,
+``History``) rides as a JSON item in the same step directory, dropout
+seeds are pure functions of (config seed, epoch, step), and the data
+order is a pure function of (epoch seed, step) — so replaying from any
+checkpointed cursor reproduces the uninterrupted trajectory exactly.
+Kill modes exercised: in-process hard kill (``ChaosKilled``), graceful
+SIGTERM preemption (emergency snapshot), and checkpoint corruption with
+fallback to the previous good step.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from quintnet_tpu.core.config import Config
+from quintnet_tpu.data import ArrayDataset, make_batches
+from quintnet_tpu.data.datasets import skip_batches, synthetic_mnist
+from quintnet_tpu.ft import (
+    ChaosKilled,
+    ChaosMonkey,
+    FTContext,
+    GoodputMeter,
+    PreemptionHandler,
+    TrainCursor,
+    TrainingPreempted,
+    corrupt_checkpoint,
+)
+from quintnet_tpu.ft.preempt import CadenceController
+from quintnet_tpu.models.vit import ViTConfig, vit_model_spec
+from quintnet_tpu.train.checkpoint import CheckpointManager, CheckpointRestoreError
+from quintnet_tpu.train.trainer import History, Trainer
+
+VCFG = ViTConfig(image_size=28, patch_size=7, in_channels=1, hidden_dim=16,
+                 depth=2, num_heads=2, num_classes=10)
+
+# 48 samples / batch 16 = 3 steps/epoch; 2 epochs = 6 global steps.
+SAMPLES, BATCH, EPOCHS = 48, 16, 2
+
+
+def _cfg(mesh_dim, mesh_name, **training):
+    t = {"batch_size": BATCH, "epochs": EPOCHS, "optimizer": "adam",
+         "learning_rate": 1e-3, "log_every": 0, "seed": 0}
+    t.update(training)
+    return Config.from_dict({"mesh_dim": mesh_dim, "mesh_name": mesh_name,
+                             "training": t})
+
+
+def _dataset():
+    x, y = synthetic_mnist(SAMPLES, seed=0)
+    return ArrayDataset(x, y)
+
+
+def _batches_fn(ds):
+    # two-positional-arg factory: map-style skip-to-cursor (start_batch
+    # slices the shuffled index, no skipped sample materialised)
+    return lambda ep, start=0: make_batches(ds, BATCH, seed=ep,
+                                            start_batch=start)
+
+
+def _trainer(cfg, ckpt_dir, logs=None):
+    log = (logs.append if logs is not None else (lambda s: None))
+    return Trainer(cfg, vit_model_spec(VCFG), task_type="classification",
+                   checkpoint_dir=ckpt_dir, log_fn=log)
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _golden_kill_resume(mesh_dim, mesh_name, tmp_path):
+    """Uninterrupted vs kill-at-step-6 (+mid-epoch resume from the step-5
+    cadence checkpoint): final params and loss series bit-identical."""
+    ds = _dataset()
+    bf = _batches_fn(ds)
+
+    # --- uninterrupted reference run (no checkpointing at all) ---------
+    t_ref = _trainer(_cfg(mesh_dim, mesh_name), None)
+    hist_ref = t_ref.fit(bf)
+    params_ref, _ = t_ref._final_state
+
+    # --- attempt 1: cadence saves every 2 steps, hard-kill after 6 -----
+    # saves land at global steps 2, 3 (epoch end), 5; the kill at 6
+    # fires BEFORE the epoch-end save, so the newest checkpoint is the
+    # MID-EPOCH cursor (epoch 1, step 2) — the resume replays step 6.
+    ck = str(tmp_path / "ck")
+    cfg = _cfg(mesh_dim, mesh_name, save_every_steps=2)
+    t1 = _trainer(cfg, ck)
+    chaos = ChaosMonkey(kill_at_step=6, mode="raise")
+    with pytest.raises(ChaosKilled):
+        t1.fit(bf, ft=FTContext(chaos=chaos))
+    t1.wait_for_saves()
+
+    # --- attempt 2: fresh Trainer, resume from the cursor --------------
+    logs = []
+    t2 = _trainer(cfg, ck, logs)
+    hist = t2.fit(bf)
+    params, _ = t2._final_state
+
+    assert any("continuing at epoch 1 step 2" in s for s in logs), logs
+    assert hist.train_loss == hist_ref.train_loss
+    assert hist.val_loss == hist_ref.val_loss
+    _assert_trees_equal(params, params_ref)
+
+
+def test_kill_resume_bit_identical_single_device(tmp_path):
+    _golden_kill_resume([1], ["dp"], tmp_path)
+
+
+def test_kill_resume_bit_identical_2axis_mesh(tmp_path):
+    _golden_kill_resume([2, 2], ["dp", "tp"], tmp_path)
+
+
+def test_sigterm_preemption_emergency_snapshot_and_resume(tmp_path):
+    """Graceful path: SIGTERM (chaos-delivered to self) sets the handler
+    flag, the loop finishes the in-flight step, writes one synchronous
+    emergency snapshot, and raises TrainingPreempted; the resumed run is
+    bit-identical to an uninterrupted one and the restored History keeps
+    the pre-crash epochs (the to_jsonl clobber fix)."""
+    ds = _dataset()
+    bf = _batches_fn(ds)
+
+    t_ref = _trainer(_cfg([1], ["dp"]), None)
+    hist_ref = t_ref.fit(bf)
+    params_ref, _ = t_ref._final_state
+
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"])  # NO cadence: only the emergency snapshot
+    t1 = _trainer(cfg, ck)
+    meter = GoodputMeter()
+    with PreemptionHandler() as handler:
+        ft = FTContext(preemption=handler,
+                       chaos=ChaosMonkey(kill_at_step=4, mode="sigterm"),
+                       goodput=meter)
+        with pytest.raises(TrainingPreempted) as ei:
+            t1.fit(bf, ft=ft)
+    # preempted after global step 4 = epoch 1 step 1 (mid-epoch)
+    assert (ei.value.epoch, ei.value.step_in_epoch) == (1, 1)
+    assert ei.value.global_step == 4
+    rep = meter.report(completed=False)
+    assert rep["steps_run"] == 4 and rep["reached"] == 4
+    assert rep["save_blocking_s"] > 0  # the emergency save is synchronous
+
+    t2 = _trainer(cfg, ck)
+    hist = t2.fit(bf)
+    params, _ = t2._final_state
+    assert hist.train_loss == hist_ref.train_loss
+    _assert_trees_equal(params, params_ref)
+
+    # History survived the crash: the jsonl written AFTER resume holds
+    # the full run — epoch-0 row included — and wall time is cumulative
+    # across both attempts (not just the resumed process's clock).
+    p = str(tmp_path / "hist.jsonl")
+    hist.to_jsonl(p)
+    rows = [json.loads(l) for l in open(p)]
+    assert [r["epoch"] for r in rows[:-1]] == list(range(EPOCHS))
+    assert rows[-1]["wall_time_s"] == pytest.approx(hist.wall_time_s,
+                                                    abs=0.01)
+    assert hist.wall_time_s > 0
+
+
+def test_corrupt_latest_falls_back_to_previous_good_step(tmp_path):
+    """Truncate the newest checkpoint: resume must fall back one cadence
+    interval (not crash, not restart the run) and still reach the
+    bit-identical final state."""
+    ds = _dataset()
+    bf = _batches_fn(ds)
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"], save_every_steps=2)
+
+    t_ref = _trainer(cfg, ck)
+    hist_ref = t_ref.fit(bf)
+    params_ref, _ = t_ref._final_state
+    t_ref.wait_for_saves()
+
+    mgr = CheckpointManager(ck)
+    steps = mgr.all_steps()
+    assert len(steps) >= 2
+    bad = steps[-1]
+    corrupt_checkpoint(ck, bad, kind="truncate")
+
+    logs = []
+    t2 = _trainer(cfg, ck, logs)
+    params, opt, cursor = t2.resume_state()
+    assert cursor is not None
+    assert t2._last_ckpt_step == steps[-2]
+    assert cursor.global_step == steps[-2]
+    assert any("fallback" in s and str(bad) in s for s in logs), logs
+
+    # finishing from the fallback point reproduces the reference run
+    t3 = _trainer(cfg, ck)
+    hist = t3.fit(bf)
+    params3, _ = t3._final_state
+    assert hist.train_loss == hist_ref.train_loss
+    _assert_trees_equal(params3, params_ref)
+
+
+def test_corrupt_step_rewritten_on_replay(tmp_path):
+    """A step the restore fallback proved unreadable must be REWRITTEN
+    when deterministic replay re-reaches it — otherwise the corrupt
+    copy shadows every later save attempt at that step and each new
+    preemption falls back to the same old good step (zero forward
+    progress when preemptions arrive faster than two cadence
+    intervals)."""
+    ds = _dataset()
+    bf = _batches_fn(ds)
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"], save_every_steps=2)
+
+    t1 = _trainer(cfg, ck)
+    t1.fit(bf)
+    t1.wait_for_saves()
+    bad = CheckpointManager(ck).latest_step()  # final boundary save
+    corrupt_checkpoint(ck, bad, kind="truncate")
+
+    logs = []
+    t2 = _trainer(cfg, ck, logs)
+    t2.fit(bf)  # falls back one interval, replays through `bad`
+    t2.wait_for_saves()
+    assert any("fallback" in s for s in logs), logs
+
+    mgr = CheckpointManager(ck)
+    assert mgr.latest_step() == bad
+    state = mgr.restore()  # the corrupt copy was replaced and loads
+    assert set(state) >= {"params", "opt", "epoch"}
+    assert mgr.restore_cursor()["step_in_epoch"] == 0
+
+
+def test_cadence_on_epoch_final_batch_heals_to_boundary_cursor(tmp_path):
+    """``save_every_steps`` dividing steps-per-epoch makes every cadence
+    save land on an epoch's final batch at the same global step as the
+    epoch-boundary save; the boundary save must rewrite the mid-epoch
+    cursor (same arrays, boundary shape), or the run's newest on-disk
+    cursor is forever mid-epoch-shaped, the History on disk misses the
+    final epoch, and resume_or_init refuses a directory that in fact
+    sits at a true epoch boundary."""
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"], save_every_steps=3)  # == steps per epoch
+    t1 = _trainer(cfg, ck)
+    hist = t1.fit(_batches_fn(ds))
+    t1.wait_for_saves()
+
+    cur = CheckpointManager(ck).restore_cursor()
+    assert (cur["epoch"], cur["step_in_epoch"]) == (EPOCHS, 0)
+    assert cur["history"]["train_loss"] == hist.train_loss
+    # the epoch-level API accepts the directory again
+    t2 = _trainer(cfg, ck)
+    _p, _o, start_epoch = t2.resume_or_init()
+    assert start_epoch == EPOCHS
+
+
+def test_preemption_handler_requires_checkpoint_dir():
+    """exit-75 means "snapshot saved, relaunch me"; a trainer that has
+    nowhere to write the snapshot must refuse the contract up front,
+    not log 'emergency snapshot saved' while every relaunch silently
+    restarts from epoch 0."""
+    t = _trainer(_cfg([1], ["dp"]), None)
+    with PreemptionHandler() as handler:
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            t.fit(_batches_fn(_dataset()),
+                  ft=FTContext(preemption=handler))
+
+
+def test_restore_error_names_step_and_fallback(tmp_path):
+    """CheckpointManager.restore on a torn step raises an actionable
+    CheckpointRestoreError (which step, which fallbacks) instead of a
+    raw orbax traceback; the named fallback step actually loads."""
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"], save_every_steps=2)
+    t = _trainer(cfg, ck)
+    t.fit(_batches_fn(ds))
+    t.wait_for_saves()
+
+    mgr = CheckpointManager(ck)
+    steps = mgr.all_steps()
+    corrupt_checkpoint(ck, steps[-1], kind="truncate")
+    with pytest.raises(CheckpointRestoreError) as ei:
+        mgr.restore()
+    err = ei.value
+    assert err.step == steps[-1]
+    assert err.available[0] == steps[-2]
+    assert str(steps[-2]) in str(err) and "restore_with_fallback" in str(err)
+    # and the advertised recovery works
+    state = mgr.restore(step=err.available[0])
+    assert set(state) >= {"params", "opt", "epoch"}
+
+
+def test_injected_restore_failures_walk_the_fallback_chain(tmp_path):
+    """fail_restores=N makes the first N restore attempts raise without
+    touching disk — resume lands N checkpoints back."""
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"], save_every_steps=2)
+    t = _trainer(cfg, ck)
+    t.fit(_batches_fn(ds))
+    t.wait_for_saves()
+    steps = CheckpointManager(ck).all_steps()
+    assert len(steps) >= 2
+
+    t2 = _trainer(cfg, ck)
+    _p, _o, cursor = t2.resume_state(
+        chaos=ChaosMonkey(fail_restores=1))
+    assert cursor.global_step == steps[-2]
+
+
+def test_pre_ft_single_item_checkpoint_still_restores(tmp_path):
+    """Checkpoints written by the PREVIOUS release are a single
+    StandardSave item (no Composite, no cursor). The new restore path
+    must read them — orbax refuses Composite args on a single-item
+    step, so restore() retries with the legacy layout — and resume
+    degrades to epoch granularity instead of misreporting every healthy
+    step as corrupt."""
+    import orbax.checkpoint as ocp
+
+    cfg = _cfg([1], ["dp"])
+    t = _trainer(cfg, str(tmp_path / "ck"))
+    params, opt = t.init_state()
+    legacy = ocp.CheckpointManager(
+        str(tmp_path / "ck"),
+        options=ocp.CheckpointManagerOptions(create=True))
+    legacy.save(2, args=ocp.args.StandardSave(
+        {"params": params, "opt": opt, "epoch": 2}))
+    legacy.wait_until_finished()
+    legacy.close()
+
+    t2 = _trainer(cfg, str(tmp_path / "ck"))
+    _p, _o, cursor = t2.resume_state()
+    assert (cursor.epoch, cursor.step_in_epoch) == (3, 0)
+    assert cursor.global_step == 2  # anchored at the legacy index
+    state = CheckpointManager(str(tmp_path / "ck")).restore()
+    assert int(state["epoch"]) == 2
+
+
+def test_preemption_during_eval_honored_at_epoch_boundary(tmp_path):
+    """SIGTERM that lands while evaluate() runs (the per-step poll can't
+    see it) must not start the next epoch: the epoch-end checkpoint is
+    made durable and TrainingPreempted carries the boundary cursor."""
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+    t = _trainer(_cfg([1], ["dp"]), ck)
+    with PreemptionHandler() as handler:
+        ft = FTContext(preemption=handler)
+
+        def val_fn(ep):
+            handler.request()  # "signal" arrives mid-eval of epoch 0
+            return make_batches(ds, BATCH, seed=100 + ep, shuffle=False)
+
+        with pytest.raises(TrainingPreempted) as ei:
+            t.fit(_batches_fn(ds), val_batches_fn=val_fn, ft=ft)
+    assert (ei.value.epoch, ei.value.step_in_epoch) == (1, 0)
+    # the boundary checkpoint is on disk and resumable
+    t2 = _trainer(_cfg([1], ["dp"]), ck)
+    _p, _o, cursor = t2.resume_state()
+    assert (cursor.epoch, cursor.step_in_epoch) == (1, 0)
+
+
+def test_resume_or_init_refuses_mid_epoch_checkpoint(tmp_path):
+    """An external epoch-level loop must not be handed mid-epoch params
+    labelled as an epoch boundary (it would re-apply the epoch's first
+    steps); resume_or_init raises and points at fit/resume_state."""
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"], save_every_steps=2)
+    t1 = _trainer(cfg, ck)
+    with pytest.raises(ChaosKilled):
+        t1.fit(_batches_fn(ds),
+               ft=FTContext(chaos=ChaosMonkey(kill_at_step=6, mode="raise")))
+    t1.wait_for_saves()  # newest checkpoint: mid-epoch cursor (1, 2)
+
+    t2 = _trainer(cfg, ck)
+    with pytest.raises(RuntimeError, match="mid-epoch.*resume_state"):
+        t2.resume_or_init()
+    # step-granular resume of the same directory still works
+    hist = _trainer(cfg, ck).fit(_batches_fn(ds))
+    assert len(hist.train_loss) == EPOCHS
+
+
+def test_legacy_epoch_indexed_checkpoint_degrades_cleanly(tmp_path):
+    """A cursor-less (pre-ft, epoch-indexed) checkpoint resumes at epoch
+    granularity with global_step anchored at the restored orbax index,
+    so new global-step-indexed saves sort strictly after it — an
+    emergency snapshot in the first resumed steps is never skipped."""
+    ds = _dataset()
+    ck = str(tmp_path / "ck")
+    cfg = _cfg([1], ["dp"])
+    t1 = _trainer(cfg, ck)
+    params, opt = t1.init_state()
+    t1.save(3, params, opt)  # legacy epoch-indexed save, no cursor
+    t1.wait_for_saves()
+
+    t2 = _trainer(cfg, ck)
+    _p, _o, cursor = t2.resume_state()
+    assert (cursor.epoch, cursor.step_in_epoch) == (4, 0)
+    assert cursor.global_step == 3  # anchored at the legacy index
+    assert t2._last_ckpt_step == 3
+    # a save one step into the resumed run is NOT silently dropped
+    cursor.global_step += 1
+    cursor.step_in_epoch = 1
+    assert t2.save_state(_p, _o, cursor, wait=True) > 0
+    assert CheckpointManager(ck).latest_step() == 4
+
+
+def test_batches_fn_signature_variants():
+    """The resume offset reaches ONLY parameters literally named
+    start/start_batch (second positional or keyword-only); unrelated
+    two-argument factories are never hijacked, and a required offset
+    parameter works on fresh runs (skip=0)."""
+    from quintnet_tpu.train.trainer import _call_batches_fn
+
+    calls = []
+    res = _call_batches_fn(lambda ep, start: calls.append((ep, start)), 1, 2)
+    assert res[1] is True and calls == [(1, 2)]
+    res = _call_batches_fn(lambda ep, start: calls.append((ep, start)), 1, 0)
+    assert res[1] is True and calls[-1] == (1, 0)  # required 2nd positional
+
+    def kw_only(ep, *, start_batch=0):
+        calls.append(("kw", ep, start_batch))
+    assert _call_batches_fn(kw_only, 2, 3)[1] is True
+    assert calls[-1] == ("kw", 2, 3)
+
+    # a second positional with an UNRELATED name keeps its default — the
+    # offset must not silently hijack it (shuffle=2 would corrupt the run)
+    def unrelated(ep, shuffle=True):
+        calls.append(("un", ep, shuffle))
+    assert _call_batches_fn(unrelated, 4, 2)[1] is False
+    assert calls[-1] == ("un", 4, True)
+
+    assert _call_batches_fn(lambda ep: calls.append(ep), 6, 7)[1] is False
+    assert calls[-1] == 6
+
+
+def test_goodput_aggregate_incomplete_run_counts_only_checkpointed():
+    """A run that never completed: useful steps stop at the last
+    CHECKPOINTED step, not the furthest step a killed attempt reached."""
+    from quintnet_tpu.ft.goodput import aggregate
+
+    attempts = [{"resumed_at": 0, "reached": 11, "steps_run": 11,
+                 "wall_s": 0.0, "save_blocking_s": 0.0, "restore_s": 0.0,
+                 "fallback_steps": 0, "completed": False,
+                 "synthetic": True}]
+    g = aggregate(attempts, wall_s=10.0, final_step=10)
+    assert g["useful_steps"] == 10
+    assert g["lost_steps"] == 1
+    # completed attempts still win over final_step
+    attempts.append({"resumed_at": 10, "reached": 12, "steps_run": 2,
+                     "wall_s": 4.0, "save_blocking_s": 1.0,
+                     "restore_s": 0.5, "fallback_steps": 0,
+                     "completed": True})
+    g = aggregate(attempts, wall_s=10.0, final_step=10)
+    assert g["useful_steps"] == 12
+    assert g["lost_steps"] == 1
+
+
+# ---------------------------------------------------------------------------
+# unit-level pieces
+
+
+def test_cursor_roundtrip_json_exact():
+    h = History(train_loss=[2.0, 1.5], val_loss=[1.8], val_metric=[0.5],
+                wall_time_s=3.25, best_val_loss=1.8, best_epoch=0)
+    c = TrainCursor(epoch=1, step_in_epoch=2, global_step=5,
+                    loss_sum=2.5667000000000001, loss_count=2,
+                    history=h, seed=7)
+    back = TrainCursor.from_dict(json.loads(json.dumps(c.to_dict())))
+    assert back == c
+    assert TrainCursor.from_dict(None) is None
+    # unknown keys from a newer writer are tolerated
+    d = c.to_dict()
+    d["future_field"] = 1
+    assert TrainCursor.from_dict(d) == c
+
+
+def test_cadence_controller_or_combination():
+    c = CadenceController(0, 0.0)
+    assert not c.enabled and not c.should_save(10**6)
+    c = CadenceController(3, 0.0)
+    assert not c.should_save(2)
+    assert c.should_save(3)
+    c.saved(3)
+    assert not c.should_save(5) and c.should_save(6)
+    # time leg fires independently of the step leg
+    c = CadenceController(0, 10.0)
+    assert c.enabled and not c.should_save(10**6)
+    c._last_save_t -= 11
+    assert c.should_save(1)
+
+
+def test_chaos_from_env():
+    env = {"QT_CHAOS": json.dumps({"kill_at_step": 7, "mode": "sigterm",
+                                   "fail_restores": 2})}
+    m = ChaosMonkey.from_env(env)
+    assert (m.kill_at_step, m.mode, m.fail_restores) == (7, "sigterm", 2)
+    assert ChaosMonkey.from_env({}) is None
+
+
+def test_start_batch_matches_generic_skip():
+    """The map-style start_batch= slice and the generic consume-and-
+    discard skip yield the same remaining batch stream."""
+    ds = _dataset()
+    a = list(make_batches(ds, BATCH, seed=3, start_batch=2))
+    b = list(skip_batches(make_batches(ds, BATCH, seed=3), 2))
+    assert len(a) == len(b) == 1
+    np.testing.assert_array_equal(a[0][0], b[0][0])
+    np.testing.assert_array_equal(a[0][1], b[0][1])
+    # skipping EXACTLY to the end is a legitimate epoch-end resume
+    assert list(skip_batches(make_batches(ds, BATCH, seed=3), 3)) == []
+    # skipping PAST the end means the data changed under the cursor —
+    # loud failure, not a silent empty epoch
+    with pytest.raises(ValueError, match="ended after 3"):
+        skip_batches(make_batches(ds, BATCH, seed=3), 9)

@@ -113,6 +113,24 @@ def test_pod_run_full_loop(tmp_path):
 
 
 @pytest.mark.fast
+def test_train_restart_loop_disarms_chaos(tmp_path, monkeypatch):
+    """A QT_CHAOS kill armed in the supervisor's environment is consumed
+    by the attempt it killed: the relaunch must not re-arm the same
+    kill_at_step (it would fire before the cursor can pass it and the
+    run could never complete)."""
+    run = str(tmp_path / "r")
+    monkeypatch.setenv("QT_CHAOS", json.dumps({"kill_at_step": 1}))
+    child = ("import os, sys;"
+             "sys.exit(113 if os.environ.get('QT_CHAOS') else 0)")
+    rc = pod_run.main(["train", "--run-dir", run, "--max-restarts", "2",
+                       "--", sys.executable, "-c", child])
+    # attempt 1 dies armed (rc 113); attempt 2 runs disarmed and passes
+    assert rc == 0
+    log = open(os.path.join(run, "logs", "train.log")).read()
+    assert "cleared QT_CHAOS" in log
+
+
+@pytest.mark.fast
 def test_merge_test_without_config_fails(tmp_path):
     run = str(tmp_path / "r2")
     os.makedirs(os.path.join(run, "checkpoints"))
