@@ -1,0 +1,56 @@
+"""qtcheck: static analysis for QuintNet-TPU's compiled programs.
+
+Three passes, one CI gate (``python -m quintnet_tpu.tools.qtcheck``):
+
+- :mod:`~quintnet_tpu.analysis.jaxpr_audit` — lower any jitted function
+  and walk its jaxpr: per-axis collective census, dtype-promotion
+  report, buffer-donation report;
+- :mod:`~quintnet_tpu.analysis.recompile` — count lowerings by abstract
+  signature; enforce "exactly N compiled programs" (the serve engine's
+  one-prefill-one-decode promise, the trainer's one-step promise);
+- :mod:`~quintnet_tpu.analysis.lint` — AST rules for JAX footguns
+  (host numpy / Python RNG in traced code, tracer branching, step-loop
+  host syncs, array defaults, unsynced wall-clock timing) with a
+  committed baseline (tools/qtcheck_baseline.json).
+
+Expected-census specs for the shipped programs live in
+:mod:`~quintnet_tpu.analysis.specs`; tests/test_qtcheck.py pins them.
+"""
+
+from quintnet_tpu.analysis.jaxpr_audit import (
+    Census,
+    collective_census,
+    donation_report,
+    dtype_report,
+)
+from quintnet_tpu.analysis.lint import (
+    RULES,
+    Violation,
+    compare_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    violations_to_baseline,
+)
+from quintnet_tpu.analysis.recompile import (
+    RecompileError,
+    RecompileSentinel,
+    abstract_signature,
+)
+
+__all__ = [
+    "Census",
+    "collective_census",
+    "donation_report",
+    "dtype_report",
+    "RULES",
+    "Violation",
+    "compare_baseline",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "violations_to_baseline",
+    "RecompileError",
+    "RecompileSentinel",
+    "abstract_signature",
+]

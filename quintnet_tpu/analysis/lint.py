@@ -1,0 +1,432 @@
+"""AST linter for JAX footguns, with a committed-baseline workflow.
+
+Generic linters do not know that ``np.random`` inside a jit-traced
+function silently freezes into a compile-time constant, or that a
+``float()`` in a step loop is a device sync that stalls async dispatch.
+These rules do. Each is narrow on purpose: a rule that fires on half
+the tree teaches people to ignore the tool.
+
+Rules
+-----
+- **QT101 host-numpy-in-jit** — ``np.``/``numpy.`` calls inside a
+  jit-traced function. If the call takes a tracer it fails at trace
+  time anyway; if it does not, it is a host computation baked into the
+  program as a constant — either way it does not belong in traced code
+  (trace-time shape arithmetic that must stay should carry a pragma).
+- **QT102 python-rng-in-jit** — ``np.random.*`` or stdlib ``random.*``
+  inside a jit-traced function. The classic silent bug: the "random"
+  value is drawn ONCE at trace time and replayed forever after;
+  ``jax.random`` with explicit keys is the only RNG that exists inside
+  jit.
+- **QT103 tracer-branch** — ``if``/``while`` whose test calls into
+  ``jnp``/``jax.numpy`` (or ``.any()``/``.all()``) inside a traced
+  function. Python control flow executes at trace time; branching on a
+  tracer raises ``ConcretizationTypeError`` at best and silently
+  specializes the program at worst — use ``lax.cond``/``jnp.where``.
+- **QT104 host-sync-in-step-loop** — ``.item()``/``float()``/``int()``
+  on non-literals inside a host loop that drives a train/engine step.
+  Each one blocks dispatch until the device drains; round 1 of this
+  repo lost ~15% step time to exactly this (train/trainer.py docstring).
+  Deliberate syncs (``training.sync_every``, log-window flushes) carry
+  pragmas or baseline entries with a note.
+- **QT105 mutable-default** — mutable literals or ``np``/``jnp``/
+  ``jax`` calls as parameter defaults. A default evaluates once at
+  import; an array default captures one buffer shared across every
+  call (and keeps a device allocation alive for the process lifetime).
+- **QT106 timing-no-sync** — a wall-clock delta (``time.time()``/
+  ``monotonic()``/``perf_counter()`` subtraction) in a function that
+  never calls ``block_until_ready``. Under async dispatch the delta
+  measures enqueue latency, not device work; every throughput number
+  this repo publishes must sync before reading the clock.
+
+Suppression: append ``# qtcheck: ok`` (or ``# qtcheck: ok[QT104]``) to
+the offending line — reserved for sites where the flagged pattern is
+the point (e.g. the engine's scheduler reading sampled tokens). Legacy
+violations live in the committed baseline (tools/qtcheck_baseline.json)
+keyed by (rule, file, enclosing function) with a count and an optional
+note; :func:`compare_baseline` fails on NEW violations and on STALE
+entries alike, so the baseline can only shrink deliberately
+(``--write-baseline``) and never drifts from the tree
+(tests/test_qtcheck.py gates this in tier-1).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES = {
+    "QT101": "host numpy call inside a jit-traced function",
+    "QT102": "Python/NumPy RNG inside a jit-traced function",
+    "QT103": "tracer-dependent Python branching inside a jit-traced "
+             "function",
+    "QT104": "host sync (.item()/float()/int()) inside a step loop",
+    "QT105": "mutable or array-valued default argument",
+    "QT106": "wall-clock timing delta without block_until_ready",
+}
+
+# call targets whose function-valued arguments are traced by JAX
+_TRACING_WRAPPERS = {
+    "jit", "shard_map", "shard_map_fn", "make_jaxpr", "grad",
+    "value_and_grad", "vmap", "pmap", "checkpoint", "remat", "scan",
+    "fori_loop", "while_loop", "cond", "switch", "associated_scan",
+    "custom_jvp", "custom_vjp", "eval_shape",
+}
+
+_TIME_CALLS = {"time", "monotonic", "perf_counter", "process_time"}
+
+_PRAGMA = re.compile(r"#\s*qtcheck:\s*ok(?:\[([A-Z0-9, ]+)\])?")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.symbol}] "
+                f"{self.message}")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _root(dotted: Optional[str]) -> Optional[str]:
+    return dotted.split(".", 1)[0] if dotted else None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression denote a tracing wrapper? Covers ``jax.jit``,
+    ``jit``, ``cc.shard_map_fn``, ``partial(jax.jit, ...)`` and
+    ``functools.partial(jax.jit, ...)``."""
+    name = _dotted(node)
+    if name is not None:
+        return name.split(".")[-1] in _TRACING_WRAPPERS
+    if isinstance(node, ast.Call):
+        fn = _dotted(node.func)
+        if fn and fn.split(".")[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, source: str,
+                 rules: Set[str]):
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.rules = rules
+        self.violations: List[Violation] = []
+        self.traced_names: Set[str] = set()
+        self._stack: List[str] = []          # enclosing def names
+        self._traced_depth = 0               # >0 => inside traced code
+        self._loop_stack: List[bool] = []    # QT104: step-driving loops
+
+    # -- plumbing ------------------------------------------------------
+    def _suppressed(self, line: int, rule: str) -> bool:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _PRAGMA.search(self.lines[ln - 1])
+                if m and (m.group(1) is None
+                          or rule in m.group(1).replace(" ", "").split(",")):
+                    return True
+        return False
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule not in self.rules:
+            return
+        line = getattr(node, "lineno", 0)
+        if self._suppressed(line, rule):
+            return
+        self.violations.append(Violation(
+            rule=rule, path=self.rel, line=line,
+            symbol=".".join(self._stack) or "<module>", message=message))
+
+    # -- traced-function discovery ------------------------------------
+    def collect_traced(self, tree: ast.Module) -> None:
+        """Names of functions handed to tracing wrappers anywhere in the
+        module (``jax.jit(step)``, ``cc.shard_map_fn(local_step, ...)``,
+        ``lax.scan(body, ...)``), plus jit-decorated defs."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        self.traced_names.add(arg.id)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec):
+                        self.traced_names.add(node.name)
+
+    # -- visitors ------------------------------------------------------
+    def _visit_def(self, node):
+        self._check_defaults(node)
+        traced = (node.name in self.traced_names
+                  or self._traced_depth > 0
+                  or any(_is_jit_expr(d) for d in node.decorator_list))
+        self._stack.append(node.name)
+        self._traced_depth += 1 if traced else 0
+        if "QT106" in self.rules:
+            self._check_timing(node)
+        self.generic_visit(node)
+        self._traced_depth -= 1 if traced else 0
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def _check_defaults(self, node) -> None:
+        args = node.args
+        for default in list(args.defaults) + [d for d in args.kw_defaults
+                                              if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self._flag("QT105", default,
+                           f"mutable default in {node.name}() is shared "
+                           "across calls")
+            elif isinstance(default, ast.Call):
+                root = _root(_dotted(default.func))
+                if root in ("np", "numpy", "jnp", "jax"):
+                    self._flag("QT105", default,
+                               f"array default in {node.name}() is built "
+                               "once at import and shared across calls")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        root = _root(name)
+        if self._traced_depth > 0 and name is not None:
+            if (root in ("np", "numpy")
+                    and name.split(".")[1:2] == ["random"]) \
+                    or root == "random":
+                self._flag("QT102", node,
+                           f"{name}() inside traced code draws once at "
+                           "trace time and replays forever; use "
+                           "jax.random with an explicit key")
+            elif root in ("np", "numpy"):
+                self._flag("QT101", node,
+                           f"{name}() inside traced code runs on host at "
+                           "trace time (constant-folded into the "
+                           "program)")
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._maybe_host_sync(node, ".item()")
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+                and not self._is_host_math(node.args[0])):
+            self._maybe_host_sync(node, f"{node.func.id}()")
+        self.generic_visit(node)
+
+    def _visit_loop(self, node):
+        drives_step = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                callee = _dotted(sub.func) or ""
+                if "step" in callee.split(".")[-1].lower():
+                    drives_step = True
+                    break
+        self._loop_stack.append(drives_step)
+        self.generic_visit(node)
+        self._loop_stack.pop()
+
+    visit_For = _visit_loop
+    # While is handled by visit_While below: branch check + loop check
+
+    @staticmethod
+    def _is_host_math(node) -> bool:
+        """float(np.exp(...)) / float(math.log(...)) never touch the
+        device — numpy/math results are already host scalars."""
+        return (isinstance(node, ast.Call)
+                and _root(_dotted(node.func)) in ("np", "numpy", "math"))
+
+    def _maybe_host_sync(self, node, what: str) -> None:
+        if any(self._loop_stack):
+            self._flag("QT104", node,
+                       f"{what} in a step-driving loop blocks async "
+                       "dispatch every iteration; keep device values "
+                       "unsynced (or sync once per window)")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+        self._visit_loop(node)
+
+    def _check_branch(self, node, kind: str) -> None:
+        if self._traced_depth == 0:
+            return
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func) or ""
+                root = _root(name)
+                if root == "jnp" or name.startswith("jax.numpy"):
+                    self._flag("QT103", node,
+                               f"{kind} test calls {name}() inside traced "
+                               "code — Python branching runs at trace "
+                               "time; use lax.cond/jnp.where")
+                    return
+                if (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in ("any", "all")):
+                    self._flag("QT103", node,
+                               f"{kind} test reduces an array with "
+                               f".{sub.func.attr}() inside traced code — "
+                               "use lax.cond/jnp.where")
+                    return
+
+    # -- QT106 ---------------------------------------------------------
+    def _check_timing(self, fn_node) -> None:
+        """Flag wall-clock deltas in functions that never sync: a
+        Sub-expression where an operand is a time call (or a local
+        assigned from one), in a function with no block_until_ready."""
+        def is_time_call(n) -> bool:
+            if not isinstance(n, ast.Call):
+                return False
+            name = _dotted(n.func) or ""
+            # time.monotonic() or a bare imported perf_counter()
+            return (name.split(".")[-1] in _TIME_CALLS
+                    and (_root(name) == "time" or "." not in name))
+
+        body_walk = list(ast.walk(fn_node))
+        # skip nested defs' bodies: they get their own visit
+        nested = set()
+        for n in body_walk:
+            if n is not fn_node and isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.update(ast.walk(n))
+        body_walk = [n for n in body_walk if n not in nested]
+
+        if any(isinstance(n, ast.Attribute)
+               and n.attr == "block_until_ready" for n in body_walk):
+            return
+        timed_names = {
+            t.id
+            for n in body_walk if isinstance(n, ast.Assign)
+            and is_time_call(n.value)
+            for t in n.targets if isinstance(t, ast.Name)
+        }
+        for n in body_walk:
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+                ops = (n.left, n.right)
+                if any(is_time_call(o)
+                       or (isinstance(o, ast.Name) and o.id in timed_names)
+                       for o in ops):
+                    self._flag(
+                        "QT106", n,
+                        "wall-clock delta without block_until_ready "
+                        "measures dispatch, not device work")
+                    return
+
+
+def lint_source(source: str, rel_path: str,
+                rules: Optional[Sequence[str]] = None) -> List[Violation]:
+    tree = ast.parse(source, filename=rel_path)
+    linter = _Linter(rel_path, rel_path, source,
+                     set(rules) if rules else set(RULES))
+    linter.collect_traced(tree)
+    linter.visit(tree)
+    return sorted(linter.violations, key=lambda v: (v.path, v.line, v.rule))
+
+
+def lint_paths(paths: Sequence[str], *, root: str = ".",
+               rules: Optional[Sequence[str]] = None) -> List[Violation]:
+    """Lint every ``*.py`` under ``paths`` (files or directories),
+    reporting paths relative to ``root`` so baselines are stable across
+    checkouts."""
+    out: List[Violation] = []
+    files: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full):
+            files.append(full)
+        else:
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in filenames if f.endswith(".py"))
+    for f in sorted(files):
+        rel = os.path.relpath(f, root)
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            out.extend(lint_source(src, rel, rules))
+        except SyntaxError as e:  # pragma: no cover - tree is parseable
+            out.append(Violation(rule="QT000", path=rel,
+                                 line=e.lineno or 0, symbol="<module>",
+                                 message=f"syntax error: {e.msg}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def violations_to_baseline(violations: Sequence[Violation],
+                           notes: Optional[Dict[Tuple[str, str, str], str]]
+                           = None) -> dict:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    lines: Dict[Tuple[str, str, str], int] = {}
+    for v in violations:
+        counts[v.key] = counts.get(v.key, 0) + 1
+        lines.setdefault(v.key, v.line)
+    entries = []
+    for (rule, path, symbol), n in sorted(counts.items()):
+        e = {"rule": rule, "path": path, "symbol": symbol, "count": n,
+             "line": lines[(rule, path, symbol)]}
+        if notes and (rule, path, symbol) in notes:
+            e["note"] = notes[(rule, path, symbol)]
+        entries.append(e)
+    return {"version": 1, "violations": entries}
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def compare_baseline(violations: Sequence[Violation],
+                     baseline: dict) -> Tuple[List[str], List[str]]:
+    """(new, stale): ``new`` are violations beyond the baseline (fail
+    CI), ``stale`` are baseline entries the tree no longer produces
+    (fail too — regenerate with --write-baseline so the committed file
+    always mirrors reality, same discipline as tests/test_bench_stale.py
+    applies to benchmark artifacts)."""
+    base = {(e["rule"], e["path"], e["symbol"]): e["count"]
+            for e in baseline.get("violations", [])}
+    cur: Dict[Tuple[str, str, str], List[Violation]] = {}
+    for v in violations:
+        cur.setdefault(v.key, []).append(v)
+
+    new, stale = [], []
+    for key, vs in sorted(cur.items()):
+        allowed = base.get(key, 0)
+        if len(vs) > allowed:
+            for v in vs[allowed:]:
+                new.append(v.render())
+    for key, n in sorted(base.items()):
+        have = len(cur.get(key, ()))
+        if have < n:
+            rule, path, symbol = key
+            stale.append(f"{path}: {rule} [{symbol}] baseline says "
+                         f"{n}, tree has {have} — regenerate the "
+                         "baseline (--write-baseline)")
+    return new, stale

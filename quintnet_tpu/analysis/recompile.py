@@ -1,0 +1,125 @@
+"""Recompile sentinel: count lowerings by abstract signature.
+
+PR 1's serving promise is "one compiled prefill + one compiled decode,
+zero recompiles" — and the trainer's step loop makes the analogous
+implicit promise (one compiled step per (shapes, dtypes) of the batch).
+jit silently recompiles whenever an argument's abstract signature
+drifts (a new shape from a non-dropped last batch, a weak-type Python
+scalar where an array used to be, a dtype flip from a host round-trip),
+and the only symptom is a mysteriously slow step. The sentinel makes
+the promise checkable:
+
+- wrap any callable (usually a ``jax.jit`` product) in
+  :class:`RecompileSentinel`; every call records the ABSTRACT signature
+  of its arguments (pytree structure + per-leaf shape/dtype/weak-type —
+  exactly the jit cache key's array part);
+- ``compile_count`` is the number of distinct signatures seen, i.e. the
+  number of programs jit compiled for this callable;
+- :meth:`assert_compile_count` turns the expected count into a hard
+  error whose message DIFFS the offending signature against the first
+  one, so the drifting leaf is named instead of guessed;
+- ``max_compiles`` makes the sentinel enforce at call time: the serve
+  engine wraps prefill/decode with ``max_compiles=1`` so a recompile
+  fails the call that would cause it, not a benchmark three weeks
+  later. The trainer wraps its step/eval functions in observe-only
+  mode and logs signature diffs on every recompile.
+
+Signature hashing never touches device data — ``jax.core.get_aval`` on
+committed arrays is metadata-only, so wrapping costs microseconds per
+call, not a sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+
+class RecompileError(RuntimeError):
+    pass
+
+
+def _leaf_sig(leaf) -> str:
+    try:
+        aval = jax.core.get_aval(leaf)
+    except TypeError:
+        return f"static:{leaf!r}"
+    return str(aval)
+
+
+def abstract_signature(args: tuple, kwargs: dict) -> Tuple:
+    """Hashable abstract signature of a call: treedef + per-leaf aval
+    strings (shape/dtype/weak_type). Two calls with equal signatures
+    hit the same jit cache entry; unequal signatures force a lowering."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (str(treedef), tuple(_leaf_sig(l) for l in leaves))
+
+
+def _diff_sigs(base: Tuple, new: Tuple) -> str:
+    if base[0] != new[0]:
+        return f"pytree structure changed:\n  was {base[0]}\n  now {new[0]}"
+    lines = [f"  leaf[{i}]: {a} -> {b}"
+             for i, (a, b) in enumerate(zip(base[1], new[1])) if a != b]
+    if len(base[1]) != len(new[1]):
+        lines.append(f"  leaf count: {len(base[1])} -> {len(new[1])}")
+    return "changed leaves:\n" + "\n".join(lines)
+
+
+class RecompileSentinel:
+    """Wrap a (jitted) callable and count distinct abstract signatures.
+
+    ``max_compiles``: raise :class:`RecompileError` BEFORE dispatching a
+    call whose signature would exceed the budget. ``on_recompile(name,
+    count, diff)`` fires on every new signature after the first —
+    observe-only wiring (the trainer logs it).
+    """
+
+    def __init__(self, name: str, fn: Callable, *,
+                 max_compiles: Optional[int] = None,
+                 on_recompile: Optional[Callable[[str, int, str], None]]
+                 = None):
+        self.name = name
+        self.fn = fn
+        self.max_compiles = max_compiles
+        self.on_recompile = on_recompile
+        self._sigs: Dict[Tuple, int] = {}   # signature -> first-seen order
+
+    def __call__(self, *args, **kwargs):
+        sig = abstract_signature(args, kwargs)
+        if sig not in self._sigs:
+            if self._sigs:
+                diff = _diff_sigs(next(iter(self._sigs)), sig)
+                if (self.max_compiles is not None
+                        and len(self._sigs) >= self.max_compiles):
+                    raise RecompileError(
+                        f"{self.name}: call would trigger lowering "
+                        f"#{len(self._sigs) + 1} (budget "
+                        f"{self.max_compiles}); {diff}")
+                if self.on_recompile is not None:
+                    self.on_recompile(self.name, len(self._sigs) + 1, diff)
+            self._sigs[sig] = len(self._sigs)
+        return self.fn(*args, **kwargs)
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._sigs)
+
+    def assert_compile_count(self, expected: int) -> None:
+        if len(self._sigs) != expected:
+            sigs = list(self._sigs)
+            detail = ""
+            if len(sigs) > 1:
+                detail = "; first drift: " + _diff_sigs(sigs[0], sigs[1])
+            raise RecompileError(
+                f"{self.name}: expected {expected} compiled program(s), "
+                f"observed {len(self._sigs)}{detail}")
+
+
+def assert_compile_count(expected: Dict[str, int],
+                         **sentinels: RecompileSentinel) -> None:
+    """Check several sentinels at once:
+    ``assert_compile_count({'prefill': 1, 'decode': 1}, prefill=s1,
+    decode=s2)``."""
+    for key, n in expected.items():
+        sentinels[key].assert_compile_count(n)

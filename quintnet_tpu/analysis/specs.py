@@ -1,0 +1,196 @@
+"""Declarative expected-census specs for QuintNet's compiled programs.
+
+Each function returns the exact per-axis collective counts
+(``{axis: {op: count}}``) that one call of the corresponding program
+puts on the wire, derived from program structure — parameter-tree
+leaf counts, block depth, microbatch count — rather than measured and
+pasted. tests/test_qtcheck.py checks them against
+:func:`~quintnet_tpu.analysis.jaxpr_audit.collective_census` of the
+real lowered programs, so ANY change to the communication pattern of
+``parallel/`` or ``serve/`` (an extra all-gather in a tp layer, a
+second grad reduction, a resharding XLA was forced to insert) fails
+tier-1 with a named diff instead of landing as a silent perf
+regression.
+
+Census terms, for reading the formulas below:
+
+- **leaf pmean** — ``reduce_grads`` pmeans every gradient leaf over the
+  data axes: one all_reduce per parameter leaf, plus one for the loss.
+- **row-parallel psum** — each transformer block holds two RowParallel
+  projections (attention out-proj, MLP down-proj): 2 psums per block
+  per forward; autodiff's transpose doubles it (the backward re-psums
+  the replicated cotangents), so a depth-L scan contributes ``4 L``.
+- **replicated-grad psum** — leaves replicated over tp (LayerNorms,
+  embeddings) receive rank-partial gradients and are psummed over tp:
+  one all_reduce per tp-replicated leaf (the sync the reference torch
+  implementation omits — parallel/tp.py docstring).
+- **clip-norm psum** — ``clip_sharded_grads`` psums the local
+  sum-of-squares of every SHARDED leaf over its sharding axes: one
+  all_reduce per tp-sharded leaf when ``grad_clip_norm`` is set.
+- **ZeRO terms** — ZeRO-1 re-assembles updated params with ONE
+  all_gather (the chunks ravel into a single flat vector); ZeRO-2
+  replaces the per-leaf dp pmean with ONE reduce_scatter into the
+  rank's chunk plus one psum for the chunk-space clip norm.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+CensusDict = Dict[str, Dict[str, int]]
+
+
+def _merge(*censuses: CensusDict) -> CensusDict:
+    out: CensusDict = {}
+    for c in censuses:
+        for axis, ops in c.items():
+            cur = out.setdefault(axis, {})
+            for op, n in ops.items():
+                cur[op] = cur.get(op, 0) + n
+    return out
+
+
+def spec_leaf_counts(param_specs, axis: str) -> Tuple[int, int, int]:
+    """(total, replicated-over-axis, sharded-over-axis) leaf counts of a
+    PartitionSpec tree — the structural inputs to the formulas below."""
+    from quintnet_tpu.parallel.train_step import _spec_axes
+
+    leaves = jax.tree.leaves(
+        param_specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+    sharded = sum(1 for s in leaves if axis in _spec_axes(s))
+    return len(leaves), len(leaves) - sharded, sharded
+
+
+def expected_dp_train_step(n_param_leaves: int, *,
+                           dp_axis: str = "dp") -> CensusDict:
+    """make_parallel_train_step on a dp-only mesh: one leaf pmean per
+    gradient leaf + the loss pmean. Nothing else — XLA does the
+    bucketing/overlap the reference hand-built (parallel/dp.py)."""
+    return {dp_axis: {"all_reduce": n_param_leaves + 1}}
+
+
+def expected_tp_train_step(depth: int, n_tp_replicated: int,
+                           n_tp_sharded: int, *, tp_axis: str = "tp",
+                           row_collectives_per_block: int = 2,
+                           grad_clip: bool = True) -> CensusDict:
+    """tp-only train step of a stacked-block model (ViT/GPT-2 layout:
+    QKV column-sharded, projections row-sharded):
+
+      2 row-parallel psums/block x depth x (forward + backward)
+      + one psum per tp-replicated gradient leaf
+      + one psum per tp-sharded leaf for the global clip norm.
+
+    No data axis -> no loss pmean (the loss is already replicated
+    across tp by the final psum's semantics)."""
+    fwd_bwd = 2 * row_collectives_per_block * depth
+    n = fwd_bwd + n_tp_replicated + (n_tp_sharded if grad_clip else 0)
+    return {tp_axis: {"all_reduce": n}}
+
+
+def expected_dp_tp_train_step(n_param_leaves: int, depth: int,
+                              n_tp_replicated: int, n_tp_sharded: int,
+                              *, dp_axis: str = "dp",
+                              tp_axis: str = "tp",
+                              grad_clip: bool = True) -> CensusDict:
+    """2-axis dp x tp mesh: the dp and tp patterns compose without
+    cross terms — dp sees exactly its dp-only census, tp exactly its
+    tp-only one. (That THIS holds is the point of auditing: a stray
+    resharding would show up as a new op on one of the axes.)"""
+    return _merge(
+        expected_dp_train_step(n_param_leaves, dp_axis=dp_axis),
+        expected_tp_train_step(depth, n_tp_replicated, n_tp_sharded,
+                               tp_axis=tp_axis, grad_clip=grad_clip))
+
+
+def expected_zero1_train_step(n_param_leaves: int, *,
+                              dp_axis: str = "dp") -> CensusDict:
+    """ZeRO-1: the dp-only census plus ONE all_gather re-assembling the
+    updated flat parameter vector from per-rank chunks
+    (parallel/zero.py _chunk_apply). Gradient traffic is unchanged —
+    that is ZeRO-1's contract (state sharded, grads still allreduced)."""
+    return {dp_axis: {"all_reduce": n_param_leaves + 1, "all_gather": 1}}
+
+
+def expected_zero2_train_step(*, dp_axis: str = "dp",
+                              grad_clip: bool = True) -> CensusDict:
+    """ZeRO-2: per-leaf dp pmeans collapse into ONE reduce_scatter of
+    the flat grad vector straight into the rank's chunk (half the
+    allreduce traffic — parallel/zero.py scatter_grad_chunk); the loss
+    pmean stays; clipping psums one chunk-space sum-of-squares; one
+    all_gather re-assembles params."""
+    return {dp_axis: {
+        "all_reduce": 1 + (1 if grad_clip else 0),
+        "reduce_scatter": 1,
+        "all_gather": 1,
+    }}
+
+
+def expected_3d_train_step(n_param_leaves: int, depth: int,
+                           n_tp_replicated: int, n_tp_sharded: int,
+                           n_pp_replicated: int, n_pp_sharded: int,
+                           n_micro: int, pp_size: int, *,
+                           dp_axis: str = "dp", tp_axis: str = "tp",
+                           pp_axis: str = "pp",
+                           grad_clip: bool = True,
+                           store_activations: bool = False) -> CensusDict:
+    """3D (dp x tp x pp) 1F1B train step.
+
+    - dp: unchanged leaf pmeans + loss pmean;
+    - tp: the fwd+bwd row-parallel psums now run once per MICROBATCH,
+      and the memory-lean 1F1B variant (``store_activations=False``)
+      recomputes each forward inside the backward — one extra forward's
+      worth of psums per microbatch;
+    - pp: one psum per pp-REPLICATED gradient leaf (stage-partial
+      grads: embedding on stage 0, head on the last stage), one per
+      pp-SHARDED leaf for the clip norm, one for the loss (masked to
+      the last stage, then shared via broadcast_from), plus the 1F1B
+      schedule's boundary ppermutes: two per microbatch (its forward
+      and backward each cross one boundary per shift of the ladder)
+      plus four per stage boundary for the warmup/cooldown sweeps —
+      ``2 * n_micro + 4 * (pp_size - 1)`` (pinned empirically over
+      pp in {2, 4} x n_micro in {2, 4, 8}; parallel/pp.py).
+    """
+    per_block = 2
+    fwd = per_block * depth
+    tp_count = (n_micro * (2 + (0 if store_activations else 1)) * fwd
+                + n_tp_replicated + (n_tp_sharded if grad_clip else 0))
+    ppermutes = 2 * n_micro + 4 * (pp_size - 1)
+    pp_count = (n_pp_replicated + (n_pp_sharded if grad_clip else 0) + 1)
+    return {
+        dp_axis: {"all_reduce": n_param_leaves + 1},
+        tp_axis: {"all_reduce": tp_count},
+        pp_axis: {"all_reduce": pp_count, "ppermute": ppermutes},
+    }
+
+
+# ---------------------------------------------------------------------------
+# serving programs (quintnet_tpu/serve/engine.py)
+
+
+def expected_serve_prefill(n_layers: int, *,
+                           tp_axis: Optional[str] = None,
+                           vocab_parallel: bool = False) -> CensusDict:
+    """One compiled prefill: 2 row-parallel psums per block under tp
+    (attention out-proj + MLP down-proj — forward only, no autodiff),
+    plus the vocab-parallel embedding psum and logits all_gather when
+    the vocabulary is sharded. Single-device: ZERO collectives."""
+    if tp_axis is None:
+        return {}
+    c: CensusDict = {tp_axis: {"all_reduce": 2 * n_layers}}
+    if vocab_parallel:
+        c[tp_axis]["all_reduce"] += 1   # vocab_parallel_embedding psum
+        c[tp_axis]["all_gather"] = 1    # vocab_parallel_logits gather
+    return c
+
+
+def expected_serve_decode(n_layers: int, *,
+                          tp_axis: Optional[str] = None,
+                          vocab_parallel: bool = False) -> CensusDict:
+    """One compiled decode step for ALL slots: identical communication
+    shape to prefill — the continuous-batching engine adds batching,
+    paging and sampling but NO collectives of its own."""
+    return expected_serve_prefill(n_layers, tp_axis=tp_axis,
+                                  vocab_parallel=vocab_parallel)

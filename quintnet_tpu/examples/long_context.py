@@ -83,9 +83,13 @@ def main():
     for i in range(args.steps):
         t0 = time.perf_counter()
         params, opt_state, loss = step(params, opt_state, batch)
+        # sync before reading the clock: dt must measure device work,
+        # not dispatch (qtcheck QT106)
+        jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
         note = " (compile)" if i == 0 else ""
-        print(f"step {i}: loss {float(loss):.4f}  {dt:.2f}s{note}")
+        loss_v = float(loss)  # qtcheck: ok[QT104] — per-step demo print
+        print(f"step {i}: loss {loss_v:.4f}  {dt:.2f}s{note}")
     print("done — every attention op ran sequence-parallel across "
           f"{sp} devices; the [S, S] score matrix never existed on any "
           "one of them")

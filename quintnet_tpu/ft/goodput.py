@@ -41,6 +41,7 @@ class GoodputMeter:
         self.save_s: float = 0.0               # host-blocking save time
         self.restore_s: float = 0.0
         self.fallback_steps: int = 0           # corrupt ckpts skipped on resume
+        self._last_result = None               # device value of the last step
 
     # -- hooks called by Trainer.fit -----------------------------------
     def on_resume(self, global_step: int, restore_s: float,
@@ -53,15 +54,30 @@ class GoodputMeter:
             print(json.dumps({"ft_start": {"resumed_at": global_step}}),
                   flush=True)
 
-    def on_step(self, global_step: int) -> None:
+    def on_step(self, global_step: int, result=None) -> None:
+        """``result``: any device value produced by the step (the loss).
+        Kept (not synced!) so :meth:`report` can block on the final
+        step's device work before reading the wall clock — without it,
+        under async dispatch the meter would close its window while the
+        last ``training.sync_every`` steps are still executing and
+        report dispatch time as step time."""
         self.steps_run += 1
         self.reached = global_step
+        if result is not None:
+            self._last_result = result
 
     def on_save(self, blocking_s: float) -> None:
         self.save_s += blocking_s
 
     # -- reporting -----------------------------------------------------
     def report(self, *, completed: bool) -> Dict[str, Any]:
+        if self._last_result is not None:
+            # drain in-flight device work so wall_s covers what the
+            # device DID, not what the host dispatched
+            import jax
+
+            jax.block_until_ready(self._last_result)
+            self._last_result = None
         wall = time.time() - self.t_start
         return {
             "resumed_at": self.resumed_at or 0,

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from quintnet_tpu.analysis.recompile import RecompileSentinel
 from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics
@@ -104,8 +105,21 @@ class ServeEngine:
         self._rid_counter = 0
         self._arrival_counter = 0
 
-        self._prefill = self._build_prefill()
-        self._decode = self._build_decode()
+        # the one-compiled-program promise, enforced at call time: a
+        # second abstract signature for either program raises
+        # RecompileError naming the drifting leaf instead of silently
+        # recompiling (analysis/recompile.py)
+        # donation sets = the aliasable args (jaxpr_audit.donation_report):
+        # pools update in place; prefill's t0 aliases the sampled token,
+        # key_data its evolved key; decode's tok row aliases the next-
+        # token row. (ids/tables/pos cannot alias an output — donating
+        # them would only earn XLA's "not usable" warning.)
+        self._prefill = RecompileSentinel(
+            "serve.prefill", self._build_prefill(donate=(1, 2, 4, 6)),
+            max_compiles=1)
+        self._decode = RecompileSentinel(
+            "serve.decode", self._build_decode(donate=(1, 2, 3, 6)),
+            max_compiles=1)
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -124,7 +138,7 @@ class ServeEngine:
                 top_k=self.top_k, top_p=self.top_p)[0]
         )(logits, subkeys).astype(jnp.int32)
 
-    def _build_prefill(self):
+    def _build_prefill(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
 
@@ -149,9 +163,9 @@ class ServeEngine:
             return (k_pool, v_pool, tok.astype(jnp.int32),
                     jax.random.key_data(key2))
 
-        return self._wrap(body, n_pool_args=2)
+        return self._wrap(body, n_pool_args=2, donate=donate)
 
-    def _build_decode(self):
+    def _build_decode(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
 
@@ -165,16 +179,18 @@ class ServeEngine:
             return (k_pool, v_pool, nxt,
                     jax.random.key_data(pairs[:, 0]))
 
-        return self._wrap(body, n_pool_args=2)
+        return self._wrap(body, n_pool_args=2, donate=donate)
 
-    def _wrap(self, body, *, n_pool_args: int):
-        """jit (donating the pool buffers — decode-state updates are
-        in-place on device); under a mesh, shard_map first: params in
+    def _wrap(self, body, *, n_pool_args: int, donate):
+        """jit, donating the aliasable arguments: the pool buffers
+        (decode-state updates are in-place on device) plus the per-step
+        host-shipped rows that alias an output (tok/t0/key_data are
+        rebuilt from host state each call, so their device buffers are
+        dead after the step). Under a mesh, shard_map first: params in
         their training layout, pool head-sharded, everything else
         replicated."""
         if self.mesh is None:
-            return jax.jit(body, donate_argnums=tuple(
-                range(1, 1 + n_pool_args)))
+            return jax.jit(body, donate_argnums=donate)
         from jax.sharding import PartitionSpec as P
 
         from quintnet_tpu.core import collectives as cc
@@ -193,8 +209,7 @@ class ServeEngine:
             body, self.mesh,
             in_specs=in_specs_for(n_rest),
             out_specs=(pool_spec,) * n_pool_args + (P(), P()))
-        return jax.jit(smapped, donate_argnums=tuple(
-            range(1, 1 + n_pool_args)))
+        return jax.jit(smapped, donate_argnums=donate)
 
     # ------------------------------------------------------------------
     # submission / results
@@ -440,11 +455,13 @@ class ServeEngine:
     def compile_stats(self) -> Dict[str, int]:
         """Compiled-program counts for the no-recompile invariant
         (tests/test_serve.py): both entries must stay at 1 no matter
-        how requests come and go."""
-        def n(f):
-            try:
-                return int(f._cache_size())
-            except AttributeError:  # pragma: no cover - old jit objects
-                return -1
+        how requests come and go. Counted by the RecompileSentinels
+        (distinct abstract signatures seen = programs jit compiled)."""
+        return {"prefill": self._prefill.compile_count,
+                "decode": self._decode.compile_count}
 
-        return {"prefill": n(self._prefill), "decode": n(self._decode)}
+    def assert_compile_count(self, prefill: int = 1, decode: int = 1):
+        """Raise RecompileError (with a signature diff) unless exactly
+        the expected number of programs was compiled."""
+        self._prefill.assert_compile_count(prefill)
+        self._decode.assert_compile_count(decode)

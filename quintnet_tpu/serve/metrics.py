@@ -89,6 +89,14 @@ class ServeMetrics:
 
     # ---- reporting --------------------------------------------------
     @property
+    def gen_tokens(self) -> int:
+        """GENERATED tokens: every admission samples exactly one
+        (prefill) token; the rest come from decode steps. The single
+        definition behind ``tokens_per_sec`` — consumers (the serve
+        bench) read it here rather than re-deriving it."""
+        return self.decode_tokens + self.admitted
+
+    @property
     def wall_s(self) -> float:
         if self._t0 is None or self._t_end is None:
             return 0.0
@@ -100,11 +108,10 @@ class ServeMetrics:
         sampled) tokens — the serving-throughput number, not prompt
         reading speed."""
         wall = self.wall_s
-        # every admission samples exactly one (prefill) token; the rest
-        # come from decode steps
-        gen_tokens = self.decode_tokens + self.admitted
+        gen_tokens = self.gen_tokens
         return {
             "steps": self.steps,
+            "gen_tokens": gen_tokens,
             "admitted": self.admitted,
             "finished": self.finished,
             "preempted": self.preempted,

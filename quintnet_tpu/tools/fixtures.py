@@ -1,0 +1,23 @@
+"""Shared deterministic fixtures for the verifier CLIs.
+
+Every ground-truth verifier (verify_gpt2, verify_llama, and the parity
+harness they anchor) must score BOTH frameworks on the SAME batch, and
+two runs of the same verifier must score the same batch again — so the
+token fixture is a seeded ``default_rng`` draw, not ``np.random``
+global state. It used to be copy-pasted per verifier; one copy
+drifting (a different seed, an int64 dtype reaching an int32 embedding
+lookup) would silently turn a parity check into a comparison of two
+different inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_token_ids(vocab_size: int, batch: int, seq: int, *,
+                     seed: int = 0) -> np.ndarray:
+    """Deterministic [batch, seq] int32 token ids in [0, vocab_size) —
+    the common eval batch of the HF cross-check verifiers."""
+    return np.random.default_rng(seed).integers(
+        0, vocab_size, (batch, seq), dtype=np.int32)

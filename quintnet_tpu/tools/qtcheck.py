@@ -1,0 +1,142 @@
+"""qtcheck CLI: lint the tree for JAX footguns, gated by a baseline.
+
+  python -m quintnet_tpu.tools.qtcheck                        # lint all
+  python -m quintnet_tpu.tools.qtcheck quintnet_tpu/serve     # subset
+  python -m quintnet_tpu.tools.qtcheck \
+      --baseline tools/qtcheck_baseline.json                  # CI gate
+  python -m quintnet_tpu.tools.qtcheck \
+      --baseline tools/qtcheck_baseline.json --write-baseline # refresh
+
+Exit codes: 0 = clean or exactly baseline-matched; 1 = NEW violations
+(fix them or, for a deliberate pattern, add a ``# qtcheck: ok[RULE]``
+pragma with a justifying comment) or STALE baseline entries (you fixed
+legacy violations — rerun with ``--write-baseline`` and commit the
+shrunken file; notes on surviving entries are preserved).
+
+The baseline keys violations by (rule, file, enclosing function) with a
+count, so line drift never churns it, and CI
+(tests/test_qtcheck.py::test_lint_baseline_gate) fails whenever the
+committed file and the tree disagree in EITHER direction — the same
+no-drift discipline tests/test_bench_stale.py applies to benchmark
+artifacts.
+
+The jaxpr-level passes (collective census, recompile sentinel,
+donation/dtype reports) are not CLI passes — they need lowered
+programs, so they live in tests/test_qtcheck.py against the real
+train/serve builders. This CLI is the pure-source half of qtcheck:
+run as a FILE (``python quintnet_tpu/tools/qtcheck.py``) it imports no
+jax at all (analysis/lint.py is loaded by path, bypassing the package
+__init__), so it works in a lint-only environment; ``python -m
+quintnet_tpu.tools.qtcheck`` behaves identically but initialises the
+package (and therefore jax) as any ``-m`` run must.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+# Load analysis/lint.py by FILE PATH, not through the package:
+# `import quintnet_tpu` pulls in jax (core/compat installs shims at
+# import), and this CLI's contract is to lint source with zero jax —
+# it must work (and stay instant) in a lint-only environment.
+_LINT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "analysis", "lint.py")
+_spec = importlib.util.spec_from_file_location("_qtcheck_lint", _LINT_PATH)
+_lint = importlib.util.module_from_spec(_spec)
+sys.modules["_qtcheck_lint"] = _lint   # dataclasses needs it registered
+_spec.loader.exec_module(_lint)
+
+RULES = _lint.RULES
+compare_baseline = _lint.compare_baseline
+lint_paths = _lint.lint_paths
+load_baseline = _lint.load_baseline
+violations_to_baseline = _lint.violations_to_baseline
+
+DEFAULT_PATHS = ("quintnet_tpu", "tools", "bench.py")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qtcheck", description="JAX-footgun linter (see docs/"
+        "static_analysis.md for the rules and the baseline workflow)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths (default: "
+                         "autodetected from this file)")
+    ap.add_argument("--baseline", default=None,
+                    help="committed baseline JSON; new violations and "
+                         "stale entries both fail")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate --baseline from the current tree "
+                         "(preserving notes) instead of checking")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset, e.g. QT104,QT106")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    root = args.root or repo_root()
+    rules = args.rules.split(",") if args.rules else None
+    violations = lint_paths(args.paths or list(DEFAULT_PATHS),
+                            root=root, rules=rules)
+
+    if args.baseline and args.write_baseline:
+        notes = {}
+        if os.path.exists(args.baseline):
+            for e in load_baseline(args.baseline).get("violations", []):
+                if "note" in e:
+                    notes[(e["rule"], e["path"], e["symbol"])] = e["note"]
+        data = violations_to_baseline(violations, notes)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.baseline}: "
+              f"{len(data['violations'])} entries "
+              f"({len(violations)} violations)")
+        return 0
+
+    if args.baseline:
+        baseline = load_baseline(args.baseline)
+        new, stale = compare_baseline(violations, baseline)
+        if args.as_json:
+            print(json.dumps({"new": new, "stale": stale,
+                              "total": len(violations)}))
+        else:
+            for line in new:
+                print(f"NEW   {line}")
+            for line in stale:
+                print(f"STALE {line}")
+            status = "clean" if not (new or stale) else "FAIL"
+            print(f"qtcheck: {len(violations)} violation(s), "
+                  f"{len(new)} new, {len(stale)} stale vs baseline "
+                  f"— {status}")
+        return 1 if (new or stale) else 0
+
+    if args.as_json:
+        print(json.dumps([v.__dict__ for v in violations]))
+    else:
+        for v in violations:
+            print(v.render())
+        print(f"qtcheck: {len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

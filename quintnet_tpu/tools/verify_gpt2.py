@@ -30,14 +30,15 @@ def main():
 
     from quintnet_tpu.models.gpt2 import clm_loss, gpt2_apply
     from quintnet_tpu.models.gpt2_io import load_hf_gpt2
+    from quintnet_tpu.tools.fixtures import random_token_ids
 
     params, cfg = load_hf_gpt2(args.hf_file)
     if cfg.n_head != args.n_head:
         from dataclasses import replace
 
         cfg = replace(cfg, n_head=args.n_head)
-    ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (args.batch, args.seq), dtype=np.int32)
+    # shared seeded fixture: both frameworks must score the SAME batch
+    ids = random_token_ids(cfg.vocab_size, args.batch, args.seq)
 
     logits = gpt2_apply(params, jnp.asarray(ids), cfg)
     loss_jax = float(clm_loss(logits, jnp.asarray(ids)))

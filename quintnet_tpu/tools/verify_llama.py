@@ -73,8 +73,10 @@ def main():
     cfg = LlamaConfig.from_hf_config(hf_cfg)
     params = llama_from_hf_state(hf.state_dict(), cfg)
 
-    ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (args.batch, args.seq), dtype=np.int32)
+    # shared seeded fixture: both frameworks must score the SAME batch
+    from quintnet_tpu.tools.fixtures import random_token_ids
+
+    ids = random_token_ids(cfg.vocab_size, args.batch, args.seq)
     with torch.no_grad():
         t = torch.from_numpy(ids).long()
         out = hf(t, labels=t)

@@ -237,7 +237,16 @@ class Trainer:
             # guards); checkpoint saves stay collective on every process
             self.log = lambda msg: None
 
-        self.step_fn = self.strategy.make_train_step(self.model, self.optimizer)
+        # recompile sentinel (analysis/recompile.py): observe-only — a
+        # legit recompile exists (a differently-shaped final batch), but
+        # each one is logged with the signature diff so shape drift is
+        # named in the log, not guessed from a slow step
+        from quintnet_tpu.analysis.recompile import RecompileSentinel
+
+        self.step_fn = RecompileSentinel(
+            "train.step",
+            self.strategy.make_train_step(self.model, self.optimizer),
+            on_recompile=self._on_recompile)
         self._eval_fn = None
         self._last_ckpt_step = None  # newest orbax step written/restored
         # steps the restore fallback proved unreadable: replay re-reaches
@@ -249,6 +258,18 @@ class Trainer:
         # lets the epoch-boundary save heal a cadence save that landed
         # on the epoch's final batch (same global_step, boundary shape)
         self._last_ckpt_midepoch = False
+
+    def _on_recompile(self, name: str, count: int, diff: str) -> None:
+        self.log(f"{name}: lowering #{count} — {diff}")
+
+    def assert_compile_count(self, steps: int = 1,
+                             evals: Optional[int] = None) -> None:
+        """Enforce the one-compiled-program promise after a run: the
+        step (and optionally eval) function lowered exactly N times.
+        Raises RecompileError with a signature diff otherwise."""
+        self.step_fn.assert_compile_count(steps)
+        if evals is not None and self._eval_fn is not None:
+            self._eval_fn.assert_compile_count(evals)
 
     # -- state -------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None):
@@ -476,21 +497,51 @@ class Trainer:
                     lambda v: jax.lax.pmean(v, strat.batch_axes), mets)
             return mets
 
+        from quintnet_tpu.analysis.recompile import RecompileSentinel
+
         batch_spec = strat.batch_partition_specs(self.model)
-        self._eval_fn = jax.jit(cc.shard_map_fn(
-            local_eval, strat.mesh,
-            in_specs=(specs, batch_spec),
-            out_specs=P()))
+        # donate the batch: evaluate() ships a fresh device batch per
+        # call and never touches it again, so its buffer can be freed
+        # as soon as the forward consumes it instead of after the call
+        # (the donation report flagged eval/validation loops as the
+        # undonated ones — train steps already donate params/opt_state)
+        self._eval_fn = RecompileSentinel(
+            "train.eval",
+            jax.jit(cc.shard_map_fn(
+                local_eval, strat.mesh,
+                in_specs=(specs, batch_spec),
+                out_specs=P()), donate_argnums=(1,)),
+            on_recompile=self._on_recompile)
         return self._eval_fn
 
     def evaluate(self, params, batches: Iterable) -> Dict[str, float]:
+        import warnings
+
         eval_fn = self._build_eval()
         acc: Dict[str, list] = {}
-        for xb, yb in batches:
-            b = self.strategy.shard_batch((jnp.asarray(xb), jnp.asarray(yb)),
-                                          self.model)
-            for k, v in eval_fn(params, b).items():
-                acc.setdefault(k, []).append(v)  # device scalars; no sync
+
+        def fresh(v):
+            # eval_fn donates the batch. For host inputs (the normal
+            # case) shard_batch builds a new device buffer, so donation
+            # is free; a DEVICE-resident input may pass through
+            # device_put unchanged and donation would delete the
+            # caller's array — copy those first (the copy is what
+            # donation consumes).
+            return jnp.copy(v) if isinstance(v, jax.Array) \
+                else jnp.asarray(v)
+
+        with warnings.catch_warnings():
+            # metric outputs are scalars, so XLA cannot ALIAS the
+            # donated batch and warns it went unaliased — expected;
+            # scoped here so genuine donation mistakes elsewhere still
+            # warn
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            for xb, yb in batches:
+                b = self.strategy.shard_batch((fresh(xb), fresh(yb)),
+                                              self.model)
+                for k, v in eval_fn(params, b).items():
+                    acc.setdefault(k, []).append(v)  # device scalars
         out = {k: float(np.mean([float(v) for v in vs]))
                for k, vs in acc.items()}
         out.setdefault("loss", float("nan"))
@@ -595,7 +646,9 @@ class Trainer:
             def flush():
                 nonlocal n_flushed, loss_sum, loss_count
                 for dev_loss in losses[n_flushed:]:
-                    loss_sum += float(dev_loss)
+                    # deliberate sync: flush runs only at checkpoint
+                    # boundaries and epoch end, never per step
+                    loss_sum += float(dev_loss)  # qtcheck: ok[QT104]
                     loss_count += 1
                 n_flushed = len(losses)
 
@@ -638,11 +691,12 @@ class Trainer:
                 global_step += 1
                 if sync_every and (i + 1) % sync_every == 0:
                     # bound async run-ahead (training.sync_every docs)
-                    float(loss)
+                    float(loss)  # qtcheck: ok[QT104] — windowed by design
                 if log_every and (i + 1) % log_every == 0:
                     # the float() is the device sync for the window, so
                     # the wall clock measured here is honest throughput
-                    window = float(jnp.mean(jnp.stack(losses[-log_every:])))
+                    window = float(  # qtcheck: ok[QT104] — window sync
+                        jnp.mean(jnp.stack(losses[-log_every:])))
                     dt = time.time() - t_win
                     sps = log_every * len(xb) / max(dt, 1e-9)
                     msg = (f"epoch {epoch} step {i + 1}: "
@@ -654,7 +708,10 @@ class Trainer:
                 # -- fault-tolerance boundary (after the step landed) --
                 if ft is not None:
                     if ft.goodput is not None:
-                        ft.goodput.on_step(global_step)
+                        # the loss rides along so the meter can sync on
+                        # the last step's device work before reading its
+                        # wall clock (ft/goodput.py report)
+                        ft.goodput.on_step(global_step, loss)
                     if ft.chaos is not None:
                         # may os._exit / SIGTERM self / raise ChaosKilled
                         ft.chaos.on_step_end(global_step)
