@@ -36,6 +36,7 @@ from quintnet_tpu.analysis.recompile import (
     RecompileError,
     RecompileSentinel,
     abstract_signature,
+    assert_compile_count,
 )
 
 __all__ = [
@@ -53,4 +54,5 @@ __all__ = [
     "RecompileError",
     "RecompileSentinel",
     "abstract_signature",
+    "assert_compile_count",
 ]

@@ -1,0 +1,55 @@
+"""Multi-replica serving: load balancing, load shedding, kill-safe
+request migration.
+
+One :class:`~quintnet_tpu.serve.engine.ServeEngine` is a single
+continuous-batching process; this package runs N of them on worker
+threads behind one submit/stream API and makes the resulting fleet
+operable under the two things production traffic guarantees — bursts
+and failures:
+
+- :mod:`router`    — least-outstanding-work routing (token-count load
+  proxy) or deterministic round_robin;
+- :mod:`admission` — bounded fleet-wide queue; overload and expired
+  deadlines shed with a typed :class:`Overloaded` instead of queueing
+  forever;
+- :mod:`health`    — per-replica circuit breaker (consecutive-failure
+  trip, timed half-open probe) gating restarts of dead replicas;
+- :mod:`replica`   — the ServeEngine worker thread: inbox, chaos
+  polling (``ft.ChaosMonkey`` mode='raise'), and the death export of
+  every unfinished request's host-side progress;
+- :mod:`fleet`     — :class:`ServeFleet`: submit/result/generate,
+  dispatcher, **exact migration** (a killed replica's in-flight
+  requests resume on healthy replicas token-identically, via the same
+  prompt+generated+key resume contract the engine's preemption path
+  already guarantees), graceful drain, fleet metrics + per-replica
+  compile-count enforcement.
+
+tools/fleet_bench.py replays a trace against the fleet per routing
+policy — with a mid-trace replica kill and an over-capacity burst —
+and emits one JSON record per policy (artifacts/fleet_r08.json).
+"""
+
+from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
+from quintnet_tpu.fleet.fleet import FleetMetrics, FleetRequest, ServeFleet
+from quintnet_tpu.fleet.health import (CLOSED, DEAD, HALF_OPEN, HEALTHY,
+                                       OPEN, STOPPED, CircuitBreaker)
+from quintnet_tpu.fleet.replica import Replica
+from quintnet_tpu.fleet.router import POLICIES, Router
+
+__all__ = [
+    "AdmissionQueue",
+    "CircuitBreaker",
+    "FleetMetrics",
+    "FleetRequest",
+    "Overloaded",
+    "POLICIES",
+    "Replica",
+    "Router",
+    "ServeFleet",
+    "HEALTHY",
+    "DEAD",
+    "STOPPED",
+    "CLOSED",
+    "OPEN",
+    "HALF_OPEN",
+]

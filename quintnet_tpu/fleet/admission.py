@@ -1,0 +1,101 @@
+"""Bounded fleet-wide admission queue + typed load shedding.
+
+A serving front-end that queues without bound converts overload into
+unbounded latency: every request eventually "succeeds" seconds or
+minutes late, which for an interactive workload is indistinguishable
+from failure — except the client got no signal to back off or retry
+elsewhere. The fleet therefore sheds: :class:`Overloaded` is a TYPED
+rejection carrying a machine-readable ``reason``, raised
+
+- at submit time when the pending queue is at ``max_pending``
+  (``reason='queue_full'`` — the >capacity-burst signal), or when the
+  fleet is draining/closed (``reason='shutdown'``);
+- at dispatch time when a queued request's deadline has already
+  passed (``reason='deadline'`` — serving it late would waste replica
+  work the client will discard; shedding it is strictly better for
+  everyone behind it in the queue).
+
+Migration re-queues (:meth:`AdmissionQueue.push_front`) bypass the
+bound: that work was already admitted once and its tokens are already
+partially delivered — shedding it on re-entry would turn one replica
+death into client-visible failures, which is exactly what migration
+exists to prevent.
+
+The queue is NOT internally locked: the fleet serialises all access
+under its own condition lock; this class owns only the policy.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+SHED_REASONS = ("queue_full", "deadline", "shutdown")
+
+
+class Overloaded(RuntimeError):
+    """Typed rejection: the fleet refused (or abandoned) a request
+    instead of queueing it forever. ``reason`` is one of
+    ``queue_full`` / ``deadline`` / ``shutdown``."""
+
+    def __init__(self, reason: str, message: str):
+        assert reason in SHED_REASONS, reason
+        super().__init__(message)
+        self.reason = reason
+
+
+class AdmissionQueue:
+    """Bounded FIFO of pending fleet requests with deadline shedding.
+
+    Items must expose a ``deadline`` attribute (absolute fleet-clock
+    time, or ``None``)."""
+
+    def __init__(self, max_pending: int,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.max_pending = int(max_pending)
+        self.clock = clock
+        self._items: List = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    @property
+    def full(self) -> bool:
+        return len(self._items) >= self.max_pending
+
+    def push(self, item) -> None:
+        """Append, or raise ``Overloaded('queue_full')`` at the bound."""
+        if self.full:
+            raise Overloaded(
+                "queue_full",
+                f"admission queue full ({self.max_pending} pending); "
+                f"shedding instead of queueing unboundedly — retry with "
+                f"backoff or raise max_pending/replicas")
+        self._items.append(item)
+
+    def push_front(self, items: List) -> None:
+        """Re-queue migrated work at the head of the line (it keeps its
+        place — it was admitted before anything currently pending).
+        Deliberately bypasses ``max_pending``; see module docstring."""
+        self._items[:0] = items
+
+    def shed_expired(self, now: Optional[float] = None) -> List:
+        """Remove and return every queued item whose deadline has
+        passed (the caller rejects them with ``Overloaded('deadline')``)."""
+        now = self.clock() if now is None else now
+        expired = [i for i in self._items
+                   if i.deadline is not None and now >= i.deadline]
+        if expired:
+            self._items = [i for i in self._items if i not in expired]
+        return expired
+
+    def pop(self):
+        """Head of the line, or None."""
+        return self._items.pop(0) if self._items else None
+
+    def drain_all(self) -> List:
+        """Empty the queue (shutdown path); returns what was pending."""
+        items, self._items = self._items, []
+        return items

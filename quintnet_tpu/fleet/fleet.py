@@ -1,0 +1,547 @@
+"""ServeFleet: N ServeEngine replicas behind one submit/stream API.
+
+One continuous-batching engine (serve/engine.py) saturates at
+``max_slots`` concurrent requests; the fleet multiplexes a request
+stream over N replica engines on worker threads — the AlpaServe
+observation that replicated capacity with statistical multiplexing,
+not one bigger replica, is what holds tail latency under bursty
+traffic. The pieces:
+
+- **routing** (fleet/router.py): least-outstanding-work by token count
+  (or round_robin), over replicas that are healthy, unpaused, and
+  below their dispatch window;
+- **admission** (fleet/admission.py): a bounded fleet-wide queue;
+  overload and expired deadlines shed with a typed
+  :class:`~quintnet_tpu.fleet.admission.Overloaded` instead of
+  queueing forever;
+- **health** (fleet/health.py): per-replica circuit breaker —
+  consecutive-failure trip, timed half-open probe — deciding whether
+  a dead replica is restarted (fresh engine from the factory);
+- **migration** (fleet/replica.py + serve/engine.py): a replica that
+  dies mid-flight exports every unfinished request's host-side
+  progress (prompt, generated, evolved PRNG key — the engine's own
+  preemption-resume contract); the fleet re-queues it AT THE FRONT and
+  a healthy replica resumes it via ``engine.restore_progress``,
+  token-identical to an undisturbed run;
+- **drain**: graceful shutdown — refuse new work, finish everything
+  accepted, then stop the threads.
+
+All replicas must be built from the SAME (family, params) — the
+factory is called once per replica (and per restart); migration
+correctness rests on that equivalence.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from quintnet_tpu.analysis import assert_compile_count as _assert_cc
+from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
+from quintnet_tpu.fleet.health import DEAD, HEALTHY, CircuitBreaker
+from quintnet_tpu.fleet.replica import Replica
+from quintnet_tpu.fleet.router import Router
+from quintnet_tpu.serve import metrics as serve_metrics
+
+
+class FleetRequest:
+    """One request's fleet-side life: payload, result slot, marks."""
+
+    def __init__(self, fid: int, prompt, max_new_tokens: int, *, key,
+                 priority: int, deadline: Optional[float], on_token,
+                 submit_time: float, clock):
+        self.fid = fid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.key = key
+        self.priority = priority
+        self.deadline = deadline          # absolute fleet-clock time
+        self.on_token = on_token
+        self.submit_time = submit_time
+        self._clock = clock
+
+        self.progress = None              # RequestProgress after a death
+        self.migrations = 0
+        self.cost = 0                     # outstanding-token estimate
+        self.replica_name: Optional[str] = None
+        self.first_token_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.output: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+
+    def deliver(self, token: int, last: bool) -> None:
+        """Worker-thread token delivery (streaming surface). Tokens
+        survive migration without duplication: a resumed request only
+        emits tokens generated AFTER its checkpoint."""
+        if self.first_token_time is None:
+            self.first_token_time = self._clock()
+        if self.on_token is not None:
+            self.on_token(self.fid, token, last)
+
+    def outstanding_cost(self) -> int:
+        """Tokens of work still owed: the (re-)prefill plus remaining
+        decode steps — what least_work routing charges the replica.
+        Identical for fresh and migrated requests: a migration
+        re-prefills prompt+generated, so the generated tokens move
+        from the decode column to the prefill column and the total is
+        unchanged."""
+        return len(self.prompt) + self.max_new_tokens
+
+
+@dataclass
+class FleetMetrics:
+    """Fleet-front-door counters + latency marks (fleet clock: queue
+    wait INCLUDED, unlike the per-engine ServeMetrics TTFT)."""
+
+    submitted: int = 0                  # all attempts, incl. rejected
+    accepted: int = 0
+    finished: int = 0
+    shed_queue_full: int = 0
+    shed_deadline: int = 0
+    shed_shutdown: int = 0
+    migrations: int = 0
+    replica_deaths: int = 0
+    restarts: int = 0
+    ttfts: List[float] = field(default_factory=list)
+    latencies: List[float] = field(default_factory=list)
+
+    @property
+    def shed(self) -> int:
+        return (self.shed_queue_full + self.shed_deadline
+                + self.shed_shutdown)
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / max(self.submitted, 1)
+
+    def summary(self) -> Dict:
+        return {
+            "submitted": self.submitted,
+            "accepted": self.accepted,
+            "finished": self.finished,
+            "shed": self.shed,
+            "shed_queue_full": self.shed_queue_full,
+            "shed_deadline": self.shed_deadline,
+            "shed_shutdown": self.shed_shutdown,
+            "shed_rate": round(self.shed_rate, 4),
+            "migrations": self.migrations,
+            "replica_deaths": self.replica_deaths,
+            "restarts": self.restarts,
+            "ttft_s": serve_metrics._pcts(self.ttfts),
+            "latency_s": serve_metrics._pcts(self.latencies),
+        }
+
+
+class ServeFleet:
+    """Multi-replica serving front-end (see module docstring).
+
+    ``engine_factory``: zero-arg callable returning a fresh
+    :class:`~quintnet_tpu.serve.engine.ServeEngine`; called once per
+    replica and once per breaker-approved restart. ``chaos``: one
+    ``ft.ChaosMonkey`` (mode='raise') or a sequence; each is armed
+    against the replica named by its ``target`` (default: replica 0).
+    """
+
+    def __init__(self, engine_factory: Callable, *, n_replicas: int = 2,
+                 policy: str = "least_work", max_pending: int = 64,
+                 max_dispatch: Optional[int] = None,
+                 trip_after: int = 3, breaker_reset_s: float = 30.0,
+                 chaos=None, clock: Callable[[], float] = time.monotonic,
+                 name_prefix: str = "r", poll_s: float = 0.02):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self._factory = engine_factory
+        self.clock = clock
+        self.metrics = FleetMetrics()
+        self._router = Router(policy)
+        self._cv = threading.Condition()
+        self._queue = AdmissionQueue(max_pending, clock=clock)
+        self._requests: Dict[int, FleetRequest] = {}
+        self._fid_counter = 0
+        self._open = 0                 # accepted, not yet finished/shed
+        self._draining = False
+        self._closed = False
+        self._max_dispatch = max_dispatch
+        self._poll_s = poll_s
+        self._retired_metrics: List = []   # ServeMetrics of dead engines
+
+        monkeys = [] if chaos is None else (
+            list(chaos) if isinstance(chaos, (list, tuple)) else [chaos])
+        names = [f"{name_prefix}{i}" for i in range(n_replicas)]
+        by_target = {}
+        for m in monkeys:
+            by_target[m.target if m.target is not None else names[0]] = m
+        unknown = set(by_target) - set(names)
+        if unknown:
+            raise ValueError(
+                f"chaos target(s) {sorted(unknown)} name no replica "
+                f"(have {names})")
+
+        self._breakers = {
+            name: CircuitBreaker(trip_after=trip_after,
+                                 reset_s=breaker_reset_s, clock=clock)
+            for name in names}
+        self._replicas = [self._spawn(name, by_target.get(name))
+                          for name in names]
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="fleet-dispatch", daemon=True)
+        self._dispatcher.start()
+
+    def _spawn(self, name: str, chaos) -> Replica:
+        return Replica(name, self._factory, chaos=chaos,
+                       max_dispatch=self._max_dispatch,
+                       on_finish=self._on_finish, on_death=self._on_death,
+                       on_reject=self._on_reject, poll_s=self._poll_s)
+
+    # ------------------------------------------------------------------
+    # submission / results
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, key=None,
+               priority: int = 0, deadline_s: Optional[float] = None,
+               on_token=None) -> int:
+        """Queue one request fleet-wide; returns its fleet id. Raises
+        :class:`Overloaded` instead of queueing when the fleet is over
+        capacity (``queue_full``), the deadline is unmeetable
+        (``deadline``), or the fleet is draining (``shutdown``).
+
+        ``key`` defaults to ``fold_in(key(0), fid)`` — fleet-level, so
+        a request's sampled output does not depend on which replica
+        serves it. ``deadline_s`` is a time-to-first-dispatch budget
+        from now; a request still queued when it expires is shed.
+        ``on_token(fid, token, is_last)`` fires from a replica worker
+        thread as tokens are produced, across migrations, each token
+        exactly once."""
+        import jax
+
+        # requests the fleet could NEVER run fail fast here, like
+        # engine.submit would — dispatched, they would bounce off every
+        # replica's validation instead (all engines share one config,
+        # so replica 0's limits speak for the fleet)
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._replicas[0].engine._check_admissible(
+            prompt, int(max_new_tokens))
+        with self._cv:
+            self.metrics.submitted += 1
+            if self._draining or self._closed:
+                self.metrics.shed_shutdown += 1
+                raise Overloaded(
+                    "shutdown", "fleet is draining; not accepting work")
+            now = self.clock()
+            if deadline_s is not None and deadline_s <= 0:
+                self.metrics.shed_deadline += 1
+                raise Overloaded(
+                    "deadline", f"deadline_s={deadline_s} already expired "
+                    f"at submit")
+            fid = self._fid_counter
+            self._fid_counter += 1
+            if key is None:
+                key = jax.random.fold_in(jax.random.key(0), fid)
+            freq = FleetRequest(
+                fid, prompt, int(max_new_tokens), key=key,
+                priority=int(priority),
+                deadline=(None if deadline_s is None
+                          else now + float(deadline_s)),
+                on_token=on_token, submit_time=now, clock=self.clock)
+            try:
+                self._queue.push(freq)
+            except Overloaded:
+                self.metrics.shed_queue_full += 1
+                raise
+            self._requests[fid] = freq
+            self._open += 1
+            self.metrics.accepted += 1
+            self._cv.notify_all()
+            return fid
+
+    def result(self, fid: int, *, timeout: Optional[float] = None
+               ) -> np.ndarray:
+        """Block until the request finishes; returns prompt+generated.
+        Raises the request's typed error if it was shed."""
+        freq = self._requests[fid]
+        if not freq.event.wait(timeout):
+            raise TimeoutError(
+                f"fleet request {fid} unfinished after {timeout}s "
+                f"(replica={freq.replica_name}, "
+                f"migrations={freq.migrations})")
+        if freq.error is not None:
+            raise freq.error
+        return freq.output
+
+    def request(self, fid: int) -> FleetRequest:
+        return self._requests[fid]
+
+    def generate(self, prompts: Sequence, *, max_new_tokens, keys=None,
+                 priorities=None, timeout: Optional[float] = None
+                 ) -> List[np.ndarray]:
+        """Blocking batch surface over the whole fleet (the analogue of
+        serve.api.generate). Sheds propagate as Overloaded."""
+        n = len(prompts)
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * n
+        keys = [None] * n if keys is None else keys
+        priorities = [0] * n if priorities is None else priorities
+        if not (len(max_new_tokens) == len(keys) == len(priorities) == n):
+            raise ValueError(
+                "per-prompt argument lengths must match prompts")
+        fids = [self.submit(p, m, key=k, priority=pr)
+                for p, m, k, pr in zip(prompts, max_new_tokens, keys,
+                                       priorities)]
+        return [self.result(f, timeout=timeout) for f in fids]
+
+    # ------------------------------------------------------------------
+    # worker callbacks (replica threads)
+    # ------------------------------------------------------------------
+    def _on_finish(self, rep: Replica, freq: FleetRequest,
+                   output: np.ndarray) -> None:
+        with self._cv:
+            rep.in_flight -= 1
+            rep.outstanding_tokens -= freq.cost
+            self._breakers[rep.name].record_success()
+            freq.output = output
+            freq.finish_time = self.clock()
+            self.metrics.finished += 1
+            if freq.first_token_time is not None:
+                self.metrics.ttfts.append(
+                    freq.first_token_time - freq.submit_time)
+            self.metrics.latencies.append(
+                freq.finish_time - freq.submit_time)
+            self._open -= 1
+            freq.event.set()
+            self._cv.notify_all()
+
+    def _on_reject(self, rep: Replica, freq: FleetRequest,
+                   error: BaseException) -> None:
+        """A request the engine refused at ingest (ValueError from its
+        submit/restore validation): error that request's waiter; the
+        replica stays healthy."""
+        with self._cv:
+            rep.in_flight -= 1
+            rep.outstanding_tokens -= freq.cost
+            freq.error = error
+            self._open -= 1
+            freq.event.set()
+            self._cv.notify_all()
+
+    def _on_death(self, rep: Replica, error: BaseException,
+                  exports: List) -> None:
+        with self._cv:
+            self.metrics.replica_deaths += 1
+            self._breakers[rep.name].record_failure()
+            self._retired_metrics.append(rep.engine.metrics)
+            rep.in_flight = 0
+            rep.outstanding_tokens = 0
+            # the worker exported without the fleet lock; a dispatch
+            # racing the death can have landed one more inbox item
+            # since — re-drain under the lock enqueues are made under
+            exports = list(exports) + rep.drain_inbox()
+            migrated = []
+            for freq, prog in sorted(exports, key=lambda e: e[0].fid):
+                if prog is not None:
+                    freq.progress = prog
+                if self._closed:
+                    # the dispatcher is gone; nothing can resume this
+                    self._shed_locked(freq, "shutdown",
+                                      "replica died during close")
+                    continue
+                freq.migrations += 1
+                self.metrics.migrations += 1
+                migrated.append(freq)
+            self._queue.push_front(migrated)
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # dispatcher
+    # ------------------------------------------------------------------
+    def _shed_locked(self, freq: FleetRequest, reason: str,
+                     message: str) -> None:
+        if reason == "deadline":
+            self.metrics.shed_deadline += 1
+        else:
+            self.metrics.shed_shutdown += 1
+        freq.error = Overloaded(reason, message)
+        self._open -= 1
+        freq.event.set()
+        self._cv.notify_all()
+
+    def _tend_replicas_locked(self) -> None:
+        for i, rep in enumerate(self._replicas):
+            if rep.state != DEAD:
+                continue
+            if not self._breakers[rep.name].allow_restart():
+                continue
+            chaos = rep.chaos
+            if chaos is not None and getattr(chaos, "rearm", False):
+                chaos.killed = False
+            self._replicas[i] = self._spawn(rep.name, chaos)
+            self.metrics.restarts += 1
+
+    def _dispatch_locked(self) -> None:
+        for freq in self._queue.shed_expired():
+            self._shed_locked(
+                freq, "deadline",
+                f"request {freq.fid} still queued at its deadline; shed "
+                f"instead of serving a result the client stopped "
+                f"waiting for")
+        while len(self._queue):
+            cands = [r for r in self._replicas
+                     if r.state == HEALTHY and not r.paused
+                     and r.in_flight < r.max_dispatch]
+            if not cands:
+                return
+            rep = self._router.pick(cands)
+            freq = self._queue.pop()
+            freq.cost = freq.outstanding_cost()
+            freq.replica_name = rep.name
+            rep.in_flight += 1
+            rep.outstanding_tokens += freq.cost
+            rep.enqueue(freq, freq.progress)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                self._tend_replicas_locked()
+                self._dispatch_locked()
+                self._cv.wait(self._poll_s)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def pause_all(self) -> None:
+        for rep in self._replicas:
+            rep.pause()
+
+    def resume_all(self) -> None:
+        for rep in self._replicas:
+            rep.resume()
+        with self._cv:
+            self._cv.notify_all()
+
+    def arm_chaos(self, monkey) -> None:
+        """Attach a (mode='raise') ChaosMonkey to the replica named by
+        its ``target`` (default: replica 0) on a RUNNING fleet — the
+        bench arms faults after warmup so kill_at_step counts replay
+        steps only."""
+        name = monkey.target
+        with self._cv:
+            reps = {r.name: r for r in self._replicas}
+            if name is not None and name not in reps:
+                raise ValueError(f"no replica named {name!r}")
+            rep = self._replicas[0] if name is None else reps[name]
+            rep.chaos = monkey
+
+    def drain(self, *, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: refuse new submissions, let everything
+        already accepted run to completion (migrations included), then
+        stop the worker threads. Raises TimeoutError (fleet left
+        draining but alive) if the backlog does not clear in time."""
+        deadline = None if timeout is None else self.clock() + timeout
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+            while self._open > 0:
+                if deadline is not None and self.clock() >= deadline:
+                    raise TimeoutError(
+                        f"drain: {self._open} request(s) still open "
+                        f"after {timeout}s")
+                self._cv.wait(self._poll_s)
+        self.close()
+
+    def close(self) -> None:
+        """Hard stop: shed everything pending, stop all threads, error
+        any request still in flight (``Overloaded('shutdown')``). Use
+        :meth:`drain` for the graceful path."""
+        with self._cv:
+            if self._closed:
+                return
+            self._draining = True
+            self._closed = True
+            for freq in self._queue.drain_all():
+                self._shed_locked(freq, "shutdown",
+                                  "fleet closed before dispatch")
+            self._cv.notify_all()
+        self._dispatcher.join(timeout=10.0)
+        for rep in self._replicas:
+            rep.stop()
+        with self._cv:
+            for rep in self._replicas:
+                for freq in rep.unfinished():
+                    if not freq.event.is_set():
+                        self._shed_locked(
+                            freq, "shutdown",
+                            "fleet closed with the request in flight")
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def replicas(self) -> List[Replica]:
+        return list(self._replicas)
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        return self._breakers[name]
+
+    def reset_metrics(self) -> None:
+        """Fresh ledgers fleet-wide (bench warmup boundary): fleet
+        counters, every live engine's ServeMetrics, retired-engine
+        stash, and each replica's step counter — so a ChaosMonkey armed
+        after warmup (:meth:`arm_chaos`) counts REPLAY steps only."""
+        with self._cv:
+            self.metrics = FleetMetrics()
+            self._retired_metrics = []
+            for rep in self._replicas:
+                rep.steps = 0
+                rep.engine.metrics = type(rep.engine.metrics)(
+                    clock=rep.engine.clock)
+
+    def engine_summary(self) -> Dict:
+        """serve.metrics.aggregate over every engine that served this
+        fleet — live replicas plus engines retired by a death."""
+        with self._cv:
+            ms = ([rep.engine.metrics for rep in self._replicas]
+                  + list(self._retired_metrics))
+        return serve_metrics.aggregate(ms)
+
+    def summary(self) -> Dict:
+        """One JSON-able dict: fleet front-door metrics + aggregated
+        engine metrics + per-replica state."""
+        with self._cv:
+            per_replica = {
+                rep.name: {
+                    "state": rep.state,
+                    "steps": rep.steps,
+                    "in_flight": rep.in_flight,
+                    "outstanding_tokens": rep.outstanding_tokens,
+                    "breaker": self._breakers[rep.name].state,
+                    "compile_stats": rep.engine.compile_stats(),
+                } for rep in self._replicas}
+        out = self.metrics.summary()
+        out["policy"] = self._router.policy
+        out["replicas"] = per_replica
+        out["engine"] = self.engine_summary()
+        return out
+
+    def assert_compile_count(self, prefill: int = 1, decode: int = 1, *,
+                             include_idle: bool = False) -> None:
+        """The fleet-wide one-prefill+one-decode promise, routed
+        through analysis.assert_compile_count: every replica engine
+        that served at least one request must have compiled EXACTLY
+        ``prefill``/``decode`` programs. Engines that never admitted
+        work (0 compiles — e.g. a just-restarted probe that got no
+        traffic) are skipped unless ``include_idle``."""
+        expected: Dict[str, int] = {}
+        sentinels: Dict = {}
+        for rep in self._replicas:
+            if not include_idle and rep.engine.metrics.admitted == 0:
+                continue
+            for kind, sentinel in rep.engine.compile_sentinels().items():
+                key = f"{rep.name}_{kind}"
+                expected[key] = prefill if kind == "prefill" else decode
+                sentinels[key] = sentinel
+        _assert_cc(expected, **sentinels)

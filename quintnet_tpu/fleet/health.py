@@ -1,0 +1,83 @@
+"""Per-replica health state + circuit breaker.
+
+A replica is either serving (``HEALTHY``), dead with its worker thread
+exited on an error (``DEAD``), or cleanly shut down (``STOPPED``).
+Whether a DEAD replica gets restarted is the :class:`CircuitBreaker`'s
+call — the classic three-state breaker (Nygard, *Release It!*):
+
+- **closed**: failures below the trip threshold; every death is
+  followed by an immediate restart (transient faults are expected —
+  a preempted core, an injected chaos kill);
+- **open**: ``trip_after`` CONSECUTIVE failures tripped the breaker;
+  no restarts until ``reset_s`` has elapsed, so a hard-broken replica
+  (bad device, poisoned params) cannot crash-loop and drag the fleet's
+  dispatcher into endless migration churn;
+- **half-open**: the cool-down elapsed; exactly ONE probe restart is
+  allowed. The probe replica completing a request closes the breaker
+  (fleet calls :meth:`record_success` on every finish); dying again
+  re-opens it for another full ``reset_s``.
+
+The breaker never touches threads itself — it is pure policy, driven
+by the fleet's dispatcher under the fleet lock, with an injectable
+clock so tests advance time without sleeping.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+# replica lifecycle states (Replica.state)
+HEALTHY = "healthy"
+DEAD = "dead"
+STOPPED = "stopped"
+
+# breaker states (CircuitBreaker.state)
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Consecutive-failure trip with a timed half-open probe."""
+
+    def __init__(self, *, trip_after: int = 3, reset_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if trip_after < 1:
+            raise ValueError(f"trip_after must be >= 1, got {trip_after}")
+        self.trip_after = int(trip_after)
+        self.reset_s = float(reset_s)
+        self.clock = clock
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+
+    def record_failure(self) -> None:
+        """One replica death. A half-open probe dying re-opens
+        immediately; otherwise the trip threshold decides."""
+        self.consecutive_failures += 1
+        if (self.state == HALF_OPEN
+                or self.consecutive_failures >= self.trip_after):
+            self.state = OPEN
+            self._opened_at = self.clock()
+
+    def record_success(self) -> None:
+        """The replica completed a request: whatever tripped it is
+        gone; full reset."""
+        self.consecutive_failures = 0
+        self.state = CLOSED
+        self._opened_at = None
+
+    def allow_restart(self) -> bool:
+        """May the fleet restart the dead replica NOW? closed → always;
+        open → only once ``reset_s`` has elapsed (transitions to
+        half-open and grants the single probe); half-open → no (the
+        probe is already out)."""
+        if self.state == CLOSED:
+            return True
+        if self.state == HALF_OPEN:
+            return False
+        if self.clock() - self._opened_at >= self.reset_s:
+            self.state = HALF_OPEN
+            return True
+        return False

@@ -52,17 +52,31 @@ class ChaosKilled(Exception):
 
 @dataclass
 class ChaosMonkey:
-    """Kill/fail injector polled by the train loop (via ``FTContext``).
+    """Kill/fail injector polled by the train loop (via ``FTContext``)
+    and by serving-fleet replica threads (quintnet_tpu/fleet/).
 
     ``kill_at_step`` counts GLOBAL steps (monotone across epochs and
     restarts), so a relaunched run armed with a later step resumes,
     passes its old death point, and dies at the new one — exactly the
-    repeated-preemption scenario the supervisor test replays.
+    repeated-preemption scenario the supervisor test replays. When a
+    fleet replica polls the monkey, the counter is that REPLICA's
+    engine-step count.
+
+    ``target`` names the fleet replica the fault is armed against
+    (e.g. ``"r1"``); ``None`` targets the process/first replica.
+    In-process replica kills must use ``mode='raise'`` —
+    ``hard``/``sigterm`` take down the whole process, which is the
+    ``tools/ft_run.py`` supervisor story, not a single replica's.
+    ``rearm=True`` lets a fleet re-arm the monkey each time it restarts
+    the dead replica (repeated-failure injection for the circuit
+    breaker); the default fires once.
     """
 
     kill_at_step: Optional[int] = None
     mode: str = "hard"  # hard | sigterm | raise
     fail_restores: int = 0
+    target: Optional[str] = None
+    rearm: bool = False
     killed: bool = field(default=False, init=False)
     restore_failures_injected: int = field(default=0, init=False)
 
@@ -75,7 +89,9 @@ class ChaosMonkey:
         return ChaosMonkey(
             kill_at_step=spec.get("kill_at_step"),
             mode=spec.get("mode", "hard"),
-            fail_restores=int(spec.get("fail_restores", 0)))
+            fail_restores=int(spec.get("fail_restores", 0)),
+            target=spec.get("target"),
+            rearm=bool(spec.get("rearm", False)))
 
     def on_step_end(self, global_step: int) -> None:
         """Die if the armed step was just completed (idempotent: the

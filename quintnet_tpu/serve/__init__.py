@@ -31,15 +31,17 @@ from quintnet_tpu.serve.api import generate, generate_stream
 from quintnet_tpu.serve.engine import ServeEngine
 from quintnet_tpu.serve.families import gpt2_family, llama_family
 from quintnet_tpu.serve.kv_pool import KVPool
-from quintnet_tpu.serve.metrics import ServeMetrics
-from quintnet_tpu.serve.scheduler import Request, Scheduler
+from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
+from quintnet_tpu.serve.scheduler import Request, RequestProgress, Scheduler
 
 __all__ = [
     "KVPool",
     "Request",
+    "RequestProgress",
     "Scheduler",
     "ServeEngine",
     "ServeMetrics",
+    "aggregate",
     "generate",
     "generate_stream",
     "gpt2_family",

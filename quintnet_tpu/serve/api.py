@@ -18,6 +18,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from quintnet_tpu.serve.engine import ServeEngine
+from quintnet_tpu.serve.scheduler import FINISHED
 
 
 def generate(engine: ServeEngine, prompts: Sequence, *,
@@ -45,6 +46,17 @@ def generate(engine: ServeEngine, prompts: Sequence, *,
             for p, m, k, pr in zip(prompts, max_new_tokens, keys,
                                    priorities)]
     engine.run(max_steps=max_steps)
+    unfinished = [r for r in rids if engine.request(r).state != FINISHED]
+    if unfinished:
+        detail = ", ".join(
+            f"rid {r} ({engine.request(r).state}, "
+            f"{len(engine.request(r).generated)}/"
+            f"{engine.request(r).max_new_tokens} tokens)"
+            for r in unfinished)
+        raise RuntimeError(
+            f"generate: {len(unfinished)} of {n} request(s) unfinished "
+            f"after max_steps={max_steps}: {detail} — raise max_steps "
+            f"(or submit less work per call)")
     return [engine.result(r) for r in rids]
 
 

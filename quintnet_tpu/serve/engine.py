@@ -42,7 +42,8 @@ from quintnet_tpu.analysis.recompile import RecompileSentinel
 from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics
-from quintnet_tpu.serve.scheduler import FINISHED, Request, Scheduler
+from quintnet_tpu.serve.scheduler import (FINISHED, Request,
+                                          RequestProgress, Scheduler)
 
 
 class ServeEngine:
@@ -104,6 +105,7 @@ class ServeEngine:
         self._results: Dict[int, Request] = {}
         self._rid_counter = 0
         self._arrival_counter = 0
+        self._admissions_paused = False
 
         # the one-compiled-program promise, enforced at call time: a
         # second abstract signature for either program raises
@@ -214,13 +216,9 @@ class ServeEngine:
     # ------------------------------------------------------------------
     # submission / results
     # ------------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
-               key=None, on_token=None) -> int:
-        """Queue one request; returns its id. ``key``: per-request
-        sampling key (defaults to fold_in(key(0), rid)) — pass the SAME
-        key an independent ``gpt2_generate`` call would get to reproduce
-        it token-for-token."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
+    def _check_admissible(self, prompt: np.ndarray,
+                          max_new_tokens: int) -> None:
+        """Submit-time rejection of requests the engine can NEVER run."""
         if prompt.size < 1:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -248,6 +246,21 @@ class ServeEngine:
                 f"KV pool too small for this request: needs up to "
                 f"{worst} blocks, pool has {self.pool.usable_blocks} "
                 f"usable (block_size={self.pool.block_size})")
+
+    def _enqueue(self, req: Request) -> int:
+        req.submit_time = self.clock()
+        self._results[req.rid] = req
+        self.scheduler.submit(req)
+        return req.rid
+
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               key=None, on_token=None) -> int:
+        """Queue one request; returns its id. ``key``: per-request
+        sampling key (defaults to fold_in(key(0), rid)) — pass the SAME
+        key an independent ``gpt2_generate`` call would get to reproduce
+        it token-for-token."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._check_admissible(prompt, max_new_tokens)
         rid = self._rid_counter
         self._rid_counter += 1
         if key is None:
@@ -258,10 +271,42 @@ class ServeEngine:
                       arrival=self._arrival_counter, on_token=on_token)
         self._arrival_counter += 1
         req.key_data = np.asarray(jax.random.key_data(key))
-        req.submit_time = self.clock()
-        self._results[rid] = req
-        self.scheduler.submit(req)
-        return rid
+        return self._enqueue(req)
+
+    def restore_progress(self, progress: RequestProgress, *,
+                         on_token=None) -> int:
+        """Admit a request MIGRATED from another engine of the same
+        (family, params): resume from its exported
+        :class:`RequestProgress` (see :meth:`export_progress`). The
+        resume path is the preemption path — the next admission
+        prefills ``prompt + generated`` and keeps sampling from the
+        checkpointed key, so the continuation is token-identical to the
+        run the exporting engine would have produced. Returns this
+        engine's (new) request id; ``on_token`` fires only for tokens
+        generated HERE (already-exported tokens were delivered by the
+        exporter)."""
+        prompt = np.asarray(progress.prompt, np.int32).reshape(-1)
+        if progress.key_data is None:
+            raise ValueError(
+                "progress.key_data is required to restore a request "
+                "(without it the continuation could not reproduce the "
+                "original sampling stream)")
+        if len(progress.generated) >= progress.max_new_tokens:
+            raise ValueError(
+                f"nothing left to generate: {len(progress.generated)} of "
+                f"{progress.max_new_tokens} tokens already produced")
+        self._check_admissible(prompt, progress.max_new_tokens)
+        rid = self._rid_counter
+        self._rid_counter += 1
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(progress.max_new_tokens),
+                      priority=int(progress.priority),
+                      arrival=self._arrival_counter, on_token=on_token)
+        self._arrival_counter += 1
+        req.generated = list(progress.generated)
+        req.key_data = np.array(progress.key_data, copy=True)
+        req.preemptions = int(progress.preemptions)
+        return self._enqueue(req)
 
     def result(self, rid: int) -> np.ndarray:
         req = self._results[rid]
@@ -398,7 +443,7 @@ class ServeEngine:
         prefill_tokens = 0
 
         # 1. admissions (prefill; may retire instantly on EOS/budget)
-        while True:
+        while not self._admissions_paused:
             free = self._free_slots()
             req = self.scheduler.next_admission(len(free))
             if req is None:
@@ -452,6 +497,59 @@ class ServeEngine:
             steps += 1
 
     # ------------------------------------------------------------------
+    # pause / drain / progress export (the fleet's migration surface)
+    # ------------------------------------------------------------------
+    @property
+    def admissions_paused(self) -> bool:
+        return self._admissions_paused
+
+    def pause_admissions(self) -> None:
+        """Stop admitting from the waiting queue; active slots keep
+        decoding. NOTE: while paused, ``run()`` would spin if only
+        waiting work remains (``has_work`` counts the queue) — pair
+        pausing with :meth:`drain` / :meth:`step`, not ``run()``."""
+        self._admissions_paused = True
+
+    def resume_admissions(self) -> None:
+        self._admissions_paused = False
+
+    def drain(self, *, max_steps: Optional[int] = None) -> List[int]:
+        """Finish the ACTIVE slots without admitting anything new:
+        pause admissions and step until no slot is occupied. Waiting
+        requests stay queued — export them (:meth:`export_progress`)
+        for migration, or :meth:`resume_admissions` to keep serving.
+        Returns the rids finished during the drain."""
+        self.pause_admissions()
+        finished: List[int] = []
+        steps = 0
+        while self._active_slots():
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"drain: {len(self._active_slots())} slot(s) still "
+                    f"active after {max_steps} steps")
+            finished.extend(self.step())
+            steps += 1
+        return finished
+
+    def export_progress(self) -> List[RequestProgress]:
+        """Snapshot every UNFINISHED request's host-side resume payload
+        (running slots + waiting queue), in arrival order. For running
+        slots the evolved PRNG key is checkpointed from the last
+        completed step — the same state :meth:`_preempt` saves — so the
+        export is exact at any step boundary, including after the
+        owning worker died between steps (the fleet's kill-migration
+        path). Read-only: the engine's own state is untouched."""
+        out: List[RequestProgress] = []
+        for slot in self._active_slots():
+            req = self._slot_req[slot]
+            req.key_data = self._key_data[slot].copy()
+            out.append(req.progress())
+        for req in self.scheduler.waiting:
+            out.append(req.progress())
+        out.sort(key=lambda p: p.rid)
+        return out
+
+    # ------------------------------------------------------------------
     def compile_stats(self) -> Dict[str, int]:
         """Compiled-program counts for the no-recompile invariant
         (tests/test_serve.py): both entries must stay at 1 no matter
@@ -459,6 +557,12 @@ class ServeEngine:
         (distinct abstract signatures seen = programs jit compiled)."""
         return {"prefill": self._prefill.compile_count,
                 "decode": self._decode.compile_count}
+
+    def compile_sentinels(self) -> Dict[str, RecompileSentinel]:
+        """The prefill/decode RecompileSentinels, for callers that
+        aggregate the promise across engines (fleet.assert_compile_count
+        routes them through analysis.assert_compile_count)."""
+        return {"prefill": self._prefill, "decode": self._decode}
 
     def assert_compile_count(self, prefill: int = 1, decode: int = 1):
         """Raise RecompileError (with a signature diff) unless exactly

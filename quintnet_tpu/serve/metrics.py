@@ -23,10 +23,11 @@ import numpy as np
 
 def _pcts(xs: List[float]) -> Dict[str, float]:
     if not xs:
-        return {"p50": 0.0, "p95": 0.0}
+        return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
     a = np.asarray(xs, np.float64)
     return {"p50": float(np.percentile(a, 50)),
-            "p95": float(np.percentile(a, 95))}
+            "p95": float(np.percentile(a, 95)),
+            "p99": float(np.percentile(a, 99))}
 
 
 @dataclass
@@ -138,3 +139,45 @@ class ServeMetrics:
             100.0 * self.kv_blocks_used / max(self.kv_blocks_total, 1),
             self.prefill_tokens, self.decode_tokens, self.preempted,
             self.finished)
+
+
+def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
+    """Fleet-level roll-up of several engines' :class:`ServeMetrics`
+    into one summary-shaped dict (quintnet_tpu/fleet/ reads it for the
+    whole-fleet throughput line).
+
+    Counters are summed; the TTFT/latency percentile SOURCE lists are
+    pooled before taking percentiles (true fleet-wide tails, not an
+    average of per-replica percentiles); the wall clock spans the
+    earliest first step to the latest last step across replicas, so
+    ``tokens_per_sec`` is aggregate fleet throughput, not a per-replica
+    mean. Replicas that never stepped contribute counters only."""
+    t0s = [m._t0 for m in all_metrics if m._t0 is not None]
+    ends = [m._t_end for m in all_metrics if m._t_end is not None]
+    wall = (max(ends) - min(t0s)) if t0s and ends else 0.0
+    wall = max(wall, 0.0)
+    gen_tokens = sum(m.gen_tokens for m in all_metrics)
+    ttfts: List[float] = []
+    latencies: List[float] = []
+    for m in all_metrics:
+        ttfts.extend(m.ttfts)
+        latencies.extend(m.latencies)
+    return {
+        "replicas": len(all_metrics),
+        "steps": sum(m.steps for m in all_metrics),
+        "gen_tokens": gen_tokens,
+        "admitted": sum(m.admitted for m in all_metrics),
+        "finished": sum(m.finished for m in all_metrics),
+        "preempted": sum(m.preempted for m in all_metrics),
+        "prefill_tokens": sum(m.prefill_tokens for m in all_metrics),
+        "decode_tokens": sum(m.decode_tokens for m in all_metrics),
+        "wall_s": round(wall, 4),
+        "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
+        "ttft_s": _pcts(ttfts),
+        "latency_s": _pcts(latencies),
+        "peak_kv_utilization": round(
+            max((m.peak_kv_utilization for m in all_metrics), default=0.0),
+            4),
+        "peak_running": max((m.peak_running for m in all_metrics),
+                            default=0),
+    }

@@ -32,6 +32,33 @@ FINISHED = "finished"
 
 
 @dataclass
+class RequestProgress:
+    """Portable host-side resume payload for one unfinished request.
+
+    Exactly the state :meth:`ServeEngine._preempt` checkpoints within
+    one engine — original prompt, tokens generated so far, the evolved
+    PRNG key — made exportable ACROSS engines: any engine built from
+    the same (family, params) that re-prefills ``prompt + generated``
+    and keeps sampling from ``key_data`` continues the token stream
+    exactly where the exporter stopped. This is the fleet's migration
+    contract (quintnet_tpu/fleet/): a replica killed mid-flight has its
+    requests' progress re-submitted elsewhere via
+    :meth:`ServeEngine.restore_progress`, token-identical to an
+    undisturbed run.
+
+    ``rid`` is the EXPORTING engine's request id (engine-local; the
+    restoring engine assigns its own)."""
+
+    rid: int
+    prompt: np.ndarray
+    generated: List[int]
+    key_data: Optional[np.ndarray]
+    max_new_tokens: int
+    priority: int = 0
+    preemptions: int = 0
+
+
+@dataclass
 class Request:
     """One generation request and its host-side progress.
 
@@ -72,6 +99,20 @@ class Request:
         """prompt + generated, the completed sequence."""
         return np.concatenate(
             [self.prompt, np.asarray(self.generated, np.int32)])
+
+    def progress(self) -> RequestProgress:
+        """Snapshot the resume payload. Assumes ``key_data`` is CURRENT:
+        it is for waiting requests (submit-time key, or the evolved key
+        checkpointed at preemption); for RUNNING slots the engine
+        refreshes it from device-step state first
+        (:meth:`ServeEngine.export_progress`)."""
+        return RequestProgress(
+            rid=self.rid, prompt=np.array(self.prompt, copy=True),
+            generated=list(self.generated),
+            key_data=(None if self.key_data is None
+                      else np.array(self.key_data, copy=True)),
+            max_new_tokens=self.max_new_tokens, priority=self.priority,
+            preemptions=self.preemptions)
 
 
 class Scheduler:

@@ -64,6 +64,12 @@ class StepTimer:
         self._t0 = None
 
     def summary(self) -> Dict[str, float]:
+        """Zero recorded steps is a legal state (a run that died before
+        its first stop(), an idle serving replica): report a zeroed
+        summary with ``steps: 0`` instead of NaN means + a NumPy
+        RuntimeWarning from an empty reduction."""
+        if not self.times:
+            return {"steps": 0, "mean_s": 0.0, "p50_s": 0.0, "p99_s": 0.0}
         a = np.asarray(self.times[1:] or self.times)  # drop compile step
         return {
             "steps": len(self.times),

@@ -1,0 +1,390 @@
+"""Multi-replica serving goldens (quintnet_tpu/fleet/).
+
+THE contract: a fleet of N replica engines serves every request
+token-for-token identically to an independent ``gpt2_generate`` call —
+including requests whose replica is KILLED mid-flight and migrated
+(the exported prompt+generated+key progress resumes elsewhere). Plus
+the operational invariants: typed load shedding under a >capacity
+burst (bounded queue, deadline expiry), circuit-breaker-gated
+restarts with a timed half-open probe, graceful drain, per-replica
+one-prefill+one-decode compile counts via analysis.assert_compile_count.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from quintnet_tpu.fleet import (DEAD, HALF_OPEN, HEALTHY, OPEN,
+                                AdmissionQueue, CircuitBreaker,
+                                Overloaded, Router, ServeFleet)
+from quintnet_tpu.ft import ChaosMonkey
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.models.gpt2_generate import gpt2_generate
+from quintnet_tpu.serve import ServeEngine, gpt2_family
+from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
+
+CFG = GPT2Config.tiny(n_layer=2)
+TEMP, TOPK = 0.8, 5
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+@pytest.fixture
+def factory(params):
+    def make():
+        return ServeEngine(gpt2_family(CFG), params, max_slots=2,
+                           block_size=4, num_blocks=24, max_seq_len=24,
+                           temperature=TEMP, top_k=TOPK)
+
+    return make
+
+
+def _oracle(params, prompt, max_new, key):
+    return np.asarray(gpt2_generate(
+        params, prompt[None], CFG, max_new_tokens=max_new,
+        temperature=TEMP, top_k=TOPK, key=key)[0])
+
+
+def _prompts(rng, lengths):
+    return [np.asarray(rng.integers(0, CFG.vocab_size, (t,)), np.int32)
+            for t in lengths]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _wait_until(pred, *, timeout=30.0, msg=""):
+    done = threading.Event()
+    import time
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for: {msg}")
+        done.wait(0.01)
+
+
+# ---------------------------------------------------------------------
+# policy units (no engines)
+# ---------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_trips_after_consecutive_failures_only(self):
+        clk = FakeClock()
+        br = CircuitBreaker(trip_after=3, reset_s=10.0, clock=clk)
+        br.record_failure()
+        br.record_failure()
+        assert br.allow_restart()          # still closed
+        br.record_success()                # resets the streak
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()                # third consecutive
+        assert br.state == OPEN
+        assert not br.allow_restart()
+
+    def test_half_open_probe_once_then_success_closes(self):
+        clk = FakeClock()
+        br = CircuitBreaker(trip_after=1, reset_s=10.0, clock=clk)
+        br.record_failure()
+        assert br.state == OPEN and not br.allow_restart()
+        clk.advance(10.0)
+        assert br.allow_restart()          # the single probe
+        assert br.state == HALF_OPEN
+        assert not br.allow_restart()      # no second probe
+        br.record_success()
+        assert br.state == "closed" and br.consecutive_failures == 0
+
+    def test_half_open_failure_reopens_for_full_reset(self):
+        clk = FakeClock()
+        br = CircuitBreaker(trip_after=1, reset_s=10.0, clock=clk)
+        br.record_failure()
+        clk.advance(10.0)
+        assert br.allow_restart()
+        br.record_failure()                # probe died
+        assert br.state == OPEN
+        clk.advance(9.0)
+        assert not br.allow_restart()      # full reset_s again
+        clk.advance(1.0)
+        assert br.allow_restart()
+
+
+class _Item:
+    def __init__(self, deadline=None):
+        self.deadline = deadline
+
+
+class TestAdmissionQueue:
+    def test_bound_sheds_typed(self):
+        q = AdmissionQueue(2, clock=FakeClock())
+        q.push(_Item())
+        q.push(_Item())
+        with pytest.raises(Overloaded) as ei:
+            q.push(_Item())
+        assert ei.value.reason == "queue_full"
+        assert len(q) == 2                 # the queue did NOT grow
+
+    def test_deadline_shedding(self):
+        clk = FakeClock()
+        q = AdmissionQueue(8, clock=clk)
+        live, dead = _Item(), _Item(deadline=5.0)
+        q.push(live)
+        q.push(dead)
+        assert q.shed_expired() == []
+        clk.advance(6.0)
+        assert q.shed_expired() == [dead]
+        assert q.pop() is live and q.pop() is None
+
+    def test_migration_requeue_bypasses_bound(self):
+        q = AdmissionQueue(1, clock=FakeClock())
+        q.push(_Item())
+        migrated = _Item()
+        q.push_front([migrated])           # no Overloaded
+        assert len(q) == 2 and q.pop() is migrated
+
+
+class TestRouter:
+    class _Rep:
+        def __init__(self, name, load):
+            self.name, self.outstanding_tokens = name, load
+
+    def test_least_work_picks_min_tokens(self):
+        r = Router("least_work")
+        reps = [self._Rep("r0", 30), self._Rep("r1", 10),
+                self._Rep("r2", 20)]
+        assert r.pick(reps).name == "r1"
+        # tie breaks on name: reproducible
+        reps[0].outstanding_tokens = 10
+        assert r.pick(reps).name == "r0"
+
+    def test_round_robin_cycles(self):
+        r = Router("round_robin")
+        reps = [self._Rep(n, 0) for n in ("r0", "r1", "r2")]
+        assert [r.pick(reps).name for _ in range(4)] == \
+            ["r0", "r1", "r2", "r0"]
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown routing policy"):
+            Router("fastest")
+
+
+def test_metrics_aggregate_pools_counters_and_tails():
+    clk = FakeClock()
+    a, b = ServeMetrics(clock=clk), ServeMetrics(clock=clk)
+    a.record_step(running=1, waiting=0, kv_blocks_used=2,
+                  kv_blocks_total=4, prefill_tokens=5, decode_tokens=1)
+    clk.advance(2.0)
+    b.record_step(running=2, waiting=1, kv_blocks_used=4,
+                  kv_blocks_total=4, prefill_tokens=7, decode_tokens=2)
+    a.record_admit()
+    a.record_first_token(0.1)
+    b.record_first_token(0.9)
+    b.record_finish(1.5)
+    agg = aggregate([a, b])
+    assert agg["replicas"] == 2 and agg["steps"] == 2
+    assert agg["prefill_tokens"] == 12 and agg["decode_tokens"] == 3
+    assert agg["gen_tokens"] == 4       # decode 3 + 1 admission sample
+    assert agg["wall_s"] == 2.0         # earliest t0 -> latest t_end
+    assert agg["finished"] == 1
+    # pooled percentiles see BOTH replicas' ttfts
+    assert agg["ttft_s"]["p50"] == pytest.approx(0.5)
+    assert "p99" in agg["ttft_s"]
+    assert agg["peak_kv_utilization"] == 1.0
+
+
+# ---------------------------------------------------------------------
+# fleet integration (real engines)
+# ---------------------------------------------------------------------
+
+def test_fleet_parity_and_graceful_drain(factory, params, rng):
+    """No faults: outputs across 2 replicas == independent oracle per
+    request; per-replica compile counts are exactly 1 prefill + 1
+    decode (analysis.assert_compile_count); drain refuses new work."""
+    prompts = _prompts(rng, (5, 7, 3, 6, 4, 8))
+    keys = [jax.random.key(100 + i) for i in range(6)]
+    fleet = ServeFleet(factory, n_replicas=2, policy="least_work")
+    try:
+        outs = fleet.generate(prompts, max_new_tokens=8, keys=keys,
+                              timeout=300)
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(o, _oracle(params, p, 8, k))
+        fleet.assert_compile_count(include_idle=True)
+        s = fleet.summary()
+        assert s["finished"] == 6 and s["engine"]["finished"] == 6
+        assert s["shed"] == 0 and s["migrations"] == 0
+        assert all(v["compile_stats"] == {"prefill": 1, "decode": 1}
+                   for v in s["replicas"].values())
+    finally:
+        fleet.drain(timeout=60)
+    with pytest.raises(Overloaded) as ei:
+        fleet.submit(prompts[0], 4)
+    assert ei.value.reason == "shutdown"
+
+
+def test_never_admissible_request_rejected_at_submit(factory, params,
+                                                     rng):
+    """A request no engine in the fleet could ever run (prompt+budget
+    over max_seq_len) fails fast at fleet.submit — it must NOT be
+    dispatched to bounce off (or kill) a replica worker."""
+    fleet = ServeFleet(factory, n_replicas=1)
+    try:
+        with pytest.raises(ValueError, match="exceeds max_seq_len"):
+            fleet.submit(np.zeros(23, np.int32), 8)
+        with pytest.raises(ValueError, match="empty prompt"):
+            fleet.submit(np.zeros(0, np.int32), 4)
+        assert fleet.metrics.accepted == 0
+        # the fleet still serves fine afterwards
+        p = _prompts(rng, (5,))[0]
+        k = jax.random.key(60)
+        np.testing.assert_array_equal(
+            fleet.generate([p], max_new_tokens=4, keys=[k],
+                           timeout=300)[0],
+            _oracle(params, p, 4, k))
+        assert all(r.state == HEALTHY for r in fleet.replicas)
+    finally:
+        fleet.drain(timeout=60)
+
+
+def test_kill_one_of_three_migrates_token_identically(factory, params,
+                                                      rng):
+    """THE chaos demo: replica r1 of 3 is killed (ft.ChaosMonkey,
+    mode='raise') after its 3rd step with requests mid-flight. Every
+    request still completes, token-identical to the undisturbed
+    oracle — including a STREAMING request that migrates mid-stream
+    (tokens in order, is_last exactly once, nothing re-delivered)."""
+    prompts = _prompts(rng, (5, 7, 3, 6, 4, 8, 5, 6, 4))
+    keys = [jax.random.key(500 + i) for i in range(9)]
+    monkey = ChaosMonkey(kill_at_step=3, mode="raise", target="r1")
+    fleet = ServeFleet(factory, n_replicas=3, policy="round_robin",
+                       chaos=monkey)
+    try:
+        streamed = []
+        fids = []
+        for i, (p, k) in enumerate(zip(prompts, keys)):
+            on_token = ((lambda fid, tok, last:
+                         streamed.append((tok, last)))
+                        if i == 1 else None)   # round_robin: i=1 -> r1
+            fids.append(fleet.submit(p, 8, key=k, on_token=on_token))
+        outs = [fleet.result(f, timeout=300) for f in fids]
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(o, _oracle(params, p, 8, k))
+
+        m = fleet.metrics
+        assert m.replica_deaths == 1
+        assert m.migrations >= 1           # in-flight work moved over
+        assert m.restarts == 1             # breaker closed -> restart
+        assert m.finished == 9 and m.shed == 0
+        # the streaming request survived migration with an intact,
+        # in-order, exactly-once token stream
+        toks = [t for t, _ in streamed]
+        np.testing.assert_array_equal(
+            np.asarray(toks, np.int32), outs[1][len(prompts[1]):])
+        assert [last for _, last in streamed].count(True) == 1
+        assert streamed[-1][1] is True
+        # every replica that served kept the one-prefill+one-decode
+        # promise (idle just-restarted engines are skipped)
+        fleet.assert_compile_count()
+    finally:
+        fleet.drain(timeout=120)
+
+
+def test_burst_sheds_typed_and_deadline_expiry(factory, params, rng):
+    """Over-capacity burst: the bounded queue rejects with
+    Overloaded('queue_full') instead of growing; a queued request whose
+    deadline lapses is shed with Overloaded('deadline'); everything
+    accepted still completes golden."""
+    clk = FakeClock()
+    prompts = _prompts(rng, (5, 6, 4, 7, 5, 6))
+    keys = [jax.random.key(700 + i) for i in range(6)]
+    fleet = ServeFleet(factory, n_replicas=1, max_pending=4, clock=clk)
+    try:
+        fleet.pause_all()                  # freeze: nothing dispatches
+        ok = [fleet.submit(prompts[0], 6, key=keys[0])]
+        fid_dead = fleet.submit(prompts[1], 6, key=keys[1], deadline_s=5)
+        ok += [fleet.submit(prompts[2], 6, key=keys[2]),
+               fleet.submit(prompts[3], 6, key=keys[3])]
+        with pytest.raises(Overloaded) as ei:
+            fleet.submit(prompts[4], 6, key=keys[4])   # queue full
+        assert ei.value.reason == "queue_full"
+        with pytest.raises(Overloaded) as ei:
+            fleet.submit(prompts[5], 6, key=keys[5], deadline_s=0)
+        assert ei.value.reason == "deadline"
+        assert len(fleet._queue) <= 4      # bound held under the burst
+
+        clk.advance(10.0)                  # fid_dead's deadline lapses
+        _wait_until(lambda: fleet.request(fid_dead).event.is_set(),
+                    msg="deadline shed")
+        with pytest.raises(Overloaded) as ei:
+            fleet.result(fid_dead)
+        assert ei.value.reason == "deadline"
+
+        fleet.resume_all()
+        for fid, i in zip(ok, (0, 2, 3)):
+            np.testing.assert_array_equal(
+                fleet.result(fid, timeout=300),
+                _oracle(params, prompts[i], 6, keys[i]))
+        m = fleet.metrics
+        assert m.shed_queue_full == 1 and m.shed_deadline == 2
+        assert m.submitted == 6 and m.accepted == 4 and m.finished == 3
+        assert m.shed_rate == pytest.approx(0.5)
+    finally:
+        fleet.drain(timeout=120)
+
+
+def test_breaker_trips_then_half_open_probe_recovers(factory, params,
+                                                     rng):
+    """Repeated kills of r0 (rearmed chaos) trip its breaker after 2
+    consecutive failures: no more restarts, work migrates to r1,
+    everything completes. After reset_s the breaker grants ONE probe
+    restart; the probe completing a request closes the breaker."""
+    clk = FakeClock()
+    prompts = _prompts(rng, (5, 6, 4, 7))
+    keys = [jax.random.key(900 + i) for i in range(4)]
+    monkey = ChaosMonkey(kill_at_step=1, mode="raise", target="r0",
+                         rearm=True)
+    fleet = ServeFleet(factory, n_replicas=2, policy="round_robin",
+                       trip_after=2, breaker_reset_s=30.0, chaos=monkey,
+                       clock=clk)
+    try:
+        fids = [fleet.submit(p, 6, key=k)
+                for p, k in zip(prompts, keys)]
+        for fid, p, k in zip(fids, prompts, keys):
+            np.testing.assert_array_equal(
+                fleet.result(fid, timeout=300),
+                _oracle(params, p, 6, k))
+        _wait_until(lambda: fleet.breaker("r0").state == OPEN,
+                    msg="breaker open after repeated kills")
+        assert fleet.metrics.replica_deaths == 2
+        assert fleet.metrics.restarts == 1   # 2nd death tripped instead
+        assert fleet.metrics.migrations >= 2
+
+        # recovery: disarm the fault, let the cool-down elapse -> the
+        # dispatcher spawns exactly one half-open probe
+        monkey.kill_at_step = None
+        clk.advance(31.0)
+        _wait_until(lambda: fleet.metrics.restarts == 2,
+                    msg="half-open probe restart")
+        assert fleet.breaker("r0").state == HALF_OPEN
+        probe_keys = [jax.random.key(950 + i) for i in range(2)]
+        probe_prompts = _prompts(rng, (5, 6))
+        outs = fleet.generate(probe_prompts, max_new_tokens=4,
+                              keys=probe_keys, timeout=300)
+        for p, k, o in zip(probe_prompts, probe_keys, outs):
+            np.testing.assert_array_equal(o, _oracle(params, p, 4, k))
+        _wait_until(lambda: fleet.breaker("r0").state == "closed",
+                    msg="probe success closes the breaker")
+        assert all(r.state == HEALTHY for r in fleet.replicas)
+    finally:
+        fleet.drain(timeout=120)

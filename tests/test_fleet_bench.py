@@ -1,0 +1,93 @@
+"""tools/fleet_bench.py must never rot unexecuted: the fast suite runs
+the CLI end-to-end (CPU, tiny config, one replica kill) and checks the
+JSON contract, and the bench.py staleness scanner must surface the
+committed fleet artifact (artifacts/fleet_r08.json) the same way it
+surfaces the serving/training/ft records.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, REPO)
+import bench  # noqa: E402
+
+FLEET_METRIC = "fleet_gpt2_tiny_tokens_per_sec"
+
+
+@pytest.mark.fast
+def test_fleet_bench_smoke_cli():
+    """A tiny replay — 2 replicas, burst > capacity, r0 killed at its
+    2nd step — runs end-to-end on CPU and emits one well-formed JSON
+    line per policy with the acceptance fields."""
+    # capacity an instant burst can absorb = max_pending (2) +
+    # replicas * max_dispatch (2*2) = 6 < 8 requests -> >= 2 shed,
+    # deterministically, whatever the dispatcher's timing
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_bench.py"),
+         "--synthetic", "--requests", "8", "--replicas", "2",
+         "--policies", "least_work", "--max-new", "4",
+         "--max-pending", "2", "--max-dispatch", "2",
+         "--kill-at-step", "2",
+         "--kill-replica", "r0", "--timeout-s", "240"],
+        capture_output=True, text=True, timeout=500,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == FLEET_METRIC
+    assert rec["rc"] == 0
+    assert rec["unit"] == "tok/s"
+    ex = rec["extras"]
+    for k in ("policy", "ttft_p50_s", "ttft_p99_s", "shed_rate",
+              "migrations", "replica_deaths", "restarts", "finished",
+              "latency_p99_s"):
+        assert k in ex, k
+    # the injected kill really happened and its work still finished
+    assert ex["replica_deaths"] == 1
+    assert ex["migrations"] >= 1
+    assert ex["finished"] == ex["accepted"]
+    # the burst overflowed the bounded queue -> typed shedding, and
+    # accounting is consistent
+    assert ex["shed"] == ex["submitted"] - ex["accepted"]
+    assert ex["shed"] >= 1
+
+
+@pytest.mark.fast
+def test_committed_fleet_artifact_surfaces_in_staleness_scan():
+    """The committed fleet artifact is discoverable through the same
+    last_known_result scanner every other bench uses."""
+    last = bench.last_known_result(metric=FLEET_METRIC)
+    assert last is not None
+    assert last["stale"] is True
+    assert last["metric"] == FLEET_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_committed_fleet_artifact_proves_acceptance_scenario():
+    """artifacts/fleet_r08.json documents the acceptance run PER
+    POLICY: 1 of 3 replicas killed mid-trace with its work migrated
+    and finished, a >capacity burst shed (typed, bounded queue), and
+    p50/p99 TTFT + tok/s + shed rate + migration count reported."""
+    recs = json.load(open(os.path.join(REPO, "artifacts",
+                                       "fleet_r08.json")))
+    by_policy = {r["extras"]["policy"]: r for r in recs
+                 if r.get("metric") == FLEET_METRIC}
+    assert {"least_work", "round_robin"} <= set(by_policy)
+    for policy, rec in by_policy.items():
+        ex = rec["extras"]
+        assert rec["rc"] == 0 and rec["value"] > 0
+        assert ex["replicas"] == 3
+        assert ex["replica_deaths"] >= 1, policy     # chaos kill fired
+        assert ex["migrations"] >= 1, policy         # work moved over
+        assert ex["finished"] == ex["accepted"], policy  # none lost
+        assert ex["shed"] >= 1, policy               # burst shed
+        assert 0 < ex["shed_rate"] < 1, policy
+        assert ex["ttft_p50_s"] > 0 and ex["ttft_p99_s"] > 0, policy
+        assert ex["ttft_p99_s"] >= ex["ttft_p50_s"], policy

@@ -1,0 +1,32 @@
+"""utils/profiling.py units: StepTimer must degrade cleanly."""
+
+import warnings
+
+import pytest
+
+from quintnet_tpu.utils.profiling import StepTimer
+
+
+@pytest.mark.fast
+def test_steptimer_zero_steps_is_zeroed_not_nan():
+    """A timer that never recorded a step (a run that died before its
+    first stop(), an idle serving replica) reports a zeroed summary —
+    no NaNs, no NumPy empty-reduction RuntimeWarning."""
+    t = StepTimer()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # any warning -> test failure
+        s = t.summary()
+    assert s == {"steps": 0, "mean_s": 0.0, "p50_s": 0.0, "p99_s": 0.0}
+
+
+@pytest.mark.fast
+def test_steptimer_single_step_summary():
+    """One recorded step: the compile-step drop falls back to using it
+    (times[1:] is empty), and the numbers are finite."""
+    t = StepTimer()
+    t.start()
+    t.stop()
+    s = t.summary()
+    assert s["steps"] == 1
+    assert s["mean_s"] >= 0.0 and s["p50_s"] >= 0.0 and s["p99_s"] >= 0.0
+    assert s["mean_s"] == s["mean_s"]    # not NaN

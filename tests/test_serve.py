@@ -339,6 +339,127 @@ def test_streaming_callback(params, rng):
     assert [last for _, last in got] == [False] * 5 + [True]
 
 
+def test_generate_max_steps_error_names_unfinished(params, rng):
+    """Exhausting max_steps raises an ACTIONABLE error naming every
+    unfinished request id and its progress, instead of whatever
+    engine.result does on an unfinished row."""
+    eng = _engine(params)
+    prompts = _prompts(rng, (4, 4))
+    with pytest.raises(RuntimeError) as ei:
+        generate(eng, prompts, max_new_tokens=8, max_steps=2)
+    msg = str(ei.value)
+    assert "unfinished" in msg and "max_steps=2" in msg
+    assert "rid 0" in msg and "rid 1" in msg
+    assert "/8 tokens" in msg
+
+
+def test_stream_preempted_mid_stream_orders_tokens(params, rng):
+    """generate_stream under policy='priority' with queued background
+    work: the low-urgency streaming request is admitted youngest, gets
+    preempted when the pool dries, resumes — and still delivers its
+    tokens in order with is_last firing exactly once, nothing
+    re-delivered across the preemption."""
+    eng = _engine(params, max_slots=2, block_size=2, num_blocks=12,
+                  max_seq_len=20, policy="priority")
+    # bg0 is LONG: it keeps growing blocks while the stream runs, so
+    # the pool dries with the stream as the youngest admission (the
+    # eviction victim); bg1 is the queued background work
+    bg_prompts = _prompts(rng, (6, 4))
+    bg_new = (12, 4)
+    bg_keys = [jax.random.key(200 + i) for i in range(2)]
+    bg = [eng.submit(p, m, key=k, priority=0)
+          for p, m, k in zip(bg_prompts, bg_new, bg_keys)]
+
+    sp = _prompts(rng, (4,))[0]
+    skey = jax.random.key(300)
+    got = []
+    out = generate_stream(
+        eng, sp, max_new_tokens=8, key=skey, priority=5,
+        on_token=lambda rid, tok, last: got.append((rid, tok, last)))
+    srid = got[0][0]
+    assert eng.request(srid).preemptions >= 1  # actually preempted
+    toks = [t for _, t, _ in got]
+    np.testing.assert_array_equal(out[len(sp):], toks)  # in order, once
+    lasts = [last for *_, last in got]
+    assert lasts.count(True) == 1 and lasts[-1] is True
+    np.testing.assert_array_equal(out, _oracle(params, sp, 8, skey))
+    # the queued background work is untouched by the streaming detour
+    eng.run()
+    for p, m, k, r in zip(bg_prompts, bg_new, bg_keys, bg):
+        np.testing.assert_array_equal(eng.result(r),
+                                      _oracle(params, p, m, k))
+
+
+# ---------------------------------------------------------------------
+# pause / drain / progress export+restore (the migration surface)
+# ---------------------------------------------------------------------
+
+def test_export_restore_progress_cross_engine_exact(params, rng):
+    """The fleet migration contract at engine level: progress exported
+    mid-flight from engine A (running slot: evolved key; waiting row:
+    submit-time key) restored on a fresh engine B continues
+    token-identically — sampling on."""
+    prompts = _prompts(rng, (5, 6))
+    keys = [jax.random.key(40 + i) for i in range(2)]
+    a = _engine(params, max_slots=1, temperature=0.9, top_k=7)
+    rids = [a.submit(p, 8, key=k) for p, k in zip(prompts, keys)]
+    for _ in range(3):
+        a.step()
+    progs = a.export_progress()
+    assert [p.rid for p in progs] == rids
+    assert len(progs[0].generated) >= 1        # running, mid-flight
+    assert progs[1].generated == []            # still waiting
+
+    b = _engine(params, max_slots=2, temperature=0.9, top_k=7)
+    new_rids = [b.restore_progress(p) for p in progs]
+    b.run()
+    for p, k, nr in zip(prompts, keys, new_rids):
+        np.testing.assert_array_equal(
+            b.result(nr),
+            _oracle(params, p, 8, k, temperature=0.9, top_k=7))
+
+
+def test_restore_progress_validation(params, rng):
+    from quintnet_tpu.serve import RequestProgress
+
+    eng = _engine(params)
+    prompt = _prompts(rng, (4,))[0]
+    key_data = np.asarray(jax.random.key_data(jax.random.key(0)))
+    with pytest.raises(ValueError, match="key_data"):
+        eng.restore_progress(RequestProgress(
+            rid=0, prompt=prompt, generated=[1], key_data=None,
+            max_new_tokens=4))
+    with pytest.raises(ValueError, match="nothing left"):
+        eng.restore_progress(RequestProgress(
+            rid=0, prompt=prompt, generated=[1, 2], key_data=key_data,
+            max_new_tokens=2))
+    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+        eng.restore_progress(RequestProgress(
+            rid=0, prompt=np.zeros(39, np.int32), generated=[],
+            key_data=key_data, max_new_tokens=4))
+
+
+def test_pause_admissions_and_drain(params, rng):
+    """drain() finishes the active slots and leaves the waiting queue
+    intact with admissions paused; resume_admissions picks the queue
+    back up."""
+    eng = _engine(params, max_slots=1)
+    p1, p2 = _prompts(rng, (4, 4))
+    r1 = eng.submit(p1, 4, key=jax.random.key(1))
+    eng.step()                                  # r1 active
+    r2 = eng.submit(p2, 4, key=jax.random.key(2))
+    finished = eng.drain()
+    assert r1 in finished
+    assert eng.admissions_paused
+    assert eng.request(r2).state == "waiting"   # queued, not dropped
+    assert eng.pool.num_used == 0
+    eng.resume_admissions()
+    eng.run()
+    np.testing.assert_array_equal(eng.result(r2),
+                                  _oracle(params, p2, 4,
+                                          jax.random.key(2)))
+
+
 def test_submit_validation(params):
     eng = _engine(params)
     with pytest.raises(ValueError, match="exceeds max_seq_len"):

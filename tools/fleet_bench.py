@@ -1,0 +1,246 @@
+"""Fleet benchmark: replay a bursty request trace against a
+multi-replica serving fleet (quintnet_tpu/fleet/) once per routing
+policy, with a mid-trace replica kill and an over-capacity burst, and
+report one JSON line per policy:
+
+  {"metric": "fleet_gpt2_tiny_tokens_per_sec", "value": N,
+   "unit": "tok/s", "rc": 0, "extras": {"policy": "least_work",
+   "ttft_p50_s": .., "ttft_p99_s": .., "shed_rate": ..,
+   "migrations": .., ...}}
+
+The trace front-loads ``--burst`` requests in one instantaneous spike
+(what sheds: the fleet absorbs queue + dispatch windows and REJECTS
+the rest with a typed Overloaded — the queue never grows past
+``--max-pending``), then Poisson arrivals (inter-arrival ~
+Exp(rate) seconds) for the remainder. ``--kill-at-step K`` arms an
+``ft.ChaosMonkey`` (mode='raise') against ``--kill-replica`` AFTER
+warmup, so the victim dies at its K-th replay step and its in-flight
+requests migrate — finished counts include them, token-identical
+(tests/test_fleet.py holds the identity; here we count).
+
+Modes:
+  python tools/fleet_bench.py --synthetic                # tiny, CPU-ok
+  python tools/fleet_bench.py --synthetic --requests 6 \
+      --policies least_work                              # CI smoke
+  python tools/fleet_bench.py --synthetic --out artifacts/fleet_r08.json
+
+``--out FILE`` appends the records to an artifacts JSON list
+(bench.last_known_result scans them — same staleness story as the
+serve/train benches).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_factory(args):
+    import jax
+
+    from quintnet_tpu.serve import ServeEngine, gpt2_family, llama_family
+
+    if args.model == "gpt2":
+        from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+        cfg = (GPT2Config.tiny(n_layer=2) if args.synthetic
+               else GPT2Config.base())
+        params = gpt2_init(jax.random.key(args.seed), cfg)
+        family = gpt2_family(cfg)
+    elif args.model == "llama":
+        from quintnet_tpu.models.llama import LlamaConfig, llama_init
+
+        cfg = (LlamaConfig.tiny(n_layers=2) if args.synthetic
+               else LlamaConfig())
+        params = llama_init(jax.random.key(args.seed), cfg)
+        family = llama_family(cfg)
+    else:
+        raise SystemExit(f"unknown --model {args.model}")
+
+    max_seq = min(args.max_prompt + args.max_new, family.max_positions)
+
+    def factory():
+        return ServeEngine(
+            family, params, max_slots=args.slots,
+            block_size=args.block_size, num_blocks=args.num_blocks,
+            max_seq_len=max_seq, eos_token_id=args.eos,
+            temperature=args.temperature)
+
+    return factory, family.cfg.vocab_size
+
+
+def make_trace(args, vocab_size: int):
+    """[(delay_s_before_submit, prompt, max_new)]: the first ``burst``
+    arrivals are instantaneous (delay 0 — the shedding spike), the rest
+    Poisson-spaced."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    trace = []
+    for i in range(args.requests):
+        delay = 0.0 if i < args.burst else rng.exponential(1.0 / args.rate)
+        n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
+        prompt = rng.integers(0, vocab_size, (n,)).astype(np.int32)
+        trace.append((delay, prompt, args.max_new))
+    return trace
+
+
+def run_policy(args, policy: str, factory, vocab_size: int) -> dict:
+    import time
+
+    import numpy as np
+
+    import jax
+
+    from quintnet_tpu.fleet import Overloaded, ServeFleet
+    from quintnet_tpu.ft import ChaosMonkey
+
+    fleet = ServeFleet(
+        factory, n_replicas=args.replicas, policy=policy,
+        max_pending=args.max_pending, max_dispatch=args.max_dispatch,
+        trip_after=args.trip_after)
+    # warmup: compile every replica's prefill+decode OUTSIDE the timed
+    # window — one full request lifecycle per replica, routed there
+    # deterministically by pausing the others — then reset all ledgers
+    for rep in fleet.replicas:
+        for other in fleet.replicas:
+            other.resume() if other is rep else other.pause()
+        fleet.generate([np.ones((args.min_prompt,), "int32")],
+                       max_new_tokens=2, timeout=600)
+    fleet.resume_all()
+    fleet.reset_metrics()
+
+    monkey = None
+    if args.kill_at_step is not None:
+        monkey = ChaosMonkey(kill_at_step=args.kill_at_step, mode="raise",
+                             target=args.kill_replica)
+        fleet.arm_chaos(monkey)
+
+    trace = make_trace(args, vocab_size)
+    fids = []
+    t0 = time.perf_counter()
+    for delay, prompt, max_new in trace:
+        if delay:
+            time.sleep(delay)
+        try:
+            fids.append(fleet.submit(prompt, max_new))
+        except Overloaded:
+            pass                       # counted in fleet.summary()
+    for fid in fids:
+        try:
+            fleet.result(fid, timeout=args.timeout_s)
+        except Overloaded:
+            pass
+    jax.block_until_ready(
+        [rep.engine.pool.caches() for rep in fleet.replicas])
+    wall = time.perf_counter() - t0
+
+    s = fleet.summary()
+    fleet.drain(timeout=args.timeout_s)
+    eng = s["engine"]
+    gen_tokens = eng["gen_tokens"]
+    tag = "tiny" if args.synthetic else "full"
+    return {
+        "metric": f"fleet_{args.model}_{tag}_tokens_per_sec",
+        "value": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "rc": 0,
+        "extras": {
+            "policy": policy,
+            "replicas": args.replicas,
+            "requests": args.requests,
+            "submitted": s["submitted"],
+            "accepted": s["accepted"],
+            "finished": s["finished"],
+            "shed": s["shed"],
+            "shed_rate": s["shed_rate"],
+            "migrations": s["migrations"],
+            "replica_deaths": s["replica_deaths"],
+            "restarts": s["restarts"],
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "ttft_p99_s": s["ttft_s"]["p99"],
+            "latency_p50_s": s["latency_s"]["p50"],
+            "latency_p99_s": s["latency_s"]["p99"],
+            "gen_tokens": gen_tokens,
+            "engine_steps": eng["steps"],
+            "preempted": eng["preempted"],
+            "wall_s": round(wall, 4),
+            "kill_at_step": args.kill_at_step,
+            "kill_replica": args.kill_replica,
+            "burst": args.burst,
+            "max_pending": args.max_pending,
+            "rate": args.rate,
+            "slots": args.slots,
+            "model": args.model,
+            "synthetic": bool(args.synthetic),
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2", choices=("gpt2", "llama"))
+    ap.add_argument("--synthetic", action="store_true",
+                    help="tiny random-init config (CPU-testable)")
+    ap.add_argument("--policies", default="least_work,round_robin",
+                    help="comma-separated routing policies to replay")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--burst", type=int, default=None,
+                    help="arrivals submitted instantaneously at t=0 "
+                         "(default: all of them)")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="Poisson arrival rate for post-burst requests "
+                         "(requests per second)")
+    ap.add_argument("--max-pending", type=int, default=8)
+    ap.add_argument("--max-dispatch", type=int, default=None,
+                    help="per-replica dispatch window (default "
+                         "2*slots). An instant burst sheds at least "
+                         "requests - max_pending - replicas*window")
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=64)
+    ap.add_argument("--min-prompt", type=int, default=4)
+    ap.add_argument("--max-prompt", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--eos", type=int, default=None)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--trip-after", type=int, default=3)
+    ap.add_argument("--kill-at-step", type=int, default=None,
+                    help="arm a mode='raise' ChaosMonkey: the target "
+                         "replica dies after its K-th replay step")
+    ap.add_argument("--kill-replica", default="r1")
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="append the records to this artifacts JSON file")
+    args = ap.parse_args()
+    if args.burst is None:
+        args.burst = args.requests
+
+    factory, vocab = build_factory(args)
+    records = []
+    for policy in [p for p in args.policies.split(",") if p]:
+        records.append(run_policy(args, policy, factory, vocab))
+        print(json.dumps(records[-1]))
+
+    if args.out:
+        prev = []
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    loaded = json.load(f)
+                prev = loaded if isinstance(loaded, list) else [loaded]
+            except (OSError, json.JSONDecodeError):
+                prev = []
+        with open(args.out, "w") as f:
+            json.dump(prev + records, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
