@@ -170,13 +170,37 @@ def expected_3d_train_step(n_param_leaves: int, depth: int,
 # serving programs (quintnet_tpu/serve/engine.py)
 
 
+def prefill_buckets(prefill_len: int, *, floor: int = 16) -> Tuple[int, ...]:
+    """THE canonical padded-length ladder for the bucketed prefill
+    programs: powers of two from ``floor`` up to (and capped at)
+    ``prefill_len``. A prompt tail of length t runs in the smallest
+    bucket >= t, so short prompts stop paying max-length compute while
+    the compile count stays bounded: the engine compiles AT MOST
+    ``len(prefill_buckets(prefill_len))`` prefill programs (one
+    RecompileSentinel per bucket, ``max_compiles=1`` each — the
+    no-recompile invariant, now per bucket). Pinned here — engine and
+    census tests derive the same ladder from the same place."""
+    if prefill_len < 1:
+        raise ValueError(f"prefill_len must be >= 1; got {prefill_len}")
+    out = []
+    b = floor
+    while b < prefill_len:
+        out.append(b)
+        b *= 2
+    out.append(prefill_len)
+    return tuple(out)
+
+
 def expected_serve_prefill(n_layers: int, *,
                            tp_axis: Optional[str] = None,
                            vocab_parallel: bool = False) -> CensusDict:
-    """One compiled prefill: 2 row-parallel psums per block under tp
-    (attention out-proj + MLP down-proj — forward only, no autodiff),
-    plus the vocab-parallel embedding psum and logits all_gather when
-    the vocabulary is sharded. Single-device: ZERO collectives."""
+    """One compiled prefill bucket: 2 row-parallel psums per block
+    under tp (attention out-proj + MLP down-proj — forward only, no
+    autodiff), plus the vocab-parallel embedding psum and logits
+    all_gather when the vocabulary is sharded. Single-device: ZERO
+    collectives. The census is independent of the bucket width AND of
+    the prefix-cache split (paged scatter/gather add no collectives),
+    so every bucket program must match this same spec."""
     if tp_axis is None:
         return {}
     c: CensusDict = {tp_axis: {"all_reduce": 2 * n_layers}}

@@ -527,21 +527,41 @@ class ServeFleet:
         out["engine"] = self.engine_summary()
         return out
 
-    def assert_compile_count(self, prefill: int = 1, decode: int = 1, *,
+    def assert_compile_count(self, prefill: Optional[int] = None,
+                             decode: int = 1, *,
                              include_idle: bool = False) -> None:
-        """The fleet-wide one-prefill+one-decode promise, routed
-        through analysis.assert_compile_count: every replica engine
+        """The fleet-wide bounded-compile promise: every replica engine
         that served at least one request must have compiled EXACTLY
-        ``prefill``/``decode`` programs. Engines that never admitted
-        work (0 compiles — e.g. a just-restarted probe that got no
-        traffic) are skipped unless ``include_idle``."""
+        ``decode`` decode programs, at least one prefill program, no
+        more than one per bucket, and no more than ``prefill`` in
+        total (default: that replica's own bucket count). An UPPER
+        bound, not an exact total — the router legitimately sends
+        different tail-length mixes to different replicas, so replicas
+        compile different bucket subsets. The decode sentinels are
+        routed through analysis.assert_compile_count for its
+        signature-diffing error. Engines that never admitted work
+        (0 compiles — e.g. a just-restarted probe that got no traffic)
+        are skipped unless ``include_idle``."""
+        from quintnet_tpu.analysis.recompile import RecompileError
+
         expected: Dict[str, int] = {}
         sentinels: Dict = {}
         for rep in self._replicas:
             if not include_idle and rep.engine.metrics.admitted == 0:
                 continue
-            for kind, sentinel in rep.engine.compile_sentinels().items():
-                key = f"{rep.name}_{kind}"
-                expected[key] = prefill if kind == "prefill" else decode
-                sentinels[key] = sentinel
+            rep_sentinels = rep.engine.compile_sentinels()
+            key = f"{rep.name}_decode"
+            expected[key] = decode
+            sentinels[key] = rep_sentinels["decode"]
+            per_bucket = {kind: s.compile_count
+                          for kind, s in rep_sentinels.items()
+                          if kind != "decode"}
+            total = sum(per_bucket.values())
+            cap = prefill if prefill is not None else len(per_bucket)
+            if not 1 <= total <= cap or any(n > 1
+                                            for n in per_bucket.values()):
+                raise RecompileError(
+                    f"replica {rep.name}: expected 1..{cap} compiled "
+                    f"prefill bucket program(s) (at most one per "
+                    f"bucket), observed {total} ({per_bucket})")
         _assert_cc(expected, **sentinels)

@@ -234,6 +234,78 @@ def paged_gather(cache, block_tables, *, block_size: int):
     return pages.transpose(0, 3, 1, 2, 4).reshape(b, h, m * bs, dh)
 
 
+def paged_prefill_update(k_cache, v_cache, k, v, positions, tail_len, *,
+                         block_tables, block_size: int):
+    """Write one request's TAIL of (k, v) projections into the paged
+    pool. ``k``/``v``: [H, P, Dh] (P = padded tail bucket);
+    ``positions``: [P] absolute token positions (``start + arange(P)``
+    — the chunked-prefill offset); ``block_tables``: [M] this request's
+    table row. Rows at or beyond ``tail_len`` (pad columns, plus any
+    position past the table) scatter into the null block — memory
+    nobody reads, the same convention as :func:`paged_cache_update`."""
+    P = positions.shape[0]
+    blk_idx = jnp.clip(positions // block_size, 0,
+                       block_tables.shape[0] - 1)
+    idx = jnp.where(jnp.arange(P) < tail_len,
+                    block_tables[blk_idx] * block_size
+                    + positions % block_size, 0)
+    kin = k.transpose(1, 0, 2).astype(k_cache.dtype)   # [P, H, Dh]
+    vin = v.transpose(1, 0, 2).astype(v_cache.dtype)
+    return k_cache.at[idx].set(kin), v_cache.at[idx].set(vin)
+
+
+def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
+                      num_heads: int, tp_axis: Optional[str] = None,
+                      block_tables=None, block_size: Optional[int] = None):
+    """Chunked prefill over the paged pool: attention for ONE request's
+    uncached tail, reading the cached prefix from pool blocks.
+
+    ``x``: [1, P, D] tail hidden states (positions ``start ..
+    start + P``); the tail's (k, v) are scattered through the block
+    table first (:func:`paged_prefill_update`), then the WHOLE row —
+    cached prefix + fresh tail — is gathered back position-ordered
+    (:func:`paged_gather`) and each tail query attends causally against
+    it: column t is valid iff ``t <= positions[i]``. With ``start == 0``
+    this is ordinary causal prefill expressed on the paged layout
+    (the serve engine's single prefill family — cache-off and cache-on
+    run the SAME program, only ``start`` differs), and the math on the
+    gathered view matches :func:`mha_decode`'s paged path exactly.
+
+    Returns (y [1, P, D], k_cache, v_cache). ``num_heads`` is LOCAL
+    heads under ``tp_axis`` (head-sharded pool + RowParallel psum, same
+    as the decode path)."""
+    qkv = linear_apply(p["qkv"], x)  # [1, P, 3*D_local]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
+    k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
+    v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
+    k_cache, v_cache = paged_prefill_update(
+        k_cache, v_cache, k[0], v[0], positions, tail_len,
+        block_tables=block_tables, block_size=block_size)
+    k_all = paged_gather(k_cache, block_tables[None],
+                         block_size=block_size)   # [1, H, M*bs, Dh]
+    v_all = paged_gather(v_cache, block_tables[None],
+                         block_size=block_size)
+    valid = (jnp.arange(k_all.shape[2])[None, :]
+             <= positions[:, None])               # [P, M*bs]
+
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_all).astype(jnp.float32)
+    scores = scores / math.sqrt(dh)
+    scores = jnp.where(valid[None, None], scores,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
+
+    o = rearrange(o, "b h s d -> b s (h d)")
+    y = jnp.dot(o, p["proj"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    if "b" in p["proj"]:
+        y = y + p["proj"]["b"]
+    return y, k_cache, v_cache
+
+
 def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                tp_axis: Optional[str] = None,
                block_tables=None, block_size: Optional[int] = None):

@@ -19,7 +19,8 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from quintnet_tpu.nn.attention import mha_apply, mha_decode, mha_init
+from quintnet_tpu.nn.attention import (mha_apply, mha_decode, mha_init,
+                                       mha_prefill_paged)
 from quintnet_tpu.nn.layers import (
     gelu,
     layer_norm_apply,
@@ -249,6 +250,25 @@ def block_prefill(p, x, *, num_heads: int, act: Callable = gelu,
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
                       tp_axis=tp_axis), (k, v)
+
+
+def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
+                        num_heads: int, act: Callable = gelu,
+                        moe_args: Optional[MoEArgs] = None,
+                        tp_axis: Optional[str] = None,
+                        block_tables=None,
+                        block_size: Optional[int] = None):
+    """Chunked-prefill block step over the paged pool (nn/attention.py
+    mha_prefill_paged): x [1, P, D] tail hidden states at absolute
+    ``positions``, caches are flat pool views — the serve engine's
+    prefix-cached prefill path. Returns (x, k_cache, v_cache)."""
+    a, k_cache, v_cache = mha_prefill_paged(
+        p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
+        positions, tail_len, num_heads=num_heads, tp_axis=tp_axis,
+        block_tables=block_tables, block_size=block_size)
+    x = x + a
+    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                      tp_axis=tp_axis), k_cache, v_cache
 
 
 def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,

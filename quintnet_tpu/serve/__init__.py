@@ -8,15 +8,21 @@ TP-sharded prefill/decode kernels into an engine that sustains many
 concurrent, variably-sized requests (Orca-style iteration-level
 scheduling; vLLM-style paged KV blocks):
 
-- :mod:`kv_pool` — fixed-size KV blocks per layer, free-list allocator,
-  per-request block tables (no per-batch T_max padding);
-- :mod:`scheduler` — waiting queue, admission by free-block budget,
+- :mod:`kv_pool` — fixed-size KV blocks per layer, refcounted
+  acquire/release, per-request block tables (no per-batch T_max
+  padding), and a PREFIX CACHE: a token-keyed block index (literal
+  prefix bytes, not a hash digest — collisions impossible) with LRU
+  retention of refcount-zero blocks and copy-on-write sharing, so
+  requests with a common prompt prefix (and preemption-resumes /
+  migrations) reuse resident KV instead of recomputing it;
+- :mod:`scheduler` — waiting queue, admission by UNCACHED-block budget,
   FCFS + optional priority, preemption-by-eviction of the youngest
   request when the pool is exhausted;
 - :mod:`engine` — the step loop: ONE jitted decode-step program over a
   static MAX_SLOTS batch (masked empty slots — no recompiles as
-  requests come and go), prefill for newly admitted requests, EOS /
-  max-len retirement;
+  requests come and go), bucketed chunked prefill for newly admitted
+  requests (powers-of-two padded lengths, at most one compiled program
+  per bucket), EOS / max-len retirement;
 - :mod:`families` — the GPT-2 / Llama model adapters (thin reuse of
   nn/attention.mha_decode's paged path and the generate modules'
   embed/logits helpers);
@@ -30,11 +36,12 @@ engine and emits a one-line JSON throughput/latency report.
 from quintnet_tpu.serve.api import generate, generate_stream
 from quintnet_tpu.serve.engine import ServeEngine
 from quintnet_tpu.serve.families import gpt2_family, llama_family
-from quintnet_tpu.serve.kv_pool import KVPool
+from quintnet_tpu.serve.kv_pool import AdmitPlan, KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
 from quintnet_tpu.serve.scheduler import Request, RequestProgress, Scheduler
 
 __all__ = [
+    "AdmitPlan",
     "KVPool",
     "Request",
     "RequestProgress",

@@ -1,19 +1,26 @@
 """Model-family adapters for the serving engine.
 
 One tiny record per family (GPT-2, Llama) giving the engine a uniform
-(prefill, paged-decode, partition-specs) surface. Nothing here forks
-model math: prefill scans the SAME nn/transformer.block_prefill /
-models/llama.llama_block_prefill bodies the batch decoders use, paged
-decode scans block_decode / llama_block_decode with ``block_tables``
-(the nn/attention.mha_decode paged path), and embedding/logits reuse
-the generate modules' vocab-parallel-aware helpers — a fix in any of
-those fixes serving too.
+(chunked-prefill, paged-decode, partition-specs) surface. Nothing here
+forks model math: prefill scans the paged block bodies
+(nn/transformer.block_prefill_paged / models/llama.llama_block_prefill_paged
+— the same attention math as the decode path, batched over the tail),
+paged decode scans block_decode / llama_block_decode with
+``block_tables`` (the nn/attention.mha_decode paged path), and
+embedding/logits reuse the generate modules' vocab-parallel-aware
+helpers — a fix in any of those fixes serving too.
 
-Prefill contract: ``prefill(params, ids [1, P], t0, tp_axis) ->
-(logits [1, V] at position t0-1, (ks, vs) each [L, 1, H_kv(/tp), P, Dh])``
-— ids are right-padded to the engine's static P; causality makes the
-pad columns inert, and the returned logits are read at the DYNAMIC
-index t0-1, so one compiled prefill serves every prompt length.
+Prefill contract (chunked, prefix-cache aware): ``prefill_from(params,
+k_pool, v_pool, ids [1, P], start, t0, table_row [M], block_size,
+tp_axis) -> (logits [1, V] at position t0-1, k_pool, v_pool)`` — ids
+hold the UNCACHED TAIL ``tokens[start:t0]`` right-padded to the
+engine's static bucket width P; positions ``[0, start)`` are already
+resident in the pool blocks the table references (a prefix-cache hit,
+or nothing when ``start == 0`` — cache-off and cache-on run the same
+program). The tail's KV is scattered through the table, attention runs
+against the gathered whole row, and the returned logits are read at
+the DYNAMIC index ``t0 - 1 - start``, so one compiled program per
+bucket width serves every (start, t0) split.
 
 Decode contract: ``decode(params, k_pool, v_pool, tok [S], pos [S],
 tables [S, M], block_size, tp_axis) -> (logits [S, V], k_pool, v_pool)``
@@ -38,7 +45,8 @@ class Family:
     n_kv_heads: int          # GLOBAL kv heads (pool head dim)
     head_dim: int
     max_positions: int
-    prefill: Callable        # (params, ids, t0, tp_axis) -> (logits, (ks, vs))
+    prefill_from: Callable   # (params, kp, vp, ids, start, t0, row, bs,
+    #                           tp_axis) -> (logits, kp, vp)
     decode: Callable         # (params, kp, vp, tok, pos, tables, bs, tp_axis)
     partition_specs: Callable  # (tp_axis) -> param pytree specs
     kv_dtype: Any = jnp.float32
@@ -53,22 +61,33 @@ def gpt2_family(cfg) -> Family:
     from quintnet_tpu.models.gpt2_generate import (_embed_tok, _local_heads,
                                                    _logits)
     from quintnet_tpu.nn.layers import gelu
-    from quintnet_tpu.nn.transformer import block_decode, block_prefill
+    from quintnet_tpu.nn.transformer import block_decode, block_prefill_paged
 
-    def prefill(params, ids, t0, tp_axis=None):
+    def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
+                     block_size, tp_axis=None):
         B, P = ids.shape
         emb = params["embedding"]
-        h = _embed_tok(emb, ids, cfg, tp_axis) + emb["wpe"][None, :P, :]
+        positions = start + jnp.arange(P, dtype=jnp.int32)
+        # pad rows may sit past n_positions; clip their (ignored) wpe read
+        safe_pos = jnp.clip(positions, 0, emb["wpe"].shape[0] - 1)
+        h = (_embed_tok(emb, ids, cfg, tp_axis)
+             + jnp.take(emb["wpe"], safe_pos, axis=0)[None])
         heads = _local_heads(cfg, tp_axis)
+        tail_len = t0 - start
 
-        def body(x, blk):
-            x, (k, v) = block_prefill(blk, x, num_heads=heads, act=gelu,
-                                      moe_args=cfg.moe_args, tp_axis=tp_axis)
-            return x, (k, v)
+        def body(x, layer):
+            blk, kc, vc = layer
+            x, kc, vc = block_prefill_paged(
+                blk, x, kc, vc, positions, tail_len, num_heads=heads,
+                act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
+                block_tables=table_row, block_size=block_size)
+            return x, (kc, vc)
 
-        h, (ks, vs) = lax.scan(body, h, params["blocks"])
-        h_last = lax.dynamic_slice_in_dim(h, t0 - 1, 1, axis=1)
-        return _logits(params, h_last, cfg, tp_axis)[:, 0, :], (ks, vs)
+        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
+                                                 k_pool, v_pool))
+        h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
+        return (_logits(params, h_last, cfg, tp_axis)[:, 0, :],
+                k_pool, v_pool)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
                tp_axis=None):
@@ -92,7 +111,7 @@ def gpt2_family(cfg) -> Family:
     return Family(
         name="gpt2", cfg=cfg, n_layers=cfg.n_layer, n_kv_heads=cfg.n_head,
         head_dim=cfg.n_embd // cfg.n_head, max_positions=cfg.n_positions,
-        prefill=prefill, decode=decode,
+        prefill_from=prefill_from, decode=decode,
         partition_specs=lambda tp_axis: gpt2_partition_specs(
             cfg, tp_axis=tp_axis),
     )
@@ -104,24 +123,32 @@ def gpt2_family(cfg) -> Family:
 
 def llama_family(cfg) -> Family:
     from quintnet_tpu.models.llama import (llama_block_decode,
-                                           llama_block_prefill,
+                                           llama_block_prefill_paged,
                                            llama_partition_specs,
                                            llama_rope_tables)
     from quintnet_tpu.models.llama_generate import _embed, _full_logits
 
-    def prefill(params, ids, t0, tp_axis=None):
+    def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
+                     block_size, tp_axis=None):
         B, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)
-        cos, sin = llama_rope_tables(jnp.arange(P), cfg)
+        positions = start + jnp.arange(P, dtype=jnp.int32)
+        cos, sin = llama_rope_tables(positions, cfg)      # [P, hd]
+        tail_len = t0 - start
 
-        def body(x, blk):
-            x, kv = llama_block_prefill(blk, x, cfg, cos, sin,
-                                        tp_axis=tp_axis)
-            return x, kv
+        def body(x, layer):
+            blk, kc, vc = layer
+            x, (kc, vc) = llama_block_prefill_paged(
+                blk, x, kc, vc, positions, tail_len, cfg, cos, sin,
+                tp_axis=tp_axis, block_tables=table_row,
+                block_size=block_size)
+            return x, (kc, vc)
 
-        h, (ks, vs) = lax.scan(body, h, params["blocks"])
-        h_last = lax.dynamic_slice_in_dim(h, t0 - 1, 1, axis=1)
-        return _full_logits(params, h_last, cfg, tp_axis)[:, 0, :], (ks, vs)
+        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
+                                                 k_pool, v_pool))
+        h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
+        return (_full_logits(params, h_last, cfg, tp_axis)[:, 0, :],
+                k_pool, v_pool)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
                tp_axis=None):
@@ -145,7 +172,7 @@ def llama_family(cfg) -> Family:
         name="llama", cfg=cfg, n_layers=cfg.n_layers,
         n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
         max_positions=cfg.n_positions,
-        prefill=prefill, decode=decode,
+        prefill_from=prefill_from, decode=decode,
         partition_specs=lambda tp_axis: llama_partition_specs(
             cfg, tp_axis=tp_axis),
     )

@@ -1,4 +1,4 @@
-"""Paged KV-cache pool: fixed-size blocks + free-list allocator.
+"""Paged KV-cache pool: refcounted blocks + prefix cache + free list.
 
 The dense decoders allocate [L, B, H, T_max, Dh] per batch — every
 request pays for the longest possible sequence. Here KV memory is a
@@ -19,31 +19,90 @@ slots point their table rows (and positions) at it, so masked rows'
 scatters land in memory nobody reads and the decode step needs no
 dynamic shapes. The allocator therefore hands out blocks [1, num_blocks).
 
-Allocation is host-side bookkeeping (a free list of ints) — the device
-arrays never reshape; "allocating" a block just means an engine slot's
-block table starts referencing it.
+Allocation is host-side bookkeeping — the device arrays never reshape;
+"allocating" a block just means an engine slot's block table starts
+referencing it.
+
+Prefix caching (the PagedAttention sharing model + SGLang-style prefix
+reuse, block-granular):
+
+- every block carries a **refcount** — the number of live block tables
+  (plus transient admission pins) referencing it; ``acquire``/``release``
+  replace grow-only alloc/free with share-aware accounting;
+- a **prefix index** maps ``token_ids[:n].tobytes()`` -> the pool block
+  holding positions ``[n - fill, n)`` of that exact token chain. Full
+  blocks are keyed at block boundaries (``n = (j+1) * block_size``); a
+  final partially-filled block is keyed at its exact token count. The
+  full-token key (not a hash) makes collisions impossible — a wrong
+  match would silently corrupt the golden token-parity contract;
+- on retire/preempt the engine **publishes** a request's blocks into
+  the index instead of freeing them; a published block whose refcount
+  drops to zero is RETAINED in an LRU set rather than pushed onto the
+  free list. Allocation consumes the LIFO free list first (warm pages)
+  and only then **evicts** the least-recently-touched cached block —
+  cached-but-unreferenced memory is free memory that happens to still
+  be useful;
+- a later request with the same token prefix re-acquires the cached
+  chain (refcount back up, table entries cloned) and prefills only the
+  uncached tail. When the reusable chain ends inside a partially-filled
+  block, the engine **copies-on-write**: the cached block's filled
+  slots are copied into a private block before the new request writes
+  its own (diverging) continuation — the cached copy is immutable while
+  the index references it.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
 
 import jax.numpy as jnp
 
 NULL_BLOCK = 0
 
 
+@dataclass
+class AdmitPlan:
+    """Host-side admission plan for one request's token sequence.
+
+    ``cached_tokens`` positions are served from the prefix index:
+    ``shared_blocks`` are re-referenced whole (read-only, one refcount
+    each), and — when the chain ends inside a partially-filled block —
+    ``cow_src`` names the cached block whose first ``cow_len`` slots
+    must be copied into the request's first private block before its
+    tail is written (copy-on-write). ``n_new_blocks`` private blocks
+    complete the table."""
+
+    cached_tokens: int                  # prefill starts at this offset
+    shared_blocks: List[int] = field(default_factory=list)
+    cow_src: Optional[int] = None
+    cow_len: int = 0
+    n_new_blocks: int = 0
+
+    @property
+    def pinned_blocks(self) -> List[int]:
+        """Blocks that must be refcount-pinned before any allocation
+        (allocation may evict refcount-zero cached blocks — including,
+        without the pin, the very chain this plan reuses)."""
+        return self.shared_blocks + (
+            [self.cow_src] if self.cow_src is not None else [])
+
+
 class KVPool:
-    """Free-list allocator over paged per-layer KV storage.
+    """Refcounted block allocator + prefix cache over paged KV storage.
 
     ``n_kv_heads`` is the GLOBAL kv-head count; pass ``sharding`` (a
     ``jax.sharding.NamedSharding`` with the head dim on the tp axis) to
-    lay the pool out head-sharded for a TP engine.
+    lay the pool out head-sharded for a TP engine. ``prefix_cache=False``
+    disables the index entirely (lookup misses, publish is a no-op,
+    release always frees) — the A/B switch tools/serve_bench.py flips.
     """
 
     def __init__(self, *, n_layers: int, n_kv_heads: int, head_dim: int,
                  block_size: int, num_blocks: int, dtype=jnp.float32,
-                 sharding=None):
+                 sharding=None, prefix_cache: bool = True):
         if block_size < 1 or num_blocks < 2:
             raise ValueError(
                 f"need block_size >= 1 and num_blocks >= 2 (block 0 is "
@@ -53,6 +112,7 @@ class KVPool:
         self.head_dim = head_dim
         self.block_size = block_size
         self.num_blocks = num_blocks
+        self.prefix_cache = bool(prefix_cache)
         shape = (n_layers, num_blocks * block_size, n_kv_heads, head_dim)
         k = jnp.zeros(shape, dtype)
         v = jnp.zeros(shape, dtype)
@@ -63,8 +123,24 @@ class KVPool:
             v = jax.device_put(v, sharding)
         self.k = k
         self.v = v
-        # LIFO free list: reuse recently-freed blocks first (warm pages)
+        # LIFO free list: reuse recently-freed blocks first (warm pages).
+        # The membership set keeps release's double-free check O(1)
+        # instead of an O(free-list) scan per block.
         self._free: List[int] = list(range(num_blocks - 1, NULL_BLOCK, -1))
+        self._free_set: Set[int] = set(self._free)
+        self._ref: List[int] = [0] * num_blocks
+        # prefix index: token-prefix bytes -> block id (and its inverse)
+        self._index: Dict[bytes, int] = {}
+        self._block_key: Dict[int, bytes] = {}
+        self._block_fill: Dict[int, int] = {}     # published slots used
+        # refcount-zero published blocks, retained for reuse until the
+        # free list runs dry; evicted least-recently-touched first
+        self._cached_free: Set[int] = set()
+        self._lru: Dict[int, int] = {}
+        self._touch_counter = 0
+        # eviction counter (hit accounting lives in ServeMetrics,
+        # which sees per-admission cached-token counts)
+        self.cache_evictions = 0
 
     # ---- accounting -------------------------------------------------
     @property
@@ -74,11 +150,24 @@ class KVPool:
 
     @property
     def num_free(self) -> int:
+        """Truly free blocks (not referenced, not cached)."""
         return len(self._free)
 
     @property
+    def num_cached(self) -> int:
+        """Refcount-zero blocks retained by the prefix index —
+        reusable as cache hits, evictable on demand."""
+        return len(self._cached_free)
+
+    @property
+    def num_available(self) -> int:
+        """Blocks an acquire can produce: free + evictable cached."""
+        return len(self._free) + len(self._cached_free)
+
+    @property
     def num_used(self) -> int:
-        return self.usable_blocks - self.num_free
+        """Blocks referenced by at least one live block table."""
+        return self.usable_blocks - self.num_free - self.num_cached
 
     @property
     def utilization(self) -> float:
@@ -88,26 +177,215 @@ class KVPool:
         """Blocks needed to hold ``n_tokens`` token slots."""
         return -(-n_tokens // self.block_size)
 
-    def can_alloc(self, n: int) -> bool:
-        return n <= self.num_free
+    def can_acquire(self, n: int) -> bool:
+        return n <= self.num_available
 
-    # ---- alloc/free -------------------------------------------------
-    def alloc(self, n: int) -> Optional[List[int]]:
-        """Pop ``n`` blocks off the free list, or None (caller decides
-        whether to wait or preempt — the pool never partially
-        allocates)."""
-        if n > len(self._free):
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+    def is_cached(self, block: int) -> bool:
+        """Is the block referenced by the prefix index (published)?"""
+        return block in self._block_key
+
+    # ---- acquire / release ------------------------------------------
+    def _touch(self, b: int) -> None:
+        self._touch_counter += 1
+        self._lru[b] = self._touch_counter
+
+    def _evict_lru(self) -> int:
+        """Drop the least-recently-touched refcount-zero cached block
+        from the index and hand it back as a plain free block. Only
+        unreferenced blocks are candidates, so an evicted block is — by
+        construction — unreachable from every live block table."""
+        b = min(self._cached_free, key=self._lru.__getitem__)
+        self._cached_free.remove(b)
+        self._unpublish(b)
+        self.cache_evictions += 1
+        return b
+
+    def _unpublish(self, b: int) -> None:
+        key = self._block_key.pop(b, None)
+        if key is not None and self._index.get(key) == b:
+            del self._index[key]
+        self._block_fill.pop(b, None)
+        self._lru.pop(b, None)
+
+    def acquire(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` private blocks (refcount 1 each): pop the LIFO
+        free list first, then evict LRU cached blocks. Returns None if
+        even eviction cannot cover ``n`` (caller decides whether to
+        wait or preempt — the pool never partially allocates)."""
+        if n > self.num_available:
             return None
-        taken = [self._free.pop() for _ in range(n)]
+        taken: List[int] = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+                self._free_set.remove(b)
+            else:
+                b = self._evict_lru()
+            self._ref[b] = 1
+            taken.append(b)
         return taken
 
-    def free(self, blocks: List[int]) -> None:
+    def acquire_cached(self, blocks: Sequence[int]) -> None:
+        """Pin cached/shared blocks for one more holder (a cache hit:
+        the admitting request's table will reference them, or a
+        transient COW-source pin for the duration of one prefill).
+        Refcount-zero blocks leave the evictable retention set."""
+        for b in blocks:
+            if self._ref[b] == 0:
+                if b not in self._cached_free:
+                    raise ValueError(
+                        f"block {b} is neither referenced nor cached — "
+                        f"cannot acquire it as a prefix hit")
+                self._cached_free.remove(b)
+            self._ref[b] += 1
+            self._touch(b)
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per listed block. A block reaching
+        refcount zero returns to the free list — unless it is published
+        in the prefix index, in which case it is RETAINED (evictable,
+        LRU) for future prefix hits. O(1) per block."""
+        need: Dict[int, int] = {}
         for b in blocks:
             if not (NULL_BLOCK < b < self.num_blocks):
-                raise ValueError(f"freeing invalid block id {b}")
-            if b in self._free:
+                raise ValueError(f"releasing invalid block id {b}")
+            need[b] = need.get(b, 0) + 1
+            if b in self._free_set or need[b] > self._ref[b]:
                 raise ValueError(f"double free of block {b}")
-        self._free.extend(blocks)
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                if b in self._block_key:
+                    self._cached_free.add(b)
+                else:
+                    self._free.append(b)
+                    self._free_set.add(b)
+
+    # legacy names (PR 1 surface): plain allocation without sharing
+    def alloc(self, n: int) -> Optional[List[int]]:
+        return self.acquire(n)
+
+    def free(self, blocks: Sequence[int]) -> None:
+        self.release(blocks)
+
+    def can_alloc(self, n: int) -> bool:
+        return self.can_acquire(n)
+
+    # ---- prefix index -----------------------------------------------
+    @staticmethod
+    def _key(tokens: np.ndarray, n: int) -> bytes:
+        return np.ascontiguousarray(tokens[:n], dtype=np.int32).tobytes()
+
+    def lookup(self, tokens, max_tokens: Optional[int] = None) -> AdmitPlan:
+        """Longest cached block-chain for ``tokens``: full blocks are
+        matched at block boundaries, then the longest published partial
+        leaf extending the chain. The match is capped at
+        ``max_tokens`` (callers pass ``len(tokens) - 1`` so at least
+        one token is always prefilled — prefill must produce the
+        next-token logits). Read-only; returns a plan with
+        ``n_new_blocks`` unset."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        limit = len(tokens) if max_tokens is None else min(
+            int(max_tokens), len(tokens))
+        if not self.prefix_cache or limit <= 0:
+            return AdmitPlan(cached_tokens=0)
+        bs = self.block_size
+        full: List[int] = []
+        while (len(full) + 1) * bs <= limit:
+            b = self._index.get(self._key(tokens, (len(full) + 1) * bs))
+            if b is None:
+                break
+            full.append(b)
+        m = len(full) * bs
+        cow_src, cow_len = None, 0
+        for f in range(min(bs - 1, limit - m), 0, -1):
+            b = self._index.get(self._key(tokens, m + f))
+            if b is not None:
+                cow_src, cow_len = b, f
+                break
+        return AdmitPlan(cached_tokens=m + cow_len, shared_blocks=full,
+                         cow_src=cow_src, cow_len=cow_len)
+
+    def plan_admission(self, tokens, total_tokens: int) -> AdmitPlan:
+        """Best ADMISSIBLE plan for a request whose table must cover
+        ``total_tokens`` slots (prefill length + the first decode
+        write): the longest cached chain plus the private blocks that
+        complete the table. Only ``n_new_blocks`` must come from the
+        allocator — the admission budget counts uncached blocks only.
+
+        A maximal chain is not always admissible: pinning it removes
+        its blocks from the evictable set, and the transient COW pin
+        occupies one more block than the table itself, so near the
+        capacity edge the longest-hit plan can need more simultaneous
+        blocks than the pool holds — FOREVER, since nothing else would
+        ever evict the pinned chain. Rather than wedge the queue head
+        (and everything behind it), degrade: drop the COW hit first,
+        then fall back to a cache-cold plan, which is admissible
+        whenever the request can run at all (submit-time fail-fast
+        checked ``blocks_for(total) <= usable_blocks``)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n_total = self.blocks_for(int(total_tokens))
+        plan = self.lookup(tokens, max_tokens=len(tokens) - 1)
+        plan.n_new_blocks = n_total - len(plan.shared_blocks)
+        if self.can_admit(plan) or not plan.pinned_blocks:
+            return plan
+        if plan.cow_src is not None:
+            plan = AdmitPlan(
+                cached_tokens=len(plan.shared_blocks) * self.block_size,
+                shared_blocks=plan.shared_blocks,
+                n_new_blocks=plan.n_new_blocks)
+            if self.can_admit(plan):
+                return plan
+        return AdmitPlan(cached_tokens=0, n_new_blocks=n_total)
+
+    def can_admit(self, plan: AdmitPlan) -> bool:
+        """Can ``plan.n_new_blocks`` be acquired once the plan's own
+        chain is pinned? Pinned blocks stop being eviction candidates,
+        so they must not be counted as available."""
+        pinned_evictable = sum(1 for b in plan.pinned_blocks
+                               if b in self._cached_free)
+        return plan.n_new_blocks <= self.num_available - pinned_evictable
+
+    def publish(self, tokens, blocks: Sequence[int], n_tokens: int) -> None:
+        """Index ``blocks`` as the cached chain for
+        ``tokens[:n_tokens]`` (the retire/preempt path — instead of
+        freeing, make the request's KV findable). Full blocks are keyed
+        at block boundaries; a trailing partial block at its exact
+        count. A key already mapping to a DIFFERENT block (an identical
+        request published first) keeps the incumbent — the duplicate
+        stays unpublished and will return to the free list on release.
+        Publish BEFORE release: release retains published blocks."""
+        if not self.prefix_cache or n_tokens <= 0:
+            return
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n_tokens = min(int(n_tokens), len(tokens))
+        q, f = divmod(n_tokens, self.block_size)
+        for j in range(q):
+            self._publish_one(blocks[j], self._key(tokens, (j + 1)
+                                                   * self.block_size),
+                              self.block_size)
+        if f and q < len(blocks):
+            self._publish_one(blocks[q], self._key(tokens, n_tokens), f)
+
+    def _publish_one(self, b: int, key: bytes, fill: int) -> None:
+        cur = self._index.get(key)
+        if cur == b:
+            self._touch(b)
+            return
+        if cur is not None:
+            return  # identical content already cached under this key
+        if b in self._block_key:
+            # already indexed under another key (cannot happen through
+            # the engine: a block holds exactly one chain position) —
+            # keep the existing mapping rather than corrupt the index
+            return
+        self._index[key] = b
+        self._block_key[b] = key
+        self._block_fill[b] = fill
+        self._touch(b)
 
     # ---- device views ----------------------------------------------
     def caches(self):

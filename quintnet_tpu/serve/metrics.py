@@ -47,6 +47,11 @@ class ServeMetrics:
     finished: int = 0
     prefill_tokens: int = 0
     decode_tokens: int = 0
+    # prefix-cache ledger: hit tokens are prompt positions served from
+    # the cached block chain at admission — exactly the prefill tokens
+    # SAVED (they were never recomputed); prefill_tokens above counts
+    # only the uncached tail actually pushed through a prefill program
+    prefix_hit_tokens: int = 0
     peak_kv_utilization: float = 0.0
     peak_running: int = 0
 
@@ -59,7 +64,8 @@ class ServeMetrics:
     # ---- recording --------------------------------------------------
     def record_step(self, *, running: int, waiting: int,
                     kv_blocks_used: int, kv_blocks_total: int,
-                    prefill_tokens: int, decode_tokens: int) -> None:
+                    prefill_tokens: int, decode_tokens: int,
+                    prefix_hit_tokens: int = 0) -> None:
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
@@ -71,6 +77,7 @@ class ServeMetrics:
         self.kv_blocks_total = kv_blocks_total
         self.prefill_tokens += prefill_tokens
         self.decode_tokens += decode_tokens
+        self.prefix_hit_tokens += prefix_hit_tokens
         util = kv_blocks_used / max(kv_blocks_total, 1)
         self.peak_kv_utilization = max(self.peak_kv_utilization, util)
         self.peak_running = max(self.peak_running, running)
@@ -103,6 +110,20 @@ class ServeMetrics:
             return 0.0
         return max(self._t_end - self._t0, 0.0)
 
+    @property
+    def prefill_tokens_saved(self) -> int:
+        """Prefill tokens never computed because the prefix cache
+        already held them (== prefix_hit_tokens; the name states what
+        the number buys)."""
+        return self.prefix_hit_tokens
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of required prefill positions served from the
+        cache: hit / (hit + actually-prefilled)."""
+        denom = self.prefix_hit_tokens + self.prefill_tokens
+        return self.prefix_hit_tokens / denom if denom else 0.0
+
     def summary(self) -> Dict:
         """One JSON-able dict: throughput, TTFT/latency percentiles,
         peak pool pressure. tok/s counts GENERATED (decode + prefill-
@@ -118,6 +139,9 @@ class ServeMetrics:
             "preempted": self.preempted,
             "prefill_tokens": self.prefill_tokens,
             "decode_tokens": self.decode_tokens,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefix_hit_rate": round(self.prefix_hit_rate, 4),
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0
             else 0.0,
@@ -162,6 +186,8 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
     for m in all_metrics:
         ttfts.extend(m.ttfts)
         latencies.extend(m.latencies)
+    hit = sum(m.prefix_hit_tokens for m in all_metrics)
+    prefill = sum(m.prefill_tokens for m in all_metrics)
     return {
         "replicas": len(all_metrics),
         "steps": sum(m.steps for m in all_metrics),
@@ -169,8 +195,12 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "admitted": sum(m.admitted for m in all_metrics),
         "finished": sum(m.finished for m in all_metrics),
         "preempted": sum(m.preempted for m in all_metrics),
-        "prefill_tokens": sum(m.prefill_tokens for m in all_metrics),
+        "prefill_tokens": prefill,
         "decode_tokens": sum(m.decode_tokens for m in all_metrics),
+        "prefix_hit_tokens": hit,
+        "prefill_tokens_saved": hit,
+        "prefix_hit_rate": round(hit / (hit + prefill), 4)
+        if (hit + prefill) else 0.0,
         "wall_s": round(wall, 4),
         "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
         "ttft_s": _pcts(ttfts),

@@ -79,6 +79,11 @@ class Request:
     state: str = WAITING
     generated: List[int] = field(default_factory=list)
     key_data: Optional[np.ndarray] = None   # evolved PRNG key (resume)
+    # the admission plan the scheduler approved, consumed by
+    # ServeEngine._admit_one in the same step — computed once so the
+    # budget check and the allocation act on the SAME plan (and the
+    # O(prefix^2) key construction isn't paid twice per admission)
+    admit_plan: Optional[object] = None
     admit_seq: int = -1                     # last admission stamp
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
@@ -149,12 +154,21 @@ class Scheduler:
         self.waiting.sort(key=self._key)
 
     # ---- admission --------------------------------------------------
+    def admission_plan(self, req: Request):
+        """The pool's :class:`~quintnet_tpu.serve.kv_pool.AdmitPlan`
+        for this request: table coverage is its whole prefill (prompt +
+        any checkpointed generation) PLUS the first decode write slot,
+        so an admitted request can always take at least one step before
+        growth/preemption kicks in — but only the blocks NOT already
+        resident in the prefix cache count against the allocator."""
+        return self.pool.plan_admission(req.output_ids(),
+                                        req.total_len + 1)
+
     def blocks_to_admit(self, req: Request) -> int:
-        """Blocks a request needs at admission: its whole prefill
-        (prompt + any checkpointed generation) PLUS the first decode
-        write slot, so an admitted request can always take at least one
-        step before growth/preemption kicks in."""
-        return self.pool.blocks_for(req.total_len + 1)
+        """UNCACHED blocks a request needs at admission (the admission
+        budget — cached chain blocks are re-referenced, not
+        allocated)."""
+        return self.admission_plan(req).n_new_blocks
 
     def next_admission(self, free_slots: int) -> Optional[Request]:
         """Pop the best admissible waiting request, or None. Head-of-
@@ -163,12 +177,20 @@ class Scheduler:
         predictable latency ordering over maximal packing."""
         if free_slots <= 0 or not self.waiting:
             return None
+        # any plan needs >= 1 new block (the cached chain is capped at
+        # total_len - 1 tokens), so a fully-saturated pool cannot admit
+        # — skip rebuilding the O(prefix) admission plan every step
+        # while the head request waits for blocks to free up
+        if self.pool.num_available == 0:
+            return None
         head = self.waiting[0]
-        if not self.pool.can_alloc(self.blocks_to_admit(head)):
+        plan = self.admission_plan(head)
+        if not self.pool.can_admit(plan):
             return None
         self.waiting.pop(0)
         head.state = RUNNING
         head.admit_seq = next(self._admit_counter)
+        head.admit_plan = plan
         return head
 
     # ---- preemption -------------------------------------------------

@@ -240,12 +240,15 @@ class TestServe:
         kw.setdefault("max_seq_len", 32)
         return ServeEngine(gpt2_family(cfg), params, mesh=mesh, **kw)
 
-    def _prefill_args(self, eng, params):
-        ids = np.zeros((1, eng.prefill_len), np.int32)
+    def _prefill_args(self, eng, params, bucket):
+        # one bucket program's args: tail ids padded to the bucket
+        # width, dynamic (start, t0) split, COW scalars
+        ids = np.zeros((1, bucket), np.int32)
         row = np.zeros((eng.table_width,), np.int32)
         kp, vp = eng.pool.caches()
-        return (params, kp, vp, jnp.asarray(ids), jnp.int32(3),
-                jnp.asarray(row), jnp.asarray(eng._key_data[0]))
+        return (params, kp, vp, jnp.asarray(ids), jnp.int32(1),
+                jnp.int32(3), jnp.asarray(row), jnp.int32(0),
+                jnp.int32(0), jnp.asarray(eng._key_data[0]))
 
     def _decode_args(self, eng, params):
         kp, vp = eng.pool.caches()
@@ -256,29 +259,36 @@ class TestServe:
     def test_single_device_census_is_collective_free(self, gpt2):
         cfg, params = gpt2
         eng = self._engine(cfg, params)
-        for fn, args, spec in (
-                (eng._prefill.fn, self._prefill_args(eng, params),
-                 census_specs.expected_serve_prefill(cfg.n_layer)),
-                (eng._decode.fn, self._decode_args(eng, params),
-                 census_specs.expected_serve_decode(cfg.n_layer))):
+        cases = [(eng._prefills[b].fn,
+                  self._prefill_args(eng, params, b),
+                  census_specs.expected_serve_prefill(cfg.n_layer))
+                 for b in eng.prefill_buckets]
+        cases.append((eng._decode.fn, self._decode_args(eng, params),
+                      census_specs.expected_serve_decode(cfg.n_layer)))
+        for fn, args, spec in cases:
             census = collective_census(fn, *args)
             assert census.diff(spec) == [], census.as_dict()
             assert census.total() == 0
 
-    def test_tp_census_two_psums_per_layer(self, gpt2):
+    def test_tp_census_two_psums_per_layer_every_bucket(self, gpt2):
         """Head-sharded serving: exactly 2 row-parallel psums per block
         per program (attention out-proj + MLP down-proj), nothing else
-        — the engine's batching/paging adds NO collectives."""
+        — the engine's batching/paging/prefix-cache COW adds NO
+        collectives, and EVERY prefill bucket width carries the same
+        census (the bucket only changes a batch-like dim)."""
         cfg, params = gpt2
         mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
         eng = self._engine(cfg, params, mesh=mesh)
-        for fn, args, spec in (
-                (eng._prefill.fn, self._prefill_args(eng, params),
-                 census_specs.expected_serve_prefill(cfg.n_layer,
-                                                     tp_axis="tp")),
-                (eng._decode.fn, self._decode_args(eng, params),
-                 census_specs.expected_serve_decode(cfg.n_layer,
-                                                    tp_axis="tp"))):
+        assert len(eng.prefill_buckets) >= 2  # actually bucketed
+        cases = [(eng._prefills[b].fn,
+                  self._prefill_args(eng, params, b),
+                  census_specs.expected_serve_prefill(cfg.n_layer,
+                                                      tp_axis="tp"))
+                 for b in eng.prefill_buckets]
+        cases.append((eng._decode.fn, self._decode_args(eng, params),
+                      census_specs.expected_serve_decode(cfg.n_layer,
+                                                         tp_axis="tp")))
+        for fn, args, spec in cases:
             census = collective_census(fn, *args)
             assert census.diff(spec) == [], census.as_dict()
 
@@ -310,13 +320,15 @@ class TestServe:
         eng.assert_compile_count()  # raises with a diff on violation
 
     def test_donation_no_aliasable_misses(self, gpt2):
-        """Every aliasable buffer of both serve programs is donated
-        (pool caches, token rows, key state): peak memory is paid
-        once."""
+        """Every aliasable buffer of the serve programs is donated
+        (pool caches, token rows, key state) in every prefill bucket:
+        peak memory is paid once."""
         cfg, params = gpt2
         eng = self._engine(cfg, params)
-        for fn, args in ((eng._prefill.fn, self._prefill_args(eng, params)),
-                         (eng._decode.fn, self._decode_args(eng, params))):
+        cases = [(eng._prefills[b].fn, self._prefill_args(eng, params, b))
+                 for b in eng.prefill_buckets]
+        cases.append((eng._decode.fn, self._decode_args(eng, params)))
+        for fn, args in cases:
             rep = donation_report(fn, *args)
             assert rep.undonated_aliasable == [], rep.summary()
             assert rep.donated_bytes > 0

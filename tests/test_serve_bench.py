@@ -1,8 +1,13 @@
 """tools/serve_bench.py must never rot unexecuted: the fast suite runs
 the CLI end-to-end (CPU, tiny config, 3 steps) and checks the JSON
-contract, and the bench.py staleness scanner (test_bench_stale.py
-machinery) must surface the committed serve-bench artifact the same way
-it surfaces training-throughput records.
+contract — for the default Poisson trace AND the --prefix-share A/B
+mode — and the bench.py staleness scanner (test_bench_stale.py
+machinery) must surface the committed serve-bench artifacts the same
+way it surfaces training-throughput records. The committed
+artifacts/serve_r09.json additionally gates the PR's acceptance
+numbers: shared-prefix cache-on >= 1.5x cache-off (or an equivalent
+TTFT reduction) with a nonzero hit rate, and the cache-off path no
+worse than PR 1's serve_r06.json record.
 """
 
 import json
@@ -17,6 +22,8 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 SERVE_METRIC = "serve_gpt2_tiny_tokens_per_sec"
+PREFIX_METRIC = "serve_gpt2_tiny_prefix_share_tokens_per_sec"
+R09 = os.path.join(REPO, "artifacts", "serve_r09.json")
 
 
 @pytest.mark.fast
@@ -50,6 +57,73 @@ def test_committed_serve_artifact_surfaces_in_staleness_scan():
     assert last["value"] > 0
     assert last["source"].startswith("artifacts")
     assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_prefix_share_smoke_cli():
+    """`serve_bench.py --prefix-share` runs the cache-on/cache-off A/B
+    end-to-end on CPU (tiny trace, run to completion so retires happen
+    and the cache actually gets hit) and reports the comparison
+    fields."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--synthetic", "--prefix-share", "--requests", "6",
+         "--rate", "0.15", "--max-new", "4", "--shared-prefix", "24",
+         "--min-tail", "2", "--max-tail", "4"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == PREFIX_METRIC
+    assert rec["rc"] == 0
+    e = rec["extras"]
+    for k in ("cache_off_tokens_per_sec", "speedup_vs_cache_off",
+              "prefix_hit_rate", "prefill_tokens_saved",
+              "shared_prefix", "cache_off_ttft_p50_s"):
+        assert k in e, k
+    assert e["prefix_hit_rate"] > 0        # the cache actually served
+    assert e["prefill_tokens_saved"] > 0
+    assert e["finished"] == e["submitted"] == 6
+
+
+@pytest.mark.fast
+def test_committed_prefix_share_artifact_meets_acceptance():
+    """The committed serve_r09.json is the PR's acceptance evidence:
+    cache-on >= 1.5x cache-off tok/s on the shared-prefix trace (or an
+    equivalent TTFT reduction), nonzero hit rate, and the cache-off
+    plain-trace record no worse than PR 1's serve_r06.json."""
+    with open(R09) as f:
+        records = json.load(f)
+    by_metric = {r["metric"]: r for r in records}
+
+    share = by_metric[PREFIX_METRIC]
+    e = share["extras"]
+    assert e["prefix_hit_rate"] > 0
+    assert e["prefill_tokens_saved"] > 0
+    ttft_reduction = (e["cache_off_ttft_p50_s"] / e["ttft_p50_s"]
+                      if e["ttft_p50_s"] else 0.0)
+    assert (e["speedup_vs_cache_off"] >= 1.5
+            or ttft_reduction >= 1.5), (
+        f"prefix cache won neither throughput "
+        f"({e['speedup_vs_cache_off']}x) nor TTFT ({ttft_reduction}x)")
+
+    # cache-off baseline: the SAME plain synthetic trace as serve_r06,
+    # through the new engine with the cache disabled — the bucketed
+    # paged-prefill refactor must not regress the cache-off path
+    plain = by_metric[SERVE_METRIC]
+    assert plain["extras"]["prefix_cache"] is False
+    with open(os.path.join(REPO, "artifacts", "serve_r06.json")) as f:
+        r06 = [r for r in json.load(f) if r["metric"] == SERVE_METRIC]
+    assert plain["value"] >= max(r["value"] for r in r06)
+
+
+@pytest.mark.fast
+def test_prefix_share_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=PREFIX_METRIC)
+    assert last is not None
+    assert last["metric"] == PREFIX_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
 
 
 @pytest.mark.fast

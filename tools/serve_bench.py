@@ -1,5 +1,5 @@
-"""Serving benchmark: replay a synthetic Poisson request trace through
-the continuous-batching engine (quintnet_tpu/serve/) and report
+"""Serving benchmark: replay a synthetic request trace through the
+continuous-batching engine (quintnet_tpu/serve/) and report
 throughput + latency as ONE JSON line:
 
   {"metric": "serve_gpt2_tiny_tokens_per_sec", "value": N,
@@ -7,14 +7,25 @@ throughput + latency as ONE JSON line:
    "ttft_p95_s": .., "peak_kv_utilization": .., ...}}
 
 Arrivals are a Poisson process in ENGINE-STEP time (inter-arrival ~
-Exp(rate)), prompt lengths uniform in [min_prompt, max_prompt] — the
-mixed-length staggered workload the one-shot batch decoders
-(models/gpt2_generate.py) cannot serve without padding everything to
-the longest request.
+Exp(rate)). Two trace shapes:
+
+- default: prompt lengths uniform in [min_prompt, max_prompt] — the
+  mixed-length staggered workload the one-shot batch decoders
+  (models/gpt2_generate.py) cannot serve without padding everything to
+  the longest request;
+- ``--prefix-share``: N users x ONE shared system prompt
+  (``--shared-prefix`` tokens) + short unique tails — the
+  real-traffic shape (system prompts, few-shot templates) the prefix
+  cache exists for. This mode replays the SAME trace through a
+  cache-ON and a cache-OFF engine and reports both: the record's value
+  is cache-on tok/s, ``extras`` carries the cache-off numbers, the
+  speedup, and the hit rate.
 
 Modes:
   python tools/serve_bench.py --synthetic              # tiny cfg, CPU-ok
   python tools/serve_bench.py --synthetic --model llama
+  python tools/serve_bench.py --synthetic --prefix-share
+  python tools/serve_bench.py --synthetic --prefix-cache off   # A/B
   python tools/serve_bench.py --model gpt2             # 124M random init
   python tools/serve_bench.py --synthetic --steps 3    # smoke (CI runs
       this — tests/test_serve_bench.py — so the CLI can never rot)
@@ -36,34 +47,56 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine(args):
+def build_engine(args, *, prefix_cache: bool):
     import jax
 
     from quintnet_tpu.serve import ServeEngine, gpt2_family, llama_family
 
+    # synthetic-config overrides (--n-layer & co): the default tiny
+    # model is too small for prefill compute to matter — the
+    # prefix-share acceptance run uses a taller/wider synthetic config
+    # so the cached-vs-recomputed prefill difference is the signal
+    syn_kw = {k: v for k, v in (
+        ("n_layer", args.n_layer), ("n_embd", args.n_embd),
+        ("n_head", args.n_head), ("n_positions", args.n_positions),
+        ("vocab_size", args.vocab_size)) if v is not None}
     if args.model == "gpt2":
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
 
-        cfg = (GPT2Config.tiny(n_layer=2) if args.synthetic
-               else GPT2Config.base())
+        cfg = (GPT2Config.tiny(**{"n_layer": 2, **syn_kw})
+               if args.synthetic else GPT2Config.base())
         params = gpt2_init(jax.random.key(args.seed), cfg)
         family = gpt2_family(cfg)
     elif args.model == "llama":
         from quintnet_tpu.models.llama import LlamaConfig, llama_init
 
-        cfg = (LlamaConfig.tiny(n_layers=2) if args.synthetic
-               else LlamaConfig())
+        lkw = {{"n_layer": "n_layers", "n_embd": "dim",
+                "n_head": "n_heads", "n_positions": "n_positions",
+                "vocab_size": "vocab_size"}[k]: v
+               for k, v in syn_kw.items()}
+        cfg = (LlamaConfig.tiny(**{"n_layers": 2, **lkw})
+               if args.synthetic else LlamaConfig())
         params = llama_init(jax.random.key(args.seed), cfg)
         family = llama_family(cfg)
     else:
         raise SystemExit(f"unknown --model {args.model}")
 
-    max_seq = min(args.max_prompt + args.max_new, family.max_positions)
+    max_prompt = (args.shared_prefix + args.max_tail if args.prefix_share
+                  else args.max_prompt)
+    max_seq = min(max_prompt + args.max_new, family.max_positions)
     return ServeEngine(
         family, params, max_slots=args.slots, block_size=args.block_size,
         num_blocks=args.num_blocks, max_seq_len=max_seq,
         eos_token_id=args.eos, temperature=args.temperature,
-        policy=args.policy)
+        policy=args.policy, prefix_cache=prefix_cache)
+
+
+def poisson_arrivals(rng, n: int, rate: float):
+    t, out = 0.0, []
+    for _ in range(n):
+        t += rng.exponential(1.0 / rate)
+        out.append(int(t))
+    return out
 
 
 def poisson_trace(args, vocab_size: int):
@@ -71,33 +104,42 @@ def poisson_trace(args, vocab_size: int):
     import numpy as np
 
     rng = np.random.default_rng(args.seed)
-    t = 0.0
+    arrivals = poisson_arrivals(rng, args.requests, args.rate)
     trace = []
-    for _ in range(args.requests):
-        t += rng.exponential(1.0 / args.rate)
+    for t in arrivals:
         n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
         prompt = rng.integers(0, vocab_size, (n,)).astype(np.int32)
-        trace.append((int(t), prompt, args.max_new))
+        trace.append((t, prompt, args.max_new))
     return trace
 
 
-def run(args) -> dict:
-    import time
-
+def prefix_share_trace(args, vocab_size: int):
+    """N users x one shared system prompt + short unique tails."""
     import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    shared = rng.integers(0, vocab_size,
+                          (args.shared_prefix,)).astype(np.int32)
+    arrivals = poisson_arrivals(rng, args.requests, args.rate)
+    trace = []
+    for t in arrivals:
+        n = int(rng.integers(args.min_tail, args.max_tail + 1))
+        tail = rng.integers(0, vocab_size, (n,)).astype(np.int32)
+        trace.append((t, np.concatenate([shared, tail]), args.max_new))
+    return trace
+
+
+def replay(engine, trace, args) -> dict:
+    """Warm up (compile EVERY prefill bucket + the decode step OUTSIDE
+    the timed window — engine.warmup() invokes each program against
+    the null block directly, so no bucket can be missed), reset the
+    ledgers, replay the trace, return the summary with a
+    device-drained wall clock."""
+    import time
 
     import jax
 
-    engine = build_engine(args)
-    vocab = engine.family.cfg.vocab_size
-    trace = poisson_trace(args, vocab)
-
-    # warmup: compile both programs (one full request lifecycle =
-    # prefill + decode + retire) OUTSIDE the timed window, then reset
-    # the metrics so the replay starts from a clean ledger — tok/s
-    # must measure serving, not XLA compile time
-    engine.submit(np.ones((args.min_prompt,), "int32"), 2)
-    engine.run()
+    engine.warmup()
     engine.metrics = type(engine.metrics)(clock=engine.clock)
 
     submitted = 0
@@ -123,35 +165,87 @@ def run(args) -> dict:
     s["wall_s"] = round(wall, 4)
     s["tokens_per_sec"] = (round(s["gen_tokens"] / wall, 2) if wall > 0
                            else 0.0)
+    s["submitted"] = submitted
+    return s
+
+
+def _common_extras(args, s: dict) -> dict:
+    return {
+        "ttft_p50_s": s["ttft_s"]["p50"],
+        "ttft_p95_s": s["ttft_s"]["p95"],
+        "latency_p50_s": s["latency_s"]["p50"],
+        "latency_p95_s": s["latency_s"]["p95"],
+        "peak_kv_utilization": s["peak_kv_utilization"],
+        "peak_running": s["peak_running"],
+        "steps": s["steps"],
+        "requests": args.requests,
+        "submitted": s["submitted"],
+        "finished": s["finished"],
+        "preempted": s["preempted"],
+        "decode_tokens": s["decode_tokens"],
+        "prefill_tokens": s["prefill_tokens"],
+        "prefix_hit_tokens": s["prefix_hit_tokens"],
+        "prefill_tokens_saved": s["prefill_tokens_saved"],
+        "prefix_hit_rate": s["prefix_hit_rate"],
+        "wall_s": s["wall_s"],
+        "model": args.model,
+        "synthetic": bool(args.synthetic),
+        "slots": args.slots,
+        "block_size": args.block_size,
+        "num_blocks": args.num_blocks,
+        "rate": args.rate,
+    }
+
+
+def run(args) -> dict:
     tag = "tiny" if args.synthetic else "full"
+
+    if args.prefix_share:
+        # A/B over the SAME shared-prefix trace: cache-on vs cache-off
+        eng_on = build_engine(args, prefix_cache=True)
+        trace = prefix_share_trace(args, eng_on.family.cfg.vocab_size)
+        s_on = replay(eng_on, trace, args)
+        eng_off = build_engine(args, prefix_cache=False)
+        s_off = replay(eng_off, trace, args)
+        extras = _common_extras(args, s_on)
+        extras.update({
+            "prefix_share": True,
+            "shared_prefix": args.shared_prefix,
+            "min_tail": args.min_tail,
+            "max_tail": args.max_tail,
+            "cache_off_tokens_per_sec": s_off["tokens_per_sec"],
+            "cache_off_ttft_p50_s": s_off["ttft_s"]["p50"],
+            "cache_off_ttft_p95_s": s_off["ttft_s"]["p95"],
+            "cache_off_prefill_tokens": s_off["prefill_tokens"],
+            "cache_off_wall_s": s_off["wall_s"],
+            "speedup_vs_cache_off": (
+                round(s_on["tokens_per_sec"]
+                      / s_off["tokens_per_sec"], 3)
+                if s_off["tokens_per_sec"] else 0.0),
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_prefix_share_"
+                      "tokens_per_sec",
+            "value": s_on["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": extras["speedup_vs_cache_off"],
+            "rc": 0,
+            "extras": extras,
+        }
+
+    prefix_cache = args.prefix_cache == "on"
+    engine = build_engine(args, prefix_cache=prefix_cache)
+    trace = poisson_trace(args, engine.family.cfg.vocab_size)
+    s = replay(engine, trace, args)
+    extras = _common_extras(args, s)
+    extras["prefix_cache"] = prefix_cache
     return {
         "metric": f"serve_{args.model}_{tag}_tokens_per_sec",
         "value": s["tokens_per_sec"],
         "unit": "tok/s",
         "vs_baseline": 1.0,
         "rc": 0,
-        "extras": {
-            "ttft_p50_s": s["ttft_s"]["p50"],
-            "ttft_p95_s": s["ttft_s"]["p95"],
-            "latency_p50_s": s["latency_s"]["p50"],
-            "latency_p95_s": s["latency_s"]["p95"],
-            "peak_kv_utilization": s["peak_kv_utilization"],
-            "peak_running": s["peak_running"],
-            "steps": s["steps"],
-            "requests": args.requests,
-            "submitted": submitted,
-            "finished": s["finished"],
-            "preempted": s["preempted"],
-            "decode_tokens": s["decode_tokens"],
-            "prefill_tokens": s["prefill_tokens"],
-            "wall_s": s["wall_s"],
-            "model": args.model,
-            "synthetic": bool(args.synthetic),
-            "slots": args.slots,
-            "block_size": args.block_size,
-            "num_blocks": args.num_blocks,
-            "rate": args.rate,
-        },
+        "extras": extras,
     }
 
 
@@ -174,10 +268,35 @@ def main():
     ap.add_argument("--eos", type=int, default=None)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--policy", default="fcfs", choices=("fcfs", "priority"))
+    ap.add_argument("--prefix-cache", default="on", choices=("on", "off"),
+                    help="prefix-cache A/B switch for the default trace")
+    ap.add_argument("--prefix-share", action="store_true",
+                    help="shared-system-prompt trace, reported cache-on "
+                         "vs cache-off over the same trace")
+    ap.add_argument("--shared-prefix", type=int, default=None,
+                    help="shared system-prompt length (--prefix-share; "
+                         "default 36 for --synthetic, 96 for full "
+                         "configs — tiny models have few positions)")
+    ap.add_argument("--min-tail", type=int, default=4,
+                    help="min unique-tail length (--prefix-share)")
+    ap.add_argument("--max-tail", type=int, default=12,
+                    help="max unique-tail length (--prefix-share)")
+    ap.add_argument("--n-layer", type=int, default=None,
+                    help="synthetic-config depth override")
+    ap.add_argument("--n-embd", type=int, default=None,
+                    help="synthetic-config width override")
+    ap.add_argument("--n-head", type=int, default=None,
+                    help="synthetic-config head-count override")
+    ap.add_argument("--n-positions", type=int, default=None,
+                    help="synthetic-config max-positions override")
+    ap.add_argument("--vocab-size", type=int, default=None,
+                    help="synthetic-config vocab override")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
                     help="append the record to this artifacts JSON file")
     args = ap.parse_args()
+    if args.shared_prefix is None:
+        args.shared_prefix = 36 if args.synthetic else 96
 
     out = run(args)
     line = json.dumps(out)
