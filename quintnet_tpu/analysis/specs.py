@@ -218,3 +218,39 @@ def expected_serve_decode(n_layers: int, *,
     paging and sampling but NO collectives of its own."""
     return expected_serve_prefill(n_layers, tp_axis=tp_axis,
                                   vocab_parallel=vocab_parallel)
+
+
+def verify_buckets(max_draft: int, *, floor: int = 2) -> Tuple[int, ...]:
+    """THE canonical draft-length ladder for the speculative VERIFY
+    programs (serve/spec.py): powers of two from ``floor`` up to (and
+    capped at) ``max_draft`` — the default ``max_draft=8`` gives
+    ``(2, 4, 8)``. A step whose longest draft is k runs in the
+    smallest bucket >= k (program width = bucket + 1 tokens per row:
+    the slot's last sampled token rides in front of the draft), so the
+    engine compiles AT MOST ``len(verify_buckets(max_draft))`` verify
+    programs — one RecompileSentinel per bucket, ``max_compiles=1``
+    each, extending the bounded-compile invariant to
+    ``<= len(prefill_buckets) + len(verify_buckets) + 1 decode``.
+    Pinned here so engine, census and compile-count tests derive the
+    same ladder from the same place."""
+    if max_draft < 1:
+        raise ValueError(f"max_draft must be >= 1; got {max_draft}")
+    out = []
+    b = floor
+    while b < max_draft:
+        out.append(b)
+        b *= 2
+    out.append(max_draft)
+    return tuple(out)
+
+
+def expected_serve_verify(n_layers: int, *,
+                          tp_axis: Optional[str] = None,
+                          vocab_parallel: bool = False) -> CensusDict:
+    """One compiled verify bucket: the decode census exactly — verify
+    is the decode step widened from 1 to bucket+1 tokens per row, and
+    the batched draft scatter/gather (nn/attention.paged_verify_update)
+    adds no collectives. Independent of the bucket width, so every
+    bucket program must match this same spec."""
+    return expected_serve_decode(n_layers, tp_axis=tp_axis,
+                                 vocab_parallel=vocab_parallel)

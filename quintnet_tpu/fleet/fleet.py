@@ -541,7 +541,11 @@ class ServeFleet:
         routed through analysis.assert_compile_count for its
         signature-diffing error. Engines that never admitted work
         (0 compiles — e.g. a just-restarted probe that got no traffic)
-        are skipped unless ``include_idle``."""
+        are skipped unless ``include_idle``. Spec-enabled engines
+        additionally carry ``verify[<k>]`` sentinels: at most one
+        compile per draft-length bucket, any total from 0 (speculation
+        never triggered) to the bucket count — the fleet-wide bound is
+        ``prefill buckets + verify buckets + 1 decode`` per replica."""
         from quintnet_tpu.analysis.recompile import RecompileError
 
         expected: Dict[str, int] = {}
@@ -550,12 +554,19 @@ class ServeFleet:
             if not include_idle and rep.engine.metrics.admitted == 0:
                 continue
             rep_sentinels = rep.engine.compile_sentinels()
+            has_verify = any(k.startswith("verify[")
+                             for k in rep_sentinels)
             key = f"{rep.name}_decode"
-            expected[key] = decode
-            sentinels[key] = rep_sentinels["decode"]
+            # a spec-enabled replica whose every step speculated may
+            # legitimately never compile the plain decode program —
+            # 0 or `decode` compiles both keep the bound
+            if not (has_verify
+                    and rep_sentinels["decode"].compile_count == 0):
+                expected[key] = decode
+                sentinels[key] = rep_sentinels["decode"]
             per_bucket = {kind: s.compile_count
                           for kind, s in rep_sentinels.items()
-                          if kind != "decode"}
+                          if kind.startswith("prefill[")}
             total = sum(per_bucket.values())
             cap = prefill if prefill is not None else len(per_bucket)
             if not 1 <= total <= cap or any(n > 1
@@ -564,4 +575,12 @@ class ServeFleet:
                     f"replica {rep.name}: expected 1..{cap} compiled "
                     f"prefill bucket program(s) (at most one per "
                     f"bucket), observed {total} ({per_bucket})")
+            per_verify = {kind: s.compile_count
+                          for kind, s in rep_sentinels.items()
+                          if kind.startswith("verify[")}
+            if any(n > 1 for n in per_verify.values()):
+                raise RecompileError(
+                    f"replica {rep.name}: expected at most one compiled "
+                    f"verify program per draft-length bucket, observed "
+                    f"{per_verify}")
         _assert_cc(expected, **sentinels)

@@ -452,6 +452,46 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
     return x, (kc, vc)
 
 
+def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
+                             cfg: LlamaConfig, cos, sin,
+                             tp_axis: Optional[str] = None,
+                             block_tables=None,
+                             block_size: Optional[int] = None):
+    """Batched draft-verify block step over the paged pool (the serve
+    engine's speculative-decode scoring path, serve/spec.py): x
+    [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
+    caches are flat pool views [N_blocks*block_size, Hkv(/tp), hd].
+    Every row's UNrepeated (k, v) run scatters through its
+    ``block_tables`` row (pad columns masked to the null block by
+    ``tail_lens``); attention gathers each row's whole history back and
+    masks causally against absolute positions — exactly
+    :func:`llama_block_decode`'s paged math widened from 1 to P tokens
+    per row. ``cos``/``sin`` [S, 1, P, hd] must be built from the SAME
+    absolute positions. Returns (x, (kc, vc))."""
+    from quintnet_tpu.nn.attention import paged_gather, paged_verify_update
+
+    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
+    kc, vc = paged_verify_update(kc, vc, k, v, positions, tail_lens,
+                                 block_tables=block_tables,
+                                 block_size=block_size)
+    kg = paged_gather(kc, block_tables, block_size=block_size)
+    vg = paged_gather(vc, block_tables, block_size=block_size)
+    rep = q.shape[1] // kg.shape[1]
+    kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
+    valid = (jnp.arange(kf.shape[2])[None, None, :]
+             <= positions[:, :, None])[:, None]       # [S, 1, P, M*bs]
+    scores = (jnp.einsum("bhqd,bhtd->bhqt", q, kf).astype(jnp.float32)
+              / math.sqrt(cfg.head_dim))
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    o = jnp.einsum("bhqt,bhtd->bhqd",
+                   jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
+    x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    return x, (kc, vc)
+
+
 def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
                        tp_axis: Optional[str] = None,
                        block_tables=None, block_size: Optional[int] = None):

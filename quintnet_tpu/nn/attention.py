@@ -306,6 +306,80 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
     return y, k_cache, v_cache
 
 
+def paged_verify_update(k_cache, v_cache, k, v, positions, tail_lens, *,
+                        block_tables, block_size: int):
+    """Write EVERY row's short token run into the paged pool in one
+    scatter — the speculative-verify write (serve/spec.py). ``k``/``v``:
+    [S, H, P, Dh] (P = draft bucket + 1); ``positions``: [S, P] absolute
+    per-row positions (``start_s + arange(P)``); ``tail_lens``: [S] —
+    row columns at or beyond a row's tail_len (draft pad, inactive
+    slots) scatter into the null block, the same convention as
+    :func:`paged_prefill_update` batched over rows."""
+    S, P = positions.shape
+    M = block_tables.shape[1]
+    blk_idx = jnp.clip(positions // block_size, 0, M - 1)        # [S, P]
+    blk = jnp.take_along_axis(block_tables, blk_idx, axis=1)
+    idx = jnp.where(jnp.arange(P)[None, :] < tail_lens[:, None],
+                    blk * block_size + positions % block_size, 0)
+    H, Dh = k.shape[1], k.shape[3]
+    kin = k.transpose(0, 2, 1, 3).reshape(S * P, H, Dh)
+    vin = v.transpose(0, 2, 1, 3).reshape(S * P, H, Dh)
+    flat = idx.reshape(S * P)
+    return (k_cache.at[flat].set(kin.astype(k_cache.dtype)),
+            v_cache.at[flat].set(vin.astype(v_cache.dtype)))
+
+
+def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
+                     num_heads: int, tp_axis: Optional[str] = None,
+                     block_tables=None, block_size: Optional[int] = None):
+    """Batched draft-verify attention over the paged pool: EVERY slot
+    scores a short run of tokens (its last sampled token + up to k
+    drafted continuations) against its own cached row in ONE forward —
+    the decode path widened from 1 to P tokens per row (speculative
+    decoding's target-scoring step, serve/spec.py).
+
+    ``x``: [S, P, D] per-slot token runs at absolute ``positions``
+    [S, P]; the runs' (k, v) scatter through each row's block table
+    first (:func:`paged_verify_update`, pad columns masked to the null
+    block by ``tail_lens``), then each row's whole history — cached
+    prefix + fresh run — is gathered back position-ordered
+    (:func:`paged_gather`) and each token attends causally against it:
+    column t is valid iff ``t <= positions[s, i]``. With P == 1 this IS
+    :func:`mha_decode`'s paged path; the math on the gathered view is
+    identical, so verify-committed tokens are bit-equal to plain
+    decoded ones.
+
+    Returns (y [S, P, D], k_cache, v_cache). ``num_heads`` is LOCAL
+    heads under ``tp_axis`` (head-sharded pool + RowParallel psum)."""
+    qkv = linear_apply(p["qkv"], x)  # [S, P, 3*D_local]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
+    k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
+    v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
+    k_cache, v_cache = paged_verify_update(
+        k_cache, v_cache, k, v, positions, tail_lens,
+        block_tables=block_tables, block_size=block_size)
+    k_all = paged_gather(k_cache, block_tables, block_size=block_size)
+    v_all = paged_gather(v_cache, block_tables, block_size=block_size)
+    valid = (jnp.arange(k_all.shape[2])[None, None, :]
+             <= positions[:, :, None])                # [S, P, T]
+
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_all).astype(jnp.float32)
+    scores = scores / math.sqrt(dh)
+    scores = jnp.where(valid[:, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
+
+    o = rearrange(o, "b h s d -> b s (h d)")
+    y = jnp.dot(o, p["proj"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    if "b" in p["proj"]:
+        y = y + p["proj"]["b"]
+    return y, k_cache, v_cache
+
+
 def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                tp_axis: Optional[str] = None,
                block_tables=None, block_size: Optional[int] = None):

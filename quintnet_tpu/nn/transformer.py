@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from quintnet_tpu.nn.attention import (mha_apply, mha_decode, mha_init,
-                                       mha_prefill_paged)
+                                       mha_prefill_paged, mha_verify_paged)
 from quintnet_tpu.nn.layers import (
     gelu,
     layer_norm_apply,
@@ -265,6 +265,25 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
     a, k_cache, v_cache = mha_prefill_paged(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
         positions, tail_len, num_heads=num_heads, tp_axis=tp_axis,
+        block_tables=block_tables, block_size=block_size)
+    x = x + a
+    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                      tp_axis=tp_axis), k_cache, v_cache
+
+
+def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
+                       num_heads: int, act: Callable = gelu,
+                       moe_args: Optional[MoEArgs] = None,
+                       tp_axis: Optional[str] = None,
+                       block_tables=None,
+                       block_size: Optional[int] = None):
+    """Batched draft-verify block step (nn/attention.mha_verify_paged):
+    x [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
+    caches are flat pool views — the serve engine's speculative-decode
+    scoring path (serve/spec.py). Returns (x, k_cache, v_cache)."""
+    a, k_cache, v_cache = mha_verify_paged(
+        p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
+        positions, tail_lens, num_heads=num_heads, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size)
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,

@@ -39,15 +39,18 @@ from quintnet_tpu.serve.families import gpt2_family, llama_family
 from quintnet_tpu.serve.kv_pool import AdmitPlan, KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
 from quintnet_tpu.serve.scheduler import Request, RequestProgress, Scheduler
+from quintnet_tpu.serve.spec import NgramDrafter, SpecConfig
 
 __all__ = [
     "AdmitPlan",
     "KVPool",
+    "NgramDrafter",
     "Request",
     "RequestProgress",
     "Scheduler",
     "ServeEngine",
     "ServeMetrics",
+    "SpecConfig",
     "aggregate",
     "generate",
     "generate_stream",

@@ -15,7 +15,18 @@ compiled programs:
 - ``decode``: ONE step for ALL ``max_slots`` rows at once — static
   shapes, inactive slots masked (they point at the pool's null block
   and their outputs are dropped), per-row positions/block tables/PRNG
-  keys. Requests come and go across steps without any retracing.
+  keys. Requests come and go across steps without any retracing;
+- ``verify`` (speculative decoding, ``spec=SpecConfig(...)``): the
+  decode step widened to k+1 tokens per row, one program per
+  draft-length bucket (analysis/specs.verify_buckets). A host-side
+  n-gram drafter (serve/spec.py) proposes each request's continuation
+  from its own prompt + generated history; one verify forward scores
+  every slot's draft and the engine commits the longest matching
+  prefix plus a bonus token — several tokens per request per step when
+  the text is predictable, never fewer than one. Draft KV lands in
+  TENTATIVE pool blocks rolled back on rejection; committed output is
+  bit-identical to plain decoding (greedy and sampled — see
+  serve/spec.py for the key-chain argument).
 
 The no-recompile invariant is now per program: ONE decode program and
 AT MOST ``len(prefill_buckets)`` prefill programs per (model, mesh)
@@ -64,11 +75,13 @@ import numpy as np
 from quintnet_tpu.analysis.recompile import (RecompileError,
                                              RecompileSentinel)
 from quintnet_tpu.analysis.specs import prefill_buckets as _spec_buckets
+from quintnet_tpu.models.gpt2_generate import sample_logits
 from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics
 from quintnet_tpu.serve.scheduler import (FINISHED, Request,
                                           RequestProgress, Scheduler)
+from quintnet_tpu.serve.spec import NgramDrafter, SpecConfig
 
 
 class ServeEngine:
@@ -78,6 +91,7 @@ class ServeEngine:
                  prefill_len: Optional[int] = None,
                  prefill_bucket_sizes: Optional[Sequence[int]] = None,
                  prefix_cache: bool = True,
+                 spec: "SpecConfig | bool | None" = None,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, policy: str = "fcfs",
@@ -97,6 +111,15 @@ class ServeEngine:
         self.log_every = int(log_every)
         self.clock = clock
         self.prefix_cache = bool(prefix_cache)
+        # speculative decoding (serve/spec.py): None/False -> off,
+        # True -> defaults, or a SpecConfig. Drafting is host-side;
+        # the verify programs are built below beside prefill/decode.
+        if spec is True:
+            spec = SpecConfig()
+        elif spec is False:
+            spec = None
+        self.spec: Optional[SpecConfig] = spec
+        self.drafter = NgramDrafter(spec) if spec is not None else None
 
         self.max_seq_len = int(max_seq_len or family.max_positions)
         if self.max_seq_len > family.max_positions:
@@ -170,6 +193,18 @@ class ServeEngine:
         self._decode = RecompileSentinel(
             "serve.decode", self._build_decode(donate=(1, 2, 3, 6)),
             max_compiles=1)
+        # verify programs (speculative decoding): one sentinel per
+        # draft-length bucket sharing ONE jitted callable — the bucket
+        # only changes the run width P = k + 1. ids donates into the
+        # candidate-token output (same [S, P] int32 row); key_data does
+        # NOT alias anything (the chain output is [S, P, keysize]).
+        self._verifies: Dict[int, RecompileSentinel] = {}
+        if self.spec is not None:
+            verify_fn = self._build_verify(donate=(1, 2, 3))
+            self._verifies = {
+                k: RecompileSentinel(f"serve.verify[{k}]", verify_fn,
+                                     max_compiles=1)
+                for k in self.spec.buckets}
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -178,8 +213,6 @@ class ServeEngine:
         """Per-row sampling, bit-identical to what autoregress does for
         a [1, V] batch with each row's own key (vmap of the same
         sample_logits call — models/gpt2_generate.py)."""
-        from quintnet_tpu.models.gpt2_generate import sample_logits
-
         if self.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return jax.vmap(
@@ -194,8 +227,6 @@ class ServeEngine:
 
         def body(params, k_pool, v_pool, ids, start, t0, table_row,
                  cow_src, cow_len, key_data):
-            from quintnet_tpu.models.gpt2_generate import sample_logits
-
             # copy-on-write: when the reusable prefix chain ends inside
             # a partially-filled cached block, its first cow_len slots
             # are copied from cow_src into this request's first private
@@ -239,6 +270,51 @@ class ServeEngine:
 
         return self._wrap(body, n_pool_args=2, n_rest=4, donate=donate)
 
+    def _build_verify(self, *, donate):
+        """The speculative verify step (serve/spec.py): ONE forward
+        scores every slot's short token run — its last sampled token +
+        up to k drafted continuations — through the paged decode math
+        (families.verify), then samples a candidate next token at EVERY
+        run position with the keys plain decode would have used there.
+
+        Key discipline is the heart of the golden contract: each row's
+        split chain ``key -> (key', sub)`` advances once per POSITION
+        on device, and the program returns the whole chain — the host
+        commits c tokens and adopts the key after exactly c splits, so
+        rejected drafts consume no randomness and the committed stream
+        is bit-identical to plain decoding (greedy AND sampled)."""
+        family, bs = self.family, self.pool.block_size
+        tp_axis = self.tp_axis
+
+        def body(params, k_pool, v_pool, ids, starts, tail_lens, tables,
+                 key_data):
+            logits, k_pool, v_pool = family.verify(
+                params, k_pool, v_pool, ids, starts, tail_lens, tables,
+                bs, tp_axis=tp_axis)                       # [S, P, V]
+            P = ids.shape[1]
+
+            def chain_step(kd, _):
+                keys = jax.random.wrap_key_data(kd)
+                pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+                pd = jax.random.key_data(pairs)            # [S, 2, ks]
+                return pd[:, 0], (pd[:, 1], pd[:, 0])
+
+            _, (sub_data, chain_data) = jax.lax.scan(
+                chain_step, key_data, None, length=P)
+            subs = jnp.swapaxes(sub_data, 0, 1)            # [S, P, ks]
+            chain = jnp.swapaxes(chain_data, 0, 1)
+            if self.temperature <= 0.0:
+                toks = jnp.argmax(logits, axis=-1)
+            else:
+                toks = jax.vmap(jax.vmap(
+                    lambda lg, kd1: sample_logits(
+                        lg[None], jax.random.wrap_key_data(kd1),
+                        temperature=self.temperature, top_k=self.top_k,
+                        top_p=self.top_p)[0]))(logits, subs)
+            return k_pool, v_pool, toks.astype(jnp.int32), chain
+
+        return self._wrap(body, n_pool_args=2, n_rest=5, donate=donate)
+
     def _wrap(self, body, *, n_pool_args: int, n_rest: int, donate):
         """jit, donating the aliasable arguments: the pool buffers
         (decode-state updates are in-place on device) plus the per-step
@@ -259,6 +335,8 @@ class ServeEngine:
         # prefill body: (params, kp, vp, ids, start, t0, row, cow_src,
         #                cow_len, key) -> 4 outs
         # decode  body: (params, kp, vp, tok, pos, tables, key) -> 4 outs
+        # verify  body: (params, kp, vp, ids, starts, tail_lens, tables,
+        #                key) -> 4 outs
         smapped = cc.shard_map_fn(
             body, self.mesh,
             in_specs=((pspecs,) + (pool_spec,) * n_pool_args
@@ -537,6 +615,132 @@ class ServeEngine:
                              if self._slot_req[s] is victim)
                 self._preempt(vslot)
 
+    # ------------------------------------------------------------------
+    # speculative decoding (serve/spec.py)
+    # ------------------------------------------------------------------
+    def _propose_drafts(self, active: List[int]):
+        """Ask the n-gram drafter for every active slot's continuation.
+        Returns ``{slot: draft np.ndarray}`` when at least one slot
+        drafted >= spec.min_draft tokens (the verify step is worth a
+        wider program), else None (plain decode). Drafts are capped so
+        the commit can never overrun the token budget: at most
+        ``remaining_new_tokens - 1`` drafted tokens leaves room for
+        the mandatory bonus token."""
+        if self.drafter is None:
+            return None
+        drafts: Dict[int, np.ndarray] = {}
+        worthwhile = False
+        for slot in active:
+            req = self._slot_req[slot]
+            cap = min(self.spec.max_draft, req.remaining_new_tokens - 1)
+            d = (self.drafter.draft(req.output_ids(), cap)
+                 if cap >= 1 else np.zeros((0,), np.int32))
+            drafts[slot] = d
+            if len(d) >= self.spec.min_draft:
+                worthwhile = True
+        return drafts if worthwhile else None
+
+    def _verify_step(self, active: List[int],
+                     drafts: Dict[int, np.ndarray],
+                     finished: List[int]) -> Tuple[int, int, int]:
+        """One batched verify: write every slot's run (last token +
+        draft) through the paged pool, read back per-position candidate
+        tokens + the PRNG split chain, commit the longest matching
+        prefix + one bonus token per slot, roll back the rest.
+
+        Block accounting: blocks the speculative tail needs beyond the
+        slot's committed holding are acquired TENTATIVE (drafts shrink
+        when the pool cannot cover them — speculation degrades, never
+        preempts); after acceptance the blocks the new committed length
+        reaches are committed, the rest rolled back, so published
+        chains never observe draft slots. Returns (committed tokens,
+        drafted tokens, accepted draft tokens)."""
+        S = self.max_slots
+        tentative: Dict[int, List[int]] = {}
+        for slot in active:
+            d = drafts[slot]
+            pos = int(self._pos[slot])
+            have = len(self._slot_blocks[slot])
+            # shrink the draft until its tail blocks are acquirable
+            while len(d):
+                need = self.pool.blocks_for(pos + len(d) + 1) - have
+                if need <= 0 or self.pool.can_acquire(need):
+                    break
+                d = d[:-1]
+            drafts[slot] = d
+            need = max(0, self.pool.blocks_for(pos + len(d) + 1) - have)
+            got = self.pool.tentative_acquire(need) if need else []
+            assert got is not None  # can_acquire checked just above
+            tentative[slot] = got
+            self._tables[slot][have:have + len(got)] = got
+
+        # bucket by the SURVIVING drafts: pool pressure may have shrunk
+        # every proposal, and the narrower program is the cheaper one
+        k_bucket = self.spec.bucket_for(
+            max(len(drafts[s]) for s in active))
+        P = k_bucket + 1
+        ids = np.zeros((S, P), np.int32)
+        starts = np.zeros((S,), np.int32)
+        tail_lens = np.zeros((S,), np.int32)
+        for slot in active:
+            d = drafts[slot]
+            ids[slot, 0] = self._tok[slot]
+            ids[slot, 1:1 + len(d)] = d
+            starts[slot] = int(self._pos[slot])
+            tail_lens[slot] = len(d) + 1
+
+        kp, vp, toks, chain = self._verifies[k_bucket](
+            self.params, *self.pool.caches(), jnp.asarray(ids),
+            jnp.asarray(starts), jnp.asarray(tail_lens),
+            jnp.asarray(self._tables), jnp.asarray(self._key_data))
+        self.pool.update(kp, vp)
+        toks = np.asarray(toks)
+        chain = np.asarray(chain)
+
+        committed = drafted = accepted = 0
+        for slot in active:
+            d = drafts[slot]
+            t = toks[slot]
+            a = 0
+            while a < len(d) and int(t[a]) == int(d[a]):
+                a += 1
+            # commit candidates t[0..a] — each is exactly the token
+            # plain decode would have produced there — stopping early
+            # on EOS / token budget (_append_token's own done rule)
+            pos0 = int(self._pos[slot])
+            c = 0
+            done = False
+            while c <= a and not done:
+                done = self._append_token(slot, int(t[c]))
+                c += 1
+            self._tok[slot] = int(t[c - 1])
+            self._pos[slot] = pos0 + c
+            # adopt the key after exactly c splits: rejected drafts
+            # consume no randomness (the bit-parity contract)
+            self._key_data[slot] = chain[slot, c - 1]
+            # resolve the tentative tail: blocks the committed length
+            # reaches stay, the speculative remainder rolls back
+            have0 = len(self._slot_blocks[slot])
+            got = tentative[slot]
+            keep = max(0, min(len(got),
+                              self.pool.blocks_for(pos0 + c) - have0))
+            if keep:
+                self.pool.commit_tentative(got[:keep])
+                self._slot_blocks[slot].extend(got[:keep])
+            if got[keep:]:
+                self.pool.rollback_tentative(got[keep:])
+                self._tables[slot][have0 + keep:have0 + len(got)] = 0
+            committed += c
+            drafted += len(d)
+            # committed draft tokens: all of t[0..c-1] except the bonus
+            # token at position a — which is only reached when the whole
+            # matched prefix committed (an EOS/budget stop inside the
+            # draft commits drafted tokens only)
+            accepted += min(c, a)
+            if done:
+                finished.append(self._retire(slot))
+        return committed, drafted, accepted
+
     def step(self) -> List[int]:
         """One scheduler iteration: admit -> grow/preempt -> one decode
         step for every active slot -> retire finished rows. Returns the
@@ -561,24 +765,36 @@ class ServeEngine:
         # 2. block growth / preemption for the upcoming writes
         self._grow_or_preempt()
 
-        # 3. one decode step for all active slots
+        # 3. one decode step for all active slots — or, when the
+        # drafter found a worthwhile proposal for some slot, ONE
+        # batched verify step scoring every slot's draft (slots with
+        # no draft ride along with a 1-token run, bit-equal to decode)
         active = self._active_slots()
         decode_tokens = 0
+        draft_tokens = accepted_draft = 0
+        spec_step = False
         if active:
-            kp, vp, nxt, key2 = self._decode(
-                self.params, *self.pool.caches(), jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._tables),
-                jnp.asarray(self._key_data))
-            self.pool.update(kp, vp)
-            nxt = np.asarray(nxt)
-            self._key_data = np.array(key2)
-            for slot in active:
-                token = int(nxt[slot])
-                self._tok[slot] = token
-                self._pos[slot] += 1
-                decode_tokens += 1
-                if self._append_token(slot, token):
-                    finished.append(self._retire(slot))
+            drafts = self._propose_drafts(active)
+            if drafts is not None:
+                spec_step = True
+                decode_tokens, draft_tokens, accepted_draft = \
+                    self._verify_step(active, drafts, finished)
+            else:
+                kp, vp, nxt, key2 = self._decode(
+                    self.params, *self.pool.caches(),
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    jnp.asarray(self._tables),
+                    jnp.asarray(self._key_data))
+                self.pool.update(kp, vp)
+                nxt = np.asarray(nxt)
+                self._key_data = np.array(key2)
+                for slot in active:
+                    token = int(nxt[slot])
+                    self._tok[slot] = token
+                    self._pos[slot] += 1
+                    decode_tokens += 1
+                    if self._append_token(slot, token):
+                        finished.append(self._retire(slot))
 
         # 4. metrics
         self.metrics.record_step(
@@ -588,7 +804,10 @@ class ServeEngine:
             kv_blocks_total=self.pool.usable_blocks,
             prefill_tokens=prefill_tokens,
             decode_tokens=decode_tokens,
-            prefix_hit_tokens=prefix_hit_tokens)
+            prefix_hit_tokens=prefix_hit_tokens,
+            spec_step=spec_step,
+            draft_tokens=draft_tokens,
+            accepted_draft_tokens=accepted_draft)
         if self.log_every:
             self.metrics.log_step(self.logger, every=self.log_every)
         return finished
@@ -617,6 +836,17 @@ class ServeEngine:
             jnp.asarray(self._pos), jnp.asarray(self._tables),
             jnp.asarray(self._key_data))
         self.pool.update(kp, vp)
+        for k, sentinel in self._verifies.items():
+            # all-zero tables + zero tail_lens: every write lands in
+            # the null block, candidate tokens and chains are discarded
+            kp, vp, _t, _c = sentinel(
+                self.params, *self.pool.caches(),
+                jnp.zeros((self.max_slots, k + 1), jnp.int32),
+                jnp.zeros((self.max_slots,), jnp.int32),
+                jnp.zeros((self.max_slots,), jnp.int32),
+                jnp.zeros((self.max_slots, self.table_width), jnp.int32),
+                jnp.asarray(self._key_data))
+            self.pool.update(kp, vp)
 
     def run(self, *, max_steps: Optional[int] = None) -> None:
         """Step until all submitted work is finished (or ``max_steps``)."""
@@ -683,29 +913,45 @@ class ServeEngine:
     # ------------------------------------------------------------------
     def compile_stats(self) -> Dict[str, int]:
         """Compiled-program counts for the bounded-compile invariant
-        (tests/test_serve.py): ``decode`` must stay at 1 and
-        ``prefill`` — the TOTAL across buckets — at most
-        ``len(prefill_buckets)`` no matter how requests come and go.
+        (tests/test_serve.py): ``decode`` must stay at 1, ``prefill``
+        — the TOTAL across buckets — at most ``len(prefill_buckets)``,
+        and (speculation on) ``verify`` at most
+        ``len(spec.buckets)``, no matter how requests come and go.
         Counted by the RecompileSentinels (distinct abstract signatures
-        seen = programs jit compiled)."""
-        return {"prefill": sum(s.compile_count
-                               for s in self._prefills.values()),
-                "decode": self._decode.compile_count}
+        seen = programs jit compiled). The ``verify`` key appears only
+        on spec-enabled engines — a spec-off engine's stats are
+        byte-identical to the pre-speculation surface."""
+        out = {"prefill": sum(s.compile_count
+                              for s in self._prefills.values()),
+               "decode": self._decode.compile_count}
+        if self.spec is not None:
+            out["verify"] = sum(s.compile_count
+                                for s in self._verifies.values())
+        return out
 
     def compile_sentinels(self) -> Dict[str, RecompileSentinel]:
-        """The per-bucket prefill sentinels (``prefill[<width>]``) and
-        the decode sentinel, for callers that aggregate the promise
-        across engines (fleet.assert_compile_count)."""
+        """The per-bucket prefill sentinels (``prefill[<width>]``), the
+        per-bucket verify sentinels (``verify[<k>]``, spec-enabled
+        engines only) and the decode sentinel, for callers that
+        aggregate the promise across engines
+        (fleet.assert_compile_count)."""
         out: Dict[str, RecompileSentinel] = {
             f"prefill[{b}]": s for b, s in self._prefills.items()}
+        for k, s in self._verifies.items():
+            out[f"verify[{k}]"] = s
         out["decode"] = self._decode
         return out
 
-    def assert_compile_count(self, prefill: int = 1, decode: int = 1):
+    def assert_compile_count(self, prefill: int = 1, decode: int = 1,
+                             verify: Optional[int] = None):
         """Raise RecompileError unless exactly ``decode`` decode
         programs and ``prefill`` prefill programs IN TOTAL across the
         buckets were compiled (each bucket is additionally capped at
-        one by its own sentinel at call time)."""
+        one by its own sentinel at call time). ``verify``: exact total
+        across the verify buckets; None accepts any total up to
+        ``len(spec.buckets)`` — traffic legitimately decides which
+        draft-length buckets ever trigger. Either way the global bound
+        holds: programs <= prefill buckets + verify buckets + 1."""
         self._decode.assert_compile_count(decode)
         total = sum(s.compile_count for s in self._prefills.values())
         if total != prefill:
@@ -715,3 +961,14 @@ class ServeEngine:
             raise RecompileError(
                 f"serve.prefill: expected {prefill} compiled bucket "
                 f"program(s) in total, observed {total} ({detail})")
+        v_total = sum(s.compile_count for s in self._verifies.values())
+        v_cap = verify if verify is not None else len(self._verifies)
+        if (verify is not None and v_total != verify) or v_total > v_cap:
+            detail = ", ".join(
+                f"bucket {k}: {s.compile_count}"
+                for k, s in sorted(self._verifies.items()))
+            raise RecompileError(
+                f"serve.verify: expected "
+                f"{verify if verify is not None else f'<= {v_cap}'} "
+                f"compiled bucket program(s) in total, observed "
+                f"{v_total} ({detail})")

@@ -25,6 +25,20 @@ bucket width serves every (start, t0) split.
 Decode contract: ``decode(params, k_pool, v_pool, tok [S], pos [S],
 tables [S, M], block_size, tp_axis) -> (logits [S, V], k_pool, v_pool)``
 — per-row positions, paged pool views, static S.
+
+Verify contract (speculative decoding, serve/spec.py): ``verify(params,
+k_pool, v_pool, ids [S, P], starts [S], tail_lens [S], tables [S, M],
+block_size, tp_axis) -> (logits [S, P, V], k_pool, v_pool)`` — the
+decode step widened from 1 to P tokens per row. Row s's ids hold its
+last sampled token + up to P-1 drafted continuations at absolute
+positions ``starts[s] + arange(P)``; columns at or beyond
+``tail_lens[s]`` are pad (their KV scatters to the null block, their
+logits are garbage the engine never reads). Logits come back for ALL P
+positions — ``logits[s, i]`` is the next-token distribution after row
+s's first i+1 run tokens — so one forward scores a whole draft + the
+bonus token. The attention math is the gathered-view decode math
+exactly (nn/attention.mha_verify_paged), which is what makes
+verify-committed tokens bit-equal to plain decoded ones.
 """
 
 from __future__ import annotations
@@ -48,6 +62,9 @@ class Family:
     prefill_from: Callable   # (params, kp, vp, ids, start, t0, row, bs,
     #                           tp_axis) -> (logits, kp, vp)
     decode: Callable         # (params, kp, vp, tok, pos, tables, bs, tp_axis)
+    verify: Callable         # (params, kp, vp, ids [S, P], starts [S],
+    #                           tail_lens [S], tables, bs, tp_axis)
+    #                           -> (logits [S, P, V], kp, vp)
     partition_specs: Callable  # (tp_axis) -> param pytree specs
     kv_dtype: Any = jnp.float32
 
@@ -61,7 +78,9 @@ def gpt2_family(cfg) -> Family:
     from quintnet_tpu.models.gpt2_generate import (_embed_tok, _local_heads,
                                                    _logits)
     from quintnet_tpu.nn.layers import gelu
-    from quintnet_tpu.nn.transformer import block_decode, block_prefill_paged
+    from quintnet_tpu.nn.transformer import (block_decode,
+                                             block_prefill_paged,
+                                             block_verify_paged)
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
                      block_size, tp_axis=None):
@@ -108,10 +127,33 @@ def gpt2_family(cfg) -> Family:
                                                  k_pool, v_pool))
         return _logits(params, h, cfg, tp_axis)[:, 0, :], k_pool, v_pool
 
+    def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
+               block_size, tp_axis=None):
+        S, P = ids.shape
+        emb = params["embedding"]
+        positions = (starts[:, None]
+                     + jnp.arange(P, dtype=jnp.int32)[None, :])  # [S, P]
+        safe_pos = jnp.clip(positions, 0, emb["wpe"].shape[0] - 1)
+        h = (_embed_tok(emb, ids, cfg, tp_axis)
+             + jnp.take(emb["wpe"], safe_pos, axis=0))
+        heads = _local_heads(cfg, tp_axis)
+
+        def body(x, layer):
+            blk, kc, vc = layer
+            x, kc, vc = block_verify_paged(
+                blk, x, kc, vc, positions, tail_lens, num_heads=heads,
+                act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
+                block_tables=tables, block_size=block_size)
+            return x, (kc, vc)
+
+        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
+                                                 k_pool, v_pool))
+        return _logits(params, h, cfg, tp_axis), k_pool, v_pool
+
     return Family(
         name="gpt2", cfg=cfg, n_layers=cfg.n_layer, n_kv_heads=cfg.n_head,
         head_dim=cfg.n_embd // cfg.n_head, max_positions=cfg.n_positions,
-        prefill_from=prefill_from, decode=decode,
+        prefill_from=prefill_from, decode=decode, verify=verify,
         partition_specs=lambda tp_axis: gpt2_partition_specs(
             cfg, tp_axis=tp_axis),
     )
@@ -124,6 +166,7 @@ def gpt2_family(cfg) -> Family:
 def llama_family(cfg) -> Family:
     from quintnet_tpu.models.llama import (llama_block_decode,
                                            llama_block_prefill_paged,
+                                           llama_block_verify_paged,
                                            llama_partition_specs,
                                            llama_rope_tables)
     from quintnet_tpu.models.llama_generate import _embed, _full_logits
@@ -168,11 +211,32 @@ def llama_family(cfg) -> Family:
         return _full_logits(params, h, cfg, tp_axis)[:, 0, :], \
             k_pool, v_pool
 
+    def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
+               block_size, tp_axis=None):
+        S, P = ids.shape
+        h = _embed(params, ids, cfg, tp_axis)                 # [S, P, D]
+        positions = (starts[:, None]
+                     + jnp.arange(P, dtype=jnp.int32)[None, :])
+        cos, sin = llama_rope_tables(positions, cfg)          # [S, P, hd]
+        cos, sin = cos[:, None], sin[:, None]                 # [S,1,P,hd]
+
+        def body(x, layer):
+            blk, kc, vc = layer
+            x, (kc, vc) = llama_block_verify_paged(
+                blk, x, kc, vc, positions, tail_lens, cfg, cos, sin,
+                tp_axis=tp_axis, block_tables=tables,
+                block_size=block_size)
+            return x, (kc, vc)
+
+        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
+                                                 k_pool, v_pool))
+        return _full_logits(params, h, cfg, tp_axis), k_pool, v_pool
+
     return Family(
         name="llama", cfg=cfg, n_layers=cfg.n_layers,
         n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
         max_positions=cfg.n_positions,
-        prefill_from=prefill_from, decode=decode,
+        prefill_from=prefill_from, decode=decode, verify=verify,
         partition_specs=lambda tp_axis: llama_partition_specs(
             cfg, tp_axis=tp_axis),
     )

@@ -141,6 +141,11 @@ class KVPool:
         # eviction counter (hit accounting lives in ServeMetrics,
         # which sees per-admission cached-token counts)
         self.cache_evictions = 0
+        # blocks acquired for a SPECULATIVE tail (serve/spec.py):
+        # referenced like any private block, but their slots hold
+        # unverified draft KV until the engine commits or rolls back —
+        # the prefix index must never see them (publish() refuses)
+        self._tentative: Set[int] = set()
 
     # ---- accounting -------------------------------------------------
     @property
@@ -264,6 +269,47 @@ class KVPool:
                     self._free.append(b)
                     self._free_set.add(b)
 
+    # ---- tentative (speculative-tail) blocks -------------------------
+    def is_tentative(self, block: int) -> bool:
+        return block in self._tentative
+
+    @property
+    def num_tentative(self) -> int:
+        return len(self._tentative)
+
+    def tentative_acquire(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` private blocks for a SPECULATIVE tail: drafted
+        slots will be written into them before verification resolves.
+        Same allocator as :meth:`acquire` (free list first, then LRU
+        eviction, never partial), but the blocks are marked tentative
+        until :meth:`commit_tentative` or :meth:`rollback_tentative` —
+        the engine resolves every tentative block within the step that
+        acquired it, so publish/index state never observes one."""
+        got = self.acquire(n)
+        if got is not None:
+            self._tentative.update(got)
+        return got
+
+    def commit_tentative(self, blocks: Sequence[int]) -> None:
+        """Verification accepted drafts reaching into ``blocks``: they
+        become ordinary private blocks of the owning request (the
+        refcount they already hold is the request's table reference)."""
+        for b in blocks:
+            if b not in self._tentative:
+                raise ValueError(f"block {b} is not tentative")
+            self._tentative.remove(b)
+
+    def rollback_tentative(self, blocks: Sequence[int]) -> None:
+        """Verification rejected the drafts in ``blocks``: drop the
+        speculative reference and return them to the allocator. The
+        draft KV they hold is garbage nobody can reach — the blocks
+        were never published and leave every live table now."""
+        for b in blocks:
+            if b not in self._tentative:
+                raise ValueError(f"block {b} is not tentative")
+            self._tentative.remove(b)
+        self.release(blocks)
+
     # legacy names (PR 1 surface): plain allocation without sharing
     def alloc(self, n: int) -> Optional[List[int]]:
         return self.acquire(n)
@@ -363,6 +409,17 @@ class KVPool:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n_tokens = min(int(n_tokens), len(tokens))
         q, f = divmod(n_tokens, self.block_size)
+        used = q + (1 if f else 0)
+        bad = [b for b in blocks[:used] if b in self._tentative]
+        if bad:
+            # the invariant speculative decoding must never break:
+            # cached/published chains hold COMMITTED positions only —
+            # a tentative block here means the engine tried to publish
+            # an unresolved speculative tail
+            raise ValueError(
+                f"publish would index tentative block(s) {bad}: "
+                f"speculative drafts must be committed or rolled back "
+                f"before a request's blocks are published")
         for j in range(q):
             self._publish_one(blocks[j], self._key(tokens, (j + 1)
                                                    * self.block_size),

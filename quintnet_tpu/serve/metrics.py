@@ -52,6 +52,15 @@ class ServeMetrics:
     # SAVED (they were never recomputed); prefill_tokens above counts
     # only the uncached tail actually pushed through a prefill program
     prefix_hit_tokens: int = 0
+    # speculative-decoding ledger (serve/spec.py): decode_steps counts
+    # decode/verify program invocations (the denominator that makes
+    # multi-token commits visible: tokens_per_decode_step > 1 is the
+    # speculation win); spec_steps of those ran a verify bucket;
+    # draft_tokens were proposed, accepted_draft_tokens committed
+    decode_steps: int = 0
+    spec_steps: int = 0
+    draft_tokens: int = 0
+    accepted_draft_tokens: int = 0
     peak_kv_utilization: float = 0.0
     peak_running: int = 0
 
@@ -65,7 +74,10 @@ class ServeMetrics:
     def record_step(self, *, running: int, waiting: int,
                     kv_blocks_used: int, kv_blocks_total: int,
                     prefill_tokens: int, decode_tokens: int,
-                    prefix_hit_tokens: int = 0) -> None:
+                    prefix_hit_tokens: int = 0,
+                    spec_step: bool = False,
+                    draft_tokens: int = 0,
+                    accepted_draft_tokens: int = 0) -> None:
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
@@ -78,6 +90,12 @@ class ServeMetrics:
         self.prefill_tokens += prefill_tokens
         self.decode_tokens += decode_tokens
         self.prefix_hit_tokens += prefix_hit_tokens
+        if decode_tokens > 0:
+            self.decode_steps += 1
+        if spec_step:
+            self.spec_steps += 1
+        self.draft_tokens += draft_tokens
+        self.accepted_draft_tokens += accepted_draft_tokens
         util = kv_blocks_used / max(kv_blocks_total, 1)
         self.peak_kv_utilization = max(self.peak_kv_utilization, util)
         self.peak_running = max(self.peak_running, running)
@@ -124,6 +142,23 @@ class ServeMetrics:
         denom = self.prefix_hit_tokens + self.prefill_tokens
         return self.prefix_hit_tokens / denom if denom else 0.0
 
+    @property
+    def tokens_per_decode_step(self) -> float:
+        """Mean tokens committed per decode/verify invocation, summed
+        over the batch — ~(mean active slots) for plain decoding (one
+        token per active row per step), multiplied by the mean accepted
+        run length when speculation commits drafts. An A/B over the
+        SAME trace isolates the speculation factor; in isolation the
+        number conflates concurrency with acceptance."""
+        return (self.decode_tokens / self.decode_steps
+                if self.decode_steps else 0.0)
+
+    @property
+    def draft_acceptance_rate(self) -> float:
+        """Fraction of drafted tokens the verify step committed."""
+        return (self.accepted_draft_tokens / self.draft_tokens
+                if self.draft_tokens else 0.0)
+
     def summary(self) -> Dict:
         """One JSON-able dict: throughput, TTFT/latency percentiles,
         peak pool pressure. tok/s counts GENERATED (decode + prefill-
@@ -142,6 +177,12 @@ class ServeMetrics:
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "prefix_hit_rate": round(self.prefix_hit_rate, 4),
+            "decode_steps": self.decode_steps,
+            "tokens_per_decode_step": round(self.tokens_per_decode_step, 4),
+            "spec_steps": self.spec_steps,
+            "draft_tokens": self.draft_tokens,
+            "accepted_draft_tokens": self.accepted_draft_tokens,
+            "draft_acceptance_rate": round(self.draft_acceptance_rate, 4),
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0
             else 0.0,
@@ -188,6 +229,10 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         latencies.extend(m.latencies)
     hit = sum(m.prefix_hit_tokens for m in all_metrics)
     prefill = sum(m.prefill_tokens for m in all_metrics)
+    dsteps = sum(m.decode_steps for m in all_metrics)
+    dtok = sum(m.decode_tokens for m in all_metrics)
+    drafted = sum(m.draft_tokens for m in all_metrics)
+    accepted = sum(m.accepted_draft_tokens for m in all_metrics)
     return {
         "replicas": len(all_metrics),
         "steps": sum(m.steps for m in all_metrics),
@@ -196,11 +241,19 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "finished": sum(m.finished for m in all_metrics),
         "preempted": sum(m.preempted for m in all_metrics),
         "prefill_tokens": prefill,
-        "decode_tokens": sum(m.decode_tokens for m in all_metrics),
+        "decode_tokens": dtok,
         "prefix_hit_tokens": hit,
         "prefill_tokens_saved": hit,
         "prefix_hit_rate": round(hit / (hit + prefill), 4)
         if (hit + prefill) else 0.0,
+        "decode_steps": dsteps,
+        "tokens_per_decode_step": round(dtok / dsteps, 4) if dsteps
+        else 0.0,
+        "spec_steps": sum(m.spec_steps for m in all_metrics),
+        "draft_tokens": drafted,
+        "accepted_draft_tokens": accepted,
+        "draft_acceptance_rate": round(accepted / drafted, 4) if drafted
+        else 0.0,
         "wall_s": round(wall, 4),
         "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
         "ttft_s": _pcts(ttfts),

@@ -46,6 +46,14 @@ class RequestProgress:
     :meth:`ServeEngine.restore_progress`, token-identical to an
     undisturbed run.
 
+    ``generated`` holds COMMITTED tokens only — speculative drafts
+    (serve/spec.py) are engine-step-transient host state that is
+    verified or discarded before any export path can observe it, and
+    ``key_data`` advances one split per committed token whether the
+    token came from plain decode or an accepted draft. A request
+    exported mid-speculation therefore resumes on any replica exactly
+    as if it had never speculated (tests/test_fleet.py).
+
     ``rid`` is the EXPORTING engine's request id (engine-local; the
     restoring engine assigns its own)."""
 

@@ -1,0 +1,153 @@
+"""Speculative decoding: n-gram self-drafting + batched verify.
+
+Decode is one token per compiled step per request — the serving floor.
+Speculative decoding (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding") breaks it by VERIFYING k
+drafted tokens in one target forward; prompt-lookup / n-gram
+self-drafting (Saxena, "Prompt Lookup Decoding"; Fu et al., "Lookahead
+Decoding") gets the draft for free — the request's own prompt +
+generated history proposes its continuation, no second model.
+
+The division of labour:
+
+- **Drafter (here, host-side)**: :class:`NgramDrafter` finds the
+  longest suffix n-gram of ``prompt + generated`` that re-occurred
+  earlier in the sequence and proposes the tokens that followed its
+  most recent occurrence. Pure numpy over a few hundred ints — no
+  device work, no compiled programs, nothing to retrace.
+- **Verify (engine, one compiled program per draft-length bucket)**:
+  all active slots score their drafts in ONE forward through the paged
+  decode path (families.verify / nn/attention.mha_verify_paged): row s
+  feeds its last sampled token + up to k drafted continuations, logits
+  come back for every position, and the engine commits the longest
+  prefix of drafts that match what the model would have produced
+  anyway — plus one bonus token from the first mismatch position.
+  Requests whose drafter found nothing ride the same call with a
+  1-token run (bit-equal to plain decode), so speculating and
+  non-speculating requests share the step.
+- **Rollback (KVPool tentative append)**: blocks acquired for the
+  speculative tail are marked tentative; on partial/total rejection
+  the engine rewinds its slot counters and rolls the unused blocks
+  back. Published/cached blocks never observe tentative slots — the
+  prefix index only ever sees committed positions.
+
+THE golden contract is inherited, not relaxed: acceptance keeps the
+output distribution identical to plain decoding — and this
+implementation is strictly stronger, BIT-identical even for sampled
+traffic. Each candidate token is sampled with exactly the PRNG key
+plain decode would have used at that step (the per-request split chain
+advances once per COMMITTED token, never for rejected drafts), and a
+draft is only accepted when it equals that sample — so the committed
+stream is the plain stream, just produced in fewer forwards
+(tests/test_spec.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+from quintnet_tpu.analysis.specs import verify_buckets as _spec_buckets
+
+_EMPTY = np.zeros((0,), np.int32)
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knobs for :class:`~.engine.ServeEngine`.
+
+    ``max_draft`` caps the drafted tokens per request per step and
+    pins the largest verify bucket; ``buckets`` defaults to the
+    canonical ladder ``analysis/specs.verify_buckets(max_draft)`` —
+    the engine compiles AT MOST one verify program per bucket
+    (RecompileSentinel, max_compiles=1 each). ``min_draft`` gates the
+    verify path: a step speculates only when some slot drafted at
+    least this many tokens (shorter drafts still ride along once
+    another slot triggers the call). ``ngram_max``/``ngram_min`` bound
+    the suffix n-gram the drafter matches on."""
+
+    max_draft: int = 8
+    min_draft: int = 2
+    ngram_max: int = 3
+    ngram_min: int = 1
+    buckets: Tuple[int, ...] = field(default=None)
+
+    def __post_init__(self):
+        if self.max_draft < 1:
+            raise ValueError(f"max_draft must be >= 1; got {self.max_draft}")
+        # the default min_draft=2 must not make max_draft=1 (a
+        # legitimate 1-draft + bonus configuration) unconstructible
+        object.__setattr__(self, "min_draft",
+                           min(self.min_draft, self.max_draft))
+        if self.min_draft < 1:
+            raise ValueError(
+                f"min_draft must be >= 1; got {self.min_draft}")
+        if not 1 <= self.ngram_min <= self.ngram_max:
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max; got "
+                f"{self.ngram_min}, {self.ngram_max}")
+        buckets = (tuple(sorted(set(int(b) for b in self.buckets)))
+                   if self.buckets is not None
+                   else _spec_buckets(self.max_draft))
+        if not buckets or buckets[0] < 1 or buckets[-1] != self.max_draft:
+            raise ValueError(
+                f"verify buckets {buckets} must be positive and end at "
+                f"max_draft={self.max_draft} (the largest draft must fit)")
+        object.__setattr__(self, "buckets", buckets)
+
+    def bucket_for(self, draft_len: int) -> int:
+        """Smallest verify bucket holding ``draft_len`` drafted tokens."""
+        for b in self.buckets:
+            if b >= draft_len:
+                return b
+        raise AssertionError(
+            f"draft {draft_len} exceeds max_draft={self.max_draft} — "
+            f"the engine caps proposals before bucketing")
+
+
+class NgramDrafter:
+    """Prompt-lookup self-drafting: propose the continuation of the
+    most recent earlier occurrence of the sequence's own suffix.
+
+    For n from ``ngram_max`` down to ``ngram_min``, the last n tokens
+    of ``ctx`` are searched for a previous occurrence; on a hit the
+    tokens that FOLLOWED the most recent match become the draft (up to
+    ``max_tokens``). Repetitive text — code, templated prose, the
+    short cycles greedy decoding itself falls into — drafts long and
+    accepts long; novel text drafts nothing and costs nothing beyond
+    this numpy scan. Stateless and host-side: drafts never touch
+    request state, exported progress, or the KV pool index."""
+
+    def __init__(self, cfg: SpecConfig):
+        self.cfg = cfg
+
+    def draft(self, ctx: np.ndarray, max_tokens: int) -> np.ndarray:
+        cfg = self.cfg
+        ctx = np.asarray(ctx, np.int32).reshape(-1)
+        T = ctx.size
+        max_tokens = min(int(max_tokens), cfg.max_draft)
+        if max_tokens < 1 or T < cfg.ngram_min + 1:
+            return _EMPTY
+        for n in range(min(cfg.ngram_max, T - 1), cfg.ngram_min - 1, -1):
+            pattern = ctx[T - n:]
+            # windows starting at i <= T-1-n: every match has at least
+            # one following token, and the suffix itself (start T-n)
+            # is excluded by construction
+            win = np.lib.stride_tricks.sliding_window_view(ctx[:T - 1], n)
+            hits = np.nonzero((win == pattern).all(axis=1))[0]
+            if hits.size:
+                # the most recent occurrence at start i makes the
+                # sequence consistent with period p = (T - n) - i
+                # (the smallest period any match witnesses), so the
+                # predicted continuation is the last p tokens cycled:
+                # draft[j] = ctx[T - p + (j mod p)]. For p >= the
+                # draft budget this degenerates to the literal
+                # continuation after the match; for runs/short cycles
+                # it predicts whole periods instead of stopping at the
+                # end of the buffer.
+                p = T - n - int(hits[-1])
+                idx = T - p + (np.arange(max_tokens) % p)
+                return ctx[idx].astype(np.int32)
+        return _EMPTY

@@ -388,3 +388,61 @@ def test_breaker_trips_then_half_open_probe_recovers(factory, params,
         assert all(r.state == HEALTHY for r in fleet.replicas)
     finally:
         fleet.drain(timeout=120)
+
+
+# ---------------------------------------------------------------------
+# speculative decoding x migration (quintnet_tpu/serve/spec.py)
+# ---------------------------------------------------------------------
+
+def test_kill_mid_speculation_migrates_token_identically(rng):
+    """Replica r1 of 2 is killed while its requests have in-flight
+    speculative drafts (spec-enabled engines on repetition-prone
+    traffic — drafts are being accepted when the chaos fires). The
+    migrated RequestProgress carries COMMITTED tokens only: every
+    request resumes on the healthy replica token-identical to the
+    undisturbed greedy oracle, drafts never leak into exported
+    progress, and the per-replica compile bound now includes the
+    verify buckets."""
+    from quintnet_tpu.serve import SpecConfig
+
+    cfg = GPT2Config.tiny(n_layer=2, n_positions=256)
+    sparams = gpt2_init(jax.random.key(1), cfg)  # repetition-prone init
+
+    def spec_factory():
+        return ServeEngine(gpt2_family(cfg), sparams, max_slots=2,
+                           block_size=8, num_blocks=32, max_seq_len=100,
+                           spec=SpecConfig())
+
+    def oracle(prompt, max_new, key):
+        return np.asarray(gpt2_generate(
+            sparams, prompt[None], cfg, max_new_tokens=max_new,
+            temperature=0.0, key=key)[0])
+
+    prompts = [np.asarray(rng.integers(0, cfg.vocab_size, (n,)),
+                          np.int32) for n in (12, 9, 11, 8)]
+    keys = [jax.random.key(1300 + i) for i in range(4)]
+    monkey = ChaosMonkey(kill_at_step=6, mode="raise", target="r1")
+    fleet = ServeFleet(spec_factory, n_replicas=2, policy="round_robin",
+                       chaos=monkey)
+    try:
+        fids = [fleet.submit(p, 60, key=k)
+                for p, k in zip(prompts, keys)]
+        outs = [fleet.result(f, timeout=300) for f in fids]
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(o, oracle(p, 60, k))
+
+        m = fleet.metrics
+        assert m.replica_deaths == 1
+        assert m.migrations >= 1
+        assert m.finished == 4 and m.shed == 0
+        # speculation was actually in flight fleet-wide (accepted
+        # drafts recorded before AND independent of the kill)
+        eng = fleet.summary()["engine"]
+        assert eng["accepted_draft_tokens"] > 0
+        assert eng["spec_steps"] > 0
+        # no replica leaked a tentative block past its step
+        assert all(r.engine.pool.num_tentative == 0
+                   for r in fleet.replicas)
+        fleet.assert_compile_count()
+    finally:
+        fleet.drain(timeout=120)

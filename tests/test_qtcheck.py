@@ -333,6 +333,65 @@ class TestServe:
             assert rep.undonated_aliasable == [], rep.summary()
             assert rep.donated_bytes > 0
 
+    def _spec_engine(self, cfg, params, mesh=None, **kw):
+        from quintnet_tpu.serve import SpecConfig
+
+        return self._engine(cfg, params, mesh=mesh, spec=SpecConfig(),
+                            **kw)
+
+    def _verify_args(self, eng, params, k):
+        # one verify bucket's args: [S, k+1] token runs, per-row
+        # (start, tail_len), full tables, per-row key state
+        S = eng.max_slots
+        kp, vp = eng.pool.caches()
+        return (params, kp, vp,
+                jnp.asarray(np.zeros((S, k + 1), np.int32)),
+                jnp.asarray(np.zeros((S,), np.int32)),
+                jnp.asarray(np.ones((S,), np.int32)),
+                jnp.asarray(eng._tables), jnp.asarray(eng._key_data))
+
+    def test_verify_census_matches_decode_every_bucket(self, gpt2):
+        """The speculative verify programs (serve/spec.py) are the
+        decode step widened to k+1 tokens per row: single-device they
+        must be collective-free, under tp exactly the decode census —
+        2 row-parallel psums per layer, nothing else, identical for
+        EVERY draft-length bucket (the bucket only changes a
+        batch-like dim; the draft scatter/gather adds no
+        collectives)."""
+        cfg, params = gpt2
+        eng = self._engine(cfg, params)
+        assert eng.compile_stats() == {"prefill": 0, "decode": 0}
+        seng = self._spec_engine(cfg, params)
+        assert tuple(seng._verifies) == seng.spec.buckets
+        for k in seng.spec.buckets:
+            census = collective_census(
+                seng._verifies[k].fn, *self._verify_args(seng, params, k))
+            spec = census_specs.expected_serve_verify(cfg.n_layer)
+            assert census.diff(spec) == [], census.as_dict()
+            assert census.total() == 0
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        teng = self._spec_engine(cfg, params, mesh=mesh)
+        for k in teng.spec.buckets:
+            census = collective_census(
+                teng._verifies[k].fn, *self._verify_args(teng, params, k))
+            spec = census_specs.expected_serve_verify(cfg.n_layer,
+                                                      tp_axis="tp")
+            assert census.diff(spec) == [], census.as_dict()
+
+    def test_verify_donation_no_aliasable_misses(self, gpt2):
+        """Every verify bucket donates its aliasable buffers: the pool
+        caches update in place and the [S, P] ids row aliases the
+        candidate-token output (key_data does NOT alias — the chain
+        output is [S, P, keysize], a different shape)."""
+        cfg, params = gpt2
+        eng = self._spec_engine(cfg, params)
+        for k in eng.spec.buckets:
+            rep = donation_report(eng._verifies[k].fn,
+                                  *self._verify_args(eng, params, k))
+            assert rep.undonated_aliasable == [], rep.summary()
+            assert rep.donated_bytes > 0
+
 
 # ---------------------------------------------------------------------
 # recompile sentinel unit behaviour

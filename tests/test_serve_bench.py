@@ -4,10 +4,13 @@ contract — for the default Poisson trace AND the --prefix-share A/B
 mode — and the bench.py staleness scanner (test_bench_stale.py
 machinery) must surface the committed serve-bench artifacts the same
 way it surfaces training-throughput records. The committed
-artifacts/serve_r09.json additionally gates the PR's acceptance
+artifacts/serve_r09.json additionally gates the PR 5 acceptance
 numbers: shared-prefix cache-on >= 1.5x cache-off (or an equivalent
 TTFT reduction) with a nonzero hit rate, and the cache-off path no
-worse than PR 1's serve_r06.json record.
+worse than PR 1's serve_r06.json record. artifacts/serve_r10.json
+gates speculation the same way: spec-on >= 1.5x spec-off on the
+repetitive greedy trace, spec-off no worse than serve_r09's plain
+baseline.
 """
 
 import json
@@ -23,7 +26,9 @@ import bench  # noqa: E402
 
 SERVE_METRIC = "serve_gpt2_tiny_tokens_per_sec"
 PREFIX_METRIC = "serve_gpt2_tiny_prefix_share_tokens_per_sec"
+SPEC_METRIC = "serve_gpt2_tiny_spec_tokens_per_sec"
 R09 = os.path.join(REPO, "artifacts", "serve_r09.json")
+R10 = os.path.join(REPO, "artifacts", "serve_r10.json")
 
 
 @pytest.mark.fast
@@ -41,8 +46,10 @@ def test_serve_bench_smoke_cli():
     assert rec["rc"] == 0
     assert rec["unit"] == "tok/s"
     for k in ("ttft_p50_s", "ttft_p95_s", "peak_kv_utilization",
-              "decode_tokens", "prefill_tokens"):
+              "decode_tokens", "prefill_tokens", "gen_tokens",
+              "decode_steps", "tokens_per_decode_step"):
         assert k in rec["extras"], k
+    assert rec["extras"]["spec"] is False
 
 
 @pytest.mark.fast
@@ -115,6 +122,86 @@ def test_committed_prefix_share_artifact_meets_acceptance():
     with open(os.path.join(REPO, "artifacts", "serve_r06.json")) as f:
         r06 = [r for r in json.load(f) if r["metric"] == SERVE_METRIC]
     assert plain["value"] >= max(r["value"] for r in r06)
+
+
+@pytest.mark.fast
+def test_spec_smoke_cli():
+    """`serve_bench.py --spec-trace` runs the speculation-on vs
+    speculation-off A/B end-to-end on CPU (tiny trace, run to
+    completion so drafting has history to match) and reports the
+    comparison fields; `--spec on` works on the default trace too."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--synthetic", "--spec-trace", "--pattern", "0", "--seed", "1",
+         "--requests", "3", "--rate", "0.1", "--max-new", "24",
+         "--min-prompt", "6", "--max-prompt", "10", "--slots", "2"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == SPEC_METRIC
+    assert rec["rc"] == 0
+    e = rec["extras"]
+    for k in ("spec_off_tokens_per_sec", "speedup_vs_spec_off",
+              "draft_acceptance_rate", "accepted_draft_tokens",
+              "tokens_per_decode_step", "spec_off_tokens_per_decode_step",
+              "decode_steps", "spec_off_decode_steps", "max_draft"):
+        assert k in e, k
+    assert e["spec"] is True
+    assert e["finished"] == e["submitted"] == 3
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--steps", "3", "--synthetic", "--spec", "on"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == SERVE_METRIC
+    assert rec["extras"]["spec"] is True
+    assert "draft_acceptance_rate" in rec["extras"]
+
+
+@pytest.mark.fast
+def test_committed_spec_artifact_meets_acceptance():
+    """The committed serve_r10.json is the speculation PR's acceptance
+    evidence: spec-on >= 1.5x spec-off tok/s on the repetitive greedy
+    trace with a real acceptance rate and multi-token decode steps,
+    and the spec-off plain-trace record no worse than PR 5's
+    serve_r09.json baseline."""
+    with open(R10) as f:
+        records = json.load(f)
+    by_metric = {r["metric"]: r for r in records}
+
+    spec = by_metric[SPEC_METRIC]
+    e = spec["extras"]
+    assert e["speedup_vs_spec_off"] >= 1.5, (
+        f"speculation won only {e['speedup_vs_spec_off']}x")
+    assert e["draft_acceptance_rate"] > 0.5
+    assert e["accepted_draft_tokens"] > 0
+    # the structural win, independent of wall-clock noise: committed
+    # tokens per program invocation must be decisively multi-token
+    assert e["tokens_per_decode_step"] \
+        >= 2 * e["spec_off_tokens_per_decode_step"]
+
+    # spec-off baseline: the plain synthetic trace, speculation and
+    # prefix cache off — the verify-path rework must not regress the
+    # non-speculating engine
+    plain = by_metric[SERVE_METRIC]
+    assert plain["extras"]["spec"] is False
+    with open(R09) as f:
+        r09 = [r for r in json.load(f) if r["metric"] == SERVE_METRIC]
+    assert plain["value"] >= max(r["value"] for r in r09)
+
+
+@pytest.mark.fast
+def test_spec_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=SPEC_METRIC)
+    assert last is not None
+    assert last["metric"] == SPEC_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
 
 
 @pytest.mark.fast

@@ -19,13 +19,27 @@ Exp(rate)). Two trace shapes:
   cache exists for. This mode replays the SAME trace through a
   cache-ON and a cache-OFF engine and reports both: the record's value
   is cache-on tok/s, ``extras`` carries the cache-off numbers, the
-  speedup, and the hit rate.
+  speedup, and the hit rate;
+- ``--spec-trace``: repetitive prompts (each a short random pattern
+  tiled to length — templated/greedy-friendly text) where n-gram
+  self-drafting should accept long drafts. Replays the SAME trace
+  through a speculation-ON and a speculation-OFF engine (both greedy)
+  and reports both: the record's value is spec-on tok/s, ``extras``
+  carries the spec-off numbers, the speedup, the draft acceptance
+  rate and ``tokens_per_decode_step`` — the committed-tokens-per-
+  program-invocation number that makes the speculation win legible
+  without reading raw metrics.
+
+Every mode's extras carry ``decode_steps`` and
+``tokens_per_decode_step`` (decode_tokens / decode_steps).
 
 Modes:
   python tools/serve_bench.py --synthetic              # tiny cfg, CPU-ok
   python tools/serve_bench.py --synthetic --model llama
   python tools/serve_bench.py --synthetic --prefix-share
   python tools/serve_bench.py --synthetic --prefix-cache off   # A/B
+  python tools/serve_bench.py --synthetic --spec-trace         # A/B
+  python tools/serve_bench.py --synthetic --spec on    # default trace
   python tools/serve_bench.py --model gpt2             # 124M random init
   python tools/serve_bench.py --synthetic --steps 3    # smoke (CI runs
       this — tests/test_serve_bench.py — so the CLI can never rot)
@@ -47,10 +61,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine(args, *, prefix_cache: bool):
+def build_engine(args, *, prefix_cache: bool, spec: bool = False):
     import jax
 
-    from quintnet_tpu.serve import ServeEngine, gpt2_family, llama_family
+    from quintnet_tpu.serve import (ServeEngine, SpecConfig, gpt2_family,
+                                    llama_family)
 
     # synthetic-config overrides (--n-layer & co): the default tiny
     # model is too small for prefill compute to matter — the
@@ -88,7 +103,8 @@ def build_engine(args, *, prefix_cache: bool):
         family, params, max_slots=args.slots, block_size=args.block_size,
         num_blocks=args.num_blocks, max_seq_len=max_seq,
         eos_token_id=args.eos, temperature=args.temperature,
-        policy=args.policy, prefix_cache=prefix_cache)
+        policy=args.policy, prefix_cache=prefix_cache,
+        spec=SpecConfig(max_draft=args.max_draft) if spec else None)
 
 
 def poisson_arrivals(rng, n: int, rate: float):
@@ -109,6 +125,31 @@ def poisson_trace(args, vocab_size: int):
     for t in arrivals:
         n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
         prompt = rng.integers(0, vocab_size, (n,)).astype(np.int32)
+        trace.append((t, prompt, args.max_new))
+    return trace
+
+
+def repetitive_trace(args, vocab_size: int):
+    """Greedy-friendly prompts for the speculation A/B. ``--pattern N``
+    tiles a short random per-request pattern to the sampled prompt
+    length (templated/repetitive text); ``--pattern 0`` keeps prompts
+    random — with greedy sampling the draftable repetition then comes
+    from the CONTINUATIONS (greedy decoding settles into repetitive
+    runs/cycles, which is exactly the structure prompt-lookup drafts
+    from — long ``--max-new`` lets that phase dominate)."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    arrivals = poisson_arrivals(rng, args.requests, args.rate)
+    trace = []
+    for t in arrivals:
+        n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
+        if args.pattern > 0:
+            pat = rng.integers(0, vocab_size,
+                               (args.pattern,)).astype(np.int32)
+            prompt = np.tile(pat, -(-n // args.pattern))[:n]
+        else:
+            prompt = rng.integers(0, vocab_size, (n,)).astype(np.int32)
         trace.append((t, prompt, args.max_new))
     return trace
 
@@ -187,6 +228,9 @@ def _common_extras(args, s: dict) -> dict:
         "prefix_hit_tokens": s["prefix_hit_tokens"],
         "prefill_tokens_saved": s["prefill_tokens_saved"],
         "prefix_hit_rate": s["prefix_hit_rate"],
+        "gen_tokens": s["gen_tokens"],
+        "decode_steps": s["decode_steps"],
+        "tokens_per_decode_step": s["tokens_per_decode_step"],
         "wall_s": s["wall_s"],
         "model": args.model,
         "synthetic": bool(args.synthetic),
@@ -233,12 +277,58 @@ def run(args) -> dict:
             "extras": extras,
         }
 
+    if args.spec_trace:
+        # A/B over the SAME repetitive trace: speculation on vs off
+        eng_on = build_engine(args, prefix_cache=args.prefix_cache == "on",
+                              spec=True)
+        trace = repetitive_trace(args, eng_on.family.cfg.vocab_size)
+        s_on = replay(eng_on, trace, args)
+        eng_off = build_engine(args, prefix_cache=args.prefix_cache == "on",
+                               spec=False)
+        s_off = replay(eng_off, trace, args)
+        extras = _common_extras(args, s_on)
+        extras.update({
+            "spec_trace": True,
+            "spec": True,
+            "pattern": args.pattern,
+            "max_draft": args.max_draft,
+            "spec_steps": s_on["spec_steps"],
+            "draft_tokens": s_on["draft_tokens"],
+            "accepted_draft_tokens": s_on["accepted_draft_tokens"],
+            "draft_acceptance_rate": s_on["draft_acceptance_rate"],
+            "spec_off_tokens_per_sec": s_off["tokens_per_sec"],
+            "spec_off_decode_steps": s_off["decode_steps"],
+            "spec_off_tokens_per_decode_step":
+                s_off["tokens_per_decode_step"],
+            "spec_off_wall_s": s_off["wall_s"],
+            "speedup_vs_spec_off": (
+                round(s_on["tokens_per_sec"] / s_off["tokens_per_sec"], 3)
+                if s_off["tokens_per_sec"] else 0.0),
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_spec_tokens_per_sec",
+            "value": s_on["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": extras["speedup_vs_spec_off"],
+            "rc": 0,
+            "extras": extras,
+        }
+
     prefix_cache = args.prefix_cache == "on"
-    engine = build_engine(args, prefix_cache=prefix_cache)
+    spec = args.spec == "on"
+    engine = build_engine(args, prefix_cache=prefix_cache, spec=spec)
     trace = poisson_trace(args, engine.family.cfg.vocab_size)
     s = replay(engine, trace, args)
     extras = _common_extras(args, s)
     extras["prefix_cache"] = prefix_cache
+    extras["spec"] = spec
+    if spec:
+        extras.update({
+            "spec_steps": s["spec_steps"],
+            "draft_tokens": s["draft_tokens"],
+            "accepted_draft_tokens": s["accepted_draft_tokens"],
+            "draft_acceptance_rate": s["draft_acceptance_rate"],
+        })
     return {
         "metric": f"serve_{args.model}_{tag}_tokens_per_sec",
         "value": s["tokens_per_sec"],
@@ -273,6 +363,17 @@ def main():
     ap.add_argument("--prefix-share", action="store_true",
                     help="shared-system-prompt trace, reported cache-on "
                          "vs cache-off over the same trace")
+    ap.add_argument("--spec", default="off", choices=("on", "off"),
+                    help="speculative decoding (n-gram self-drafting + "
+                         "batched verify) for the default trace")
+    ap.add_argument("--spec-trace", action="store_true",
+                    help="repetitive greedy-friendly trace, reported "
+                         "spec-on vs spec-off over the same trace")
+    ap.add_argument("--pattern", type=int, default=8,
+                    help="repeated-pattern length (--spec-trace prompts)")
+    ap.add_argument("--max-draft", type=int, default=8,
+                    help="max drafted tokens per request per step "
+                         "(pins the largest verify bucket)")
     ap.add_argument("--shared-prefix", type=int, default=None,
                     help="shared system-prompt length (--prefix-share; "
                          "default 36 for --synthetic, 96 for full "
