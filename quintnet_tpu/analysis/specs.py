@@ -254,3 +254,33 @@ def expected_serve_verify(n_layers: int, *,
     bucket program must match this same spec."""
     return expected_serve_decode(n_layers, tp_axis=tp_axis,
                                  vocab_parallel=vocab_parallel)
+
+
+def lora_rank_buckets(max_rank: int, *, floor: int = 4) -> Tuple[int, ...]:
+    """THE canonical adapter-rank ladder for multi-tenant LoRA serving
+    (serve/adapters.py): powers of two from ``floor`` up to (and capped
+    at) ``max_rank``. The packed per-slot adapter tensors a decode step
+    ships ride a rank dimension padded to the smallest bucket covering
+    the batch's largest bound adapter, so adapters of ANY rank <=
+    ``max_rank`` join and leave with zero recompiles: the engine
+    compiles AT MOST one decode program per bucket (RecompileSentinel,
+    ``max_compiles=1`` each), and the bounded-compile invariant becomes
+    ``<= len(prefill_buckets) + len(verify_buckets) + 1 decode per rank
+    bucket``. Prefill and verify always run at the TOP bucket (one
+    request / already the widest program — re-bucketing them would
+    multiply their program count for no win), so their ladders are
+    unchanged. The per-slot low-rank deltas add NO collectives under tp
+    (column-target deltas are rank-local; row-target deltas ride the
+    existing RowParallel psum), so the expected_serve_* censuses above
+    hold for LoRA-enabled programs unchanged. Pinned here so engine,
+    census and compile-count tests derive the same ladder from the same
+    place."""
+    if max_rank < 1:
+        raise ValueError(f"max_rank must be >= 1; got {max_rank}")
+    out = []
+    b = floor
+    while b < max_rank:
+        out.append(b)
+        b *= 2
+    out.append(max_rank)
+    return tuple(out)

@@ -88,6 +88,22 @@ def main():
 
     from quintnet_tpu.models.gpt2_generate import gpt2_generate
 
+    # persist + reload through the safetensors round-trip BEFORE the
+    # merged-generate check — this is the exact artifact the serving
+    # AdapterRegistry consumes (serve/adapters.py), so the example
+    # exercises the file a tenant would actually deploy
+    import os
+    import tempfile
+
+    from quintnet_tpu.models.lora import load_lora, save_lora
+
+    path = os.path.join(tempfile.mkdtemp(prefix="lora_"),
+                        "adapters.safetensors")
+    save_lora(lora, lcfg, path)
+    lora, lcfg = load_lora(path)
+    print(f"saved + reloaded adapters via {path} "
+          f"({os.path.getsize(path)} bytes)")
+
     merged = lora_merge_tree(params, lora, lcfg)
     out = gpt2_generate(merged, np.asarray(ids[:1, :8]), cfg,
                         max_new_tokens=8)

@@ -8,7 +8,8 @@ operable under the two things production traffic guarantees — bursts
 and failures:
 
 - :mod:`router`    — least-outstanding-work routing (token-count load
-  proxy) or deterministic round_robin;
+  proxy) or deterministic round_robin, with an adapter-affinity
+  pre-filter for LoRA-bound requests (serve/adapters.py);
 - :mod:`admission` — bounded fleet-wide queue; overload and expired
   deadlines shed with a typed :class:`Overloaded` instead of queueing
   forever;

@@ -91,6 +91,14 @@ class AdmissionQueue:
             self._items = [i for i in self._items if i not in expired]
         return expired
 
+    def peek_adapter_id(self) -> Optional[str]:
+        """The queue head's LoRA binding (or None) — the dispatcher
+        reads it before :meth:`pop` so the router can apply adapter
+        affinity to the request it is about to place."""
+        if not self._items:
+            return None
+        return getattr(self._items[0], "adapter_id", None)
+
     def pop(self):
         """Head of the line, or None."""
         return self._items.pop(0) if self._items else None

@@ -9,7 +9,9 @@ traffic. The pieces:
 
 - **routing** (fleet/router.py): least-outstanding-work by token count
   (or round_robin), over replicas that are healthy, unpaused, and
-  below their dispatch window;
+  below their dispatch window — with a cheap adapter-affinity
+  pre-filter for LoRA-bound requests (prefer replicas whose registry
+  already holds the adapter resident, serve/adapters.py);
 - **admission** (fleet/admission.py): a bounded fleet-wide queue;
   overload and expired deadlines shed with a typed
   :class:`~quintnet_tpu.fleet.admission.Overloaded` instead of
@@ -53,7 +55,7 @@ class FleetRequest:
 
     def __init__(self, fid: int, prompt, max_new_tokens: int, *, key,
                  priority: int, deadline: Optional[float], on_token,
-                 submit_time: float, clock):
+                 submit_time: float, clock, adapter_id=None):
         self.fid = fid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -62,6 +64,7 @@ class FleetRequest:
         self.deadline = deadline          # absolute fleet-clock time
         self.on_token = on_token
         self.submit_time = submit_time
+        self.adapter_id = adapter_id      # LoRA binding (None = base)
         self._clock = clock
 
         self.progress = None              # RequestProgress after a death
@@ -203,7 +206,7 @@ class ServeFleet:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *, key=None,
                priority: int = 0, deadline_s: Optional[float] = None,
-               on_token=None) -> int:
+               on_token=None, adapter_id: Optional[str] = None) -> int:
         """Queue one request fleet-wide; returns its fleet id. Raises
         :class:`Overloaded` instead of queueing when the fleet is over
         capacity (``queue_full``), the deadline is unmeetable
@@ -215,7 +218,10 @@ class ServeFleet:
         from now; a request still queued when it expires is shed.
         ``on_token(fid, token, is_last)`` fires from a replica worker
         thread as tokens are produced, across migrations, each token
-        exactly once."""
+        exactly once. ``adapter_id``: serve through the named LoRA
+        adapter (serve/adapters.py) — the router prefers replicas
+        where the adapter is already resident; the binding survives
+        migration (a cold replica loads it on demand)."""
         import jax
 
         # requests the fleet could NEVER run fail fast here, like
@@ -225,6 +231,20 @@ class ServeFleet:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._replicas[0].engine._check_admissible(
             prompt, int(max_new_tokens))
+        if adapter_id is not None:
+            # registration check only — deliberately NOT
+            # validate_adapter, which would LOAD the weights into
+            # replica 0's registry as a side effect (skewing the
+            # router's affinity toward r0 and churning its LRU for
+            # requests that route elsewhere). Shape problems surface
+            # at the serving replica's ingest, which errors that
+            # request alone (_on_reject), never the replica.
+            reg = getattr(self._replicas[0].engine, "adapters", None)
+            if reg is None:
+                raise ValueError(
+                    "this fleet's engines were built without adapters; "
+                    "cannot serve adapter_id requests")
+            reg.entry(adapter_id)      # KeyError for unknown ids
         with self._cv:
             self.metrics.submitted += 1
             if self._draining or self._closed:
@@ -246,7 +266,8 @@ class ServeFleet:
                 priority=int(priority),
                 deadline=(None if deadline_s is None
                           else now + float(deadline_s)),
-                on_token=on_token, submit_time=now, clock=self.clock)
+                on_token=on_token, submit_time=now, clock=self.clock,
+                adapter_id=adapter_id)
             try:
                 self._queue.push(freq)
             except Overloaded:
@@ -393,7 +414,10 @@ class ServeFleet:
                      and r.in_flight < r.max_dispatch]
             if not cands:
                 return
-            rep = self._router.pick(cands)
+            # adapter affinity: peek the queue head's binding so the
+            # router can prefer adapter-warm replicas (fleet/router.py)
+            rep = self._router.pick(
+                cands, adapter_id=self._queue.peek_adapter_id())
             freq = self._queue.pop()
             freq.cost = freq.outstanding_cost()
             freq.replica_name = rep.name
@@ -544,8 +568,12 @@ class ServeFleet:
         are skipped unless ``include_idle``. Spec-enabled engines
         additionally carry ``verify[<k>]`` sentinels: at most one
         compile per draft-length bucket, any total from 0 (speculation
-        never triggered) to the bucket count — the fleet-wide bound is
-        ``prefill buckets + verify buckets + 1 decode`` per replica."""
+        never triggered) to the bucket count. Adapter-enabled engines
+        carry ``decode[r<rank>]`` sentinels instead of one ``decode``
+        — at most one compile per rank bucket, accounted like verify
+        (traffic decides which rank buckets trigger). The fleet-wide
+        bound is ``prefill buckets + verify buckets + decode rank
+        buckets (or 1 decode)`` per replica."""
         from quintnet_tpu.analysis.recompile import RecompileError
 
         expected: Dict[str, int] = {}
@@ -556,14 +584,27 @@ class ServeFleet:
             rep_sentinels = rep.engine.compile_sentinels()
             has_verify = any(k.startswith("verify[")
                              for k in rep_sentinels)
-            key = f"{rep.name}_decode"
-            # a spec-enabled replica whose every step speculated may
-            # legitimately never compile the plain decode program —
-            # 0 or `decode` compiles both keep the bound
-            if not (has_verify
-                    and rep_sentinels["decode"].compile_count == 0):
-                expected[key] = decode
-                sentinels[key] = rep_sentinels["decode"]
+            if "decode" not in rep_sentinels:
+                # adapter-enabled engine: rank-bucketed decode — at
+                # most one compile per bucket, any total up to the
+                # bucket count (which buckets fire is traffic-shaped)
+                per_decode = {kind: s.compile_count
+                              for kind, s in rep_sentinels.items()
+                              if kind.startswith("decode[")}
+                if any(n > 1 for n in per_decode.values()):
+                    raise RecompileError(
+                        f"replica {rep.name}: expected at most one "
+                        f"compiled decode program per LoRA rank "
+                        f"bucket, observed {per_decode}")
+            else:
+                key = f"{rep.name}_decode"
+                # a spec-enabled replica whose every step speculated
+                # may legitimately never compile the plain decode
+                # program — 0 or `decode` compiles both keep the bound
+                if not (has_verify
+                        and rep_sentinels["decode"].compile_count == 0):
+                    expected[key] = decode
+                    sentinels[key] = rep_sentinels["decode"]
             per_bucket = {kind: s.compile_count
                           for kind, s in rep_sentinels.items()
                           if kind.startswith("prefill[")}

@@ -76,6 +76,14 @@ class Replica:
     def paused(self) -> bool:
         return self._paused
 
+    def adapter_resident(self, adapter_id: str) -> bool:
+        """The router's affinity predicate: is the adapter's weight
+        tree resident in THIS replica's registry right now? (Registry
+        reads are registry-lock protected; the dispatcher calls this
+        under the fleet lock without touching engine state.)"""
+        reg = getattr(self.engine, "adapters", None)
+        return reg is not None and reg.is_resident(adapter_id)
+
     def enqueue(self, freq, progress=None) -> None:
         """Hand one fleet request (optionally with a migration resume
         payload) to the worker."""
@@ -135,8 +143,11 @@ class Replica:
         if progress is None:
             rid = self.engine.submit(
                 freq.prompt, freq.max_new_tokens, key=freq.key,
-                priority=freq.priority, on_token=deliver)
+                priority=freq.priority, on_token=deliver,
+                adapter_id=freq.adapter_id)
         else:
+            # progress carries the adapter binding; restore re-pins it
+            # from THIS replica's registry (loading on a cold replica)
             rid = self.engine.restore_progress(progress,
                                                on_token=deliver)
         self._rid2freq[rid] = freq

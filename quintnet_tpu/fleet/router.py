@@ -11,6 +11,16 @@ bursty traffic. ``round_robin`` is the deterministic baseline the
 bench compares against (and what tests use when they need to know
 exactly which replica got which request).
 
+Adapter affinity (multi-tenant LoRA, serve/adapters.py): a request
+bound to an adapter PREFERS replicas whose registry holds the adapter
+resident — serving it there skips a safetensors (re)load and keeps
+each tenant's working set warm on few replicas instead of thrashing
+every LRU. The affinity is a cheap candidate PRE-FILTER ahead of the
+load policy, never a hard constraint: when no candidate is warm (a
+brand-new tenant, or its replicas are busy/dead) the full candidate
+list stands and the chosen replica loads the adapter on demand — the
+same path fleet migration relies on.
+
 The router is pure policy: the fleet hands it the CANDIDATE list
 (healthy, unpaused, below their dispatch window) under the fleet lock
 and it picks one. Ties break on replica name so the choice is
@@ -19,7 +29,7 @@ reproducible.
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 POLICIES = ("least_work", "round_robin")
 
@@ -32,11 +42,20 @@ class Router:
         self.policy = policy
         self._rr = 0
 
-    def pick(self, candidates: List) -> "object":
+    def pick(self, candidates: List, *,
+             adapter_id: Optional[str] = None) -> "object":
         """Choose one replica from a non-empty candidate list. Each
-        candidate exposes ``outstanding_tokens`` and ``name``."""
+        candidate exposes ``outstanding_tokens``, ``name`` and
+        ``adapter_resident(adapter_id)``. ``adapter_id``: narrow to
+        the adapter-warm candidates first when any exist (see module
+        docstring), then apply the policy unchanged."""
         if not candidates:
             raise ValueError("pick() needs at least one candidate")
+        if adapter_id is not None:
+            warm = [r for r in candidates
+                    if r.adapter_resident(adapter_id)]
+            if warm:
+                candidates = warm
         if self.policy == "round_robin":
             choice = candidates[self._rr % len(candidates)]
             self._rr += 1

@@ -310,10 +310,16 @@ def llama_init(key, cfg: LlamaConfig, *, dtype=jnp.float32):
     return params
 
 
-def llama_qkv(p_attn, a_in, cfg: LlamaConfig, cos, sin, *, tp: int = 1):
+def llama_qkv(p_attn, a_in, cfg: LlamaConfig, cos, sin, *, tp: int = 1,
+              lora=None, lora_scale=None):
     """Projections + rope, shared by training forward, prefill and
     decode: normalized input [B, S, D] -> (q [B, Hq/tp, S, hd] rotated,
-    k [B, Hkv/tp, S, hd] rotated, v) — k/v UNrepeated (GQA)."""
+    k [B, Hkv/tp, S, hd] rotated, v) — k/v UNrepeated (GQA).
+
+    ``lora``/``lora_scale``: per-slot packed adapters for the serving
+    multi-LoRA path (nn/layers.lora_delta) — each present q/k/v target
+    adds its low-rank delta on the projection, BEFORE the head reshape
+    and rope (exactly where a merged weight would land)."""
     if cfg.n_heads % tp or cfg.n_kv_heads % tp:
         raise ValueError(
             f"tp={tp} must divide n_heads={cfg.n_heads} and "
@@ -321,19 +327,31 @@ def llama_qkv(p_attn, a_in, cfg: LlamaConfig, cos, sin, *, tp: int = 1):
     b, s, _ = a_in.shape
     hd = cfg.head_dim
 
-    def heads(w, n):
-        return jnp.dot(a_in, w).reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+    def heads(name, n):
+        y = jnp.dot(a_in, p_attn[name]["w"])
+        if lora is not None and name in lora:
+            from quintnet_tpu.nn.layers import lora_delta
 
-    q = apply_rope(heads(p_attn["q"]["w"], cfg.n_heads // tp), cos, sin)
-    k = apply_rope(heads(p_attn["k"]["w"], cfg.n_kv_heads // tp), cos, sin)
-    return q, k, heads(p_attn["v"]["w"], cfg.n_kv_heads // tp)
+            y = y + lora_delta(a_in, lora[name], lora_scale)
+        return y.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
+
+    q = apply_rope(heads("q", cfg.n_heads // tp), cos, sin)
+    k = apply_rope(heads("k", cfg.n_kv_heads // tp), cos, sin)
+    return q, k, heads("v", cfg.n_kv_heads // tp)
 
 
-def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None):
-    """[B, H, S, hd] attention output -> o-proj (+tp psum) + residual."""
+def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None,
+                        lora=None, lora_scale=None):
+    """[B, H, S, hd] attention output -> o-proj (+tp psum) + residual.
+    ``lora``: an ``o`` target adds its per-slot delta before the psum
+    (row-parallel partial sums compose — nn/layers.lora_delta)."""
     b = o.shape[0]
     o = o.transpose(0, 2, 1, 3).reshape(b, o.shape[2], -1)
     y = jnp.dot(o, p_attn["o"]["w"])
+    if lora is not None and "o" in lora:
+        from quintnet_tpu.nn.layers import lora_delta
+
+        y = y + lora_delta(o, lora["o"], lora_scale)
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     return x + y
@@ -341,16 +359,20 @@ def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None):
 
 def llama_mlp_residual(p, x, cfg: LlamaConfig, *,
                        tp_axis: Optional[str] = None,
-                       ep_axis: Optional[str] = None):
+                       ep_axis: Optional[str] = None,
+                       lora=None, lora_scale=None):
     """-> (x + FFN(ln2(x)), moe_aux) — aux is 0.0 for dense blocks.
     THE one FFN-residual implementation for training forward, prefill
-    and decode (a fix here fixes all three)."""
+    and decode (a fix here fixes all three). ``lora``: per-slot packed
+    gate/up/down adapters (serving multi-LoRA; MoE blocks have no LoRA
+    targets and ignore it)."""
     h = rms_norm_apply(p["ln2"], x, eps=cfg.rms_eps)
     if "moe" in p:
         y, aux = moe_apply(p["moe"], h, cfg.moe_args, ep_axis=ep_axis,
                            tp_axis=tp_axis)
         return x + y, aux
-    return x + swiglu_apply(p["mlp"], h, tp_axis=tp_axis), \
+    return x + swiglu_apply(p["mlp"], h, tp_axis=tp_axis, lora=lora,
+                            lora_scale=lora_scale), \
         jnp.zeros((), jnp.float32)
 
 
@@ -417,7 +439,8 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
                               cfg: LlamaConfig, cos, sin,
                               tp_axis: Optional[str] = None,
                               block_tables=None,
-                              block_size: Optional[int] = None):
+                              block_size: Optional[int] = None,
+                              lora=None, lora_scale=None):
     """Chunked prefill over the paged pool (the serve engine's
     prefix-cached path): x [1, P, D] tail hidden states at absolute
     ``positions`` [P], caches are flat pool views
@@ -427,12 +450,15 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
     and masks causally against absolute positions (exactly
     :func:`llama_block_decode`'s paged math, batched over the tail).
     ``cos``/``sin`` [P, hd] must be built from the SAME absolute
-    positions. Returns (x, (kc, vc))."""
+    positions. ``lora``/``lora_scale``: this layer's packed per-slot
+    adapters (serving multi-LoRA). Returns (x, (kc, vc))."""
     from quintnet_tpu.nn.attention import paged_gather, paged_prefill_update
 
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    attn_lora = lora.get("attn") if lora is not None else None
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
-    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
+                        lora=attn_lora, lora_scale=lora_scale)
     kc, vc = paged_prefill_update(kc, vc, k[0], v[0], positions, tail_len,
                                   block_tables=block_tables,
                                   block_size=block_size)
@@ -447,8 +473,12 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
     scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
     o = jnp.einsum("bhqt,bhtd->bhqd",
                    jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
-    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
-    x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
+                            lora=attn_lora, lora_scale=lora_scale)
+    x, _aux = llama_mlp_residual(
+        p, x, cfg, tp_axis=tp_axis,
+        lora=lora.get("mlp") if lora is not None else None,
+        lora_scale=lora_scale)
     return x, (kc, vc)
 
 
@@ -456,7 +486,8 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
                              cfg: LlamaConfig, cos, sin,
                              tp_axis: Optional[str] = None,
                              block_tables=None,
-                             block_size: Optional[int] = None):
+                             block_size: Optional[int] = None,
+                             lora=None, lora_scale=None):
     """Batched draft-verify block step over the paged pool (the serve
     engine's speculative-decode scoring path, serve/spec.py): x
     [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
@@ -467,12 +498,15 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
     masks causally against absolute positions — exactly
     :func:`llama_block_decode`'s paged math widened from 1 to P tokens
     per row. ``cos``/``sin`` [S, 1, P, hd] must be built from the SAME
-    absolute positions. Returns (x, (kc, vc))."""
+    absolute positions. ``lora``/``lora_scale``: this layer's packed
+    per-slot adapters. Returns (x, (kc, vc))."""
     from quintnet_tpu.nn.attention import paged_gather, paged_verify_update
 
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    attn_lora = lora.get("attn") if lora is not None else None
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
-    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
+                        lora=attn_lora, lora_scale=lora_scale)
     kc, vc = paged_verify_update(kc, vc, k, v, positions, tail_lens,
                                  block_tables=block_tables,
                                  block_size=block_size)
@@ -487,14 +521,19 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
     scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
     o = jnp.einsum("bhqt,bhtd->bhqd",
                    jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
-    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
-    x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
+                            lora=attn_lora, lora_scale=lora_scale)
+    x, _aux = llama_mlp_residual(
+        p, x, cfg, tp_axis=tp_axis,
+        lora=lora.get("mlp") if lora is not None else None,
+        lora_scale=lora_scale)
     return x, (kc, vc)
 
 
 def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
                        tp_axis: Optional[str] = None,
-                       block_tables=None, block_size: Optional[int] = None):
+                       block_tables=None, block_size: Optional[int] = None,
+                       lora=None, lora_scale=None):
     """One cached token: x [B, 1, D], caches [B, Hkv(/tp), T, hd] ->
     (x, updated caches). Masked attention over cache[:pos].
 
@@ -503,10 +542,13 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
     shared across requests, ``pos`` is a [B] vector, and the caller
     supplies per-row rope tables (cos/sin [B, 1, 1, hd]). The cache
     stays UNrepeated either way — kv-head repeat happens on the
-    gathered view."""
+    gathered view. ``lora``/``lora_scale``: this layer's packed
+    per-slot adapters (multi-tenant LoRA serving)."""
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    attn_lora = lora.get("attn") if lora is not None else None
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
-    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
+                        lora=attn_lora, lora_scale=lora_scale)
     if block_tables is None:
         kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos,
                                              axis=2)
@@ -532,8 +574,12 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
     scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
     o = jnp.einsum("bhqt,bhtd->bhqd",
                    jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
-    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
-    x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
+                            lora=attn_lora, lora_scale=lora_scale)
+    x, _aux = llama_mlp_residual(
+        p, x, cfg, tp_axis=tp_axis,
+        lora=lora.get("mlp") if lora is not None else None,
+        lora_scale=lora_scale)
     return x, (kc, vc)
 
 

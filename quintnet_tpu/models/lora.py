@@ -48,6 +48,19 @@ class LoRAConfig:
     # cover attention qkv/proj and both MLP matmuls
     targets: Tuple[str, ...] = DEFAULT_TARGETS
 
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"LoRA rank must be >= 1; got {self.rank}")
+        bad = [t for t in self.targets if "," in t]
+        if bad:
+            # save_lora serialises targets comma-joined in the
+            # safetensors header; a comma inside a name would split
+            # into phantom targets on reload
+            raise ValueError(
+                f"LoRA target names must not contain ',': {bad}")
+        if not self.targets:
+            raise ValueError("LoRA targets must be non-empty")
+
     @property
     def scale(self) -> float:
         return self.alpha / self.rank

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from einops import rearrange
 
-from quintnet_tpu.nn.layers import linear_init, linear_apply
+from quintnet_tpu.nn.layers import linear_init, linear_apply, lora_delta
 
 
 def mha_init(key, dim: int, *, qkv_bias: bool = True, dtype=jnp.float32):
@@ -256,7 +256,8 @@ def paged_prefill_update(k_cache, v_cache, k, v, positions, tail_len, *,
 
 def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                       num_heads: int, tp_axis: Optional[str] = None,
-                      block_tables=None, block_size: Optional[int] = None):
+                      block_tables=None, block_size: Optional[int] = None,
+                      lora=None, lora_scale=None):
     """Chunked prefill over the paged pool: attention for ONE request's
     uncached tail, reading the cached prefix from pool blocks.
 
@@ -273,8 +274,14 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
 
     Returns (y [1, P, D], k_cache, v_cache). ``num_heads`` is LOCAL
     heads under ``tp_axis`` (head-sharded pool + RowParallel psum, same
-    as the decode path)."""
+    as the decode path).
+
+    ``lora``/``lora_scale``: per-slot packed adapters (serving
+    multi-LoRA; nn/layers.lora_delta) — qkv's delta lands before the
+    head split, proj's before the psum."""
     qkv = linear_apply(p["qkv"], x)  # [1, P, 3*D_local]
+    if lora is not None and "qkv" in lora:
+        qkv = qkv + lora_delta(x, lora["qkv"], lora_scale)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
@@ -299,6 +306,8 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
+    if lora is not None and "proj" in lora:
+        y = y + lora_delta(o, lora["proj"], lora_scale)
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
@@ -331,7 +340,8 @@ def paged_verify_update(k_cache, v_cache, k, v, positions, tail_lens, *,
 
 def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
                      num_heads: int, tp_axis: Optional[str] = None,
-                     block_tables=None, block_size: Optional[int] = None):
+                     block_tables=None, block_size: Optional[int] = None,
+                     lora=None, lora_scale=None):
     """Batched draft-verify attention over the paged pool: EVERY slot
     scores a short run of tokens (its last sampled token + up to k
     drafted continuations) against its own cached row in ONE forward —
@@ -350,8 +360,12 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
     decoded ones.
 
     Returns (y [S, P, D], k_cache, v_cache). ``num_heads`` is LOCAL
-    heads under ``tp_axis`` (head-sharded pool + RowParallel psum)."""
+    heads under ``tp_axis`` (head-sharded pool + RowParallel psum).
+    ``lora``/``lora_scale``: per-slot packed adapters, exactly as in
+    :func:`mha_decode`."""
     qkv = linear_apply(p["qkv"], x)  # [S, P, 3*D_local]
+    if lora is not None and "qkv" in lora:
+        qkv = qkv + lora_delta(x, lora["qkv"], lora_scale)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
@@ -373,6 +387,8 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
+    if lora is not None and "proj" in lora:
+        y = y + lora_delta(o, lora["proj"], lora_scale)
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
@@ -382,7 +398,8 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
 
 def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                tp_axis: Optional[str] = None,
-               block_tables=None, block_size: Optional[int] = None):
+               block_tables=None, block_size: Optional[int] = None,
+               lora=None, lora_scale=None):
     """Single-token cached attention. Returns (y, k_cache, v_cache).
 
     Dense (single-request fast path, ``block_tables=None``): x [B, 1, D],
@@ -409,8 +426,15 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
     cache holds this rank's heads, and the output projection psums over
     the axis (RowParallel, same as mha_apply's training path). The
     reference skips generation entirely under any parallelism
-    (GPT2_Trainer.py:509-555)."""
+    (GPT2_Trainer.py:509-555).
+
+    ``lora``/``lora_scale``: per-slot packed adapters (multi-tenant
+    LoRA serving, serve/adapters.py) — row s applies ITS adapter's
+    low-rank delta on the qkv and proj matmuls (nn/layers.lora_delta);
+    zero-adapter rows are base-model rows exactly."""
     qkv = linear_apply(p["qkv"], x)  # [B, 1, 3D]
+    if lora is not None and "qkv" in lora:
+        qkv = qkv + lora_delta(x, lora["qkv"], lora_scale)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
@@ -439,6 +463,8 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
+    if lora is not None and "proj" in lora:
+        y = y + lora_delta(o, lora["proj"], lora_scale)
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:

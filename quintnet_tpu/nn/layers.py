@@ -80,6 +80,30 @@ def linear_apply(p, x, *, precision=None):
     return y
 
 
+def lora_delta(x, node, scale):
+    """Per-slot batched low-rank delta for multi-tenant LoRA serving
+    (serve/adapters.py; the Punica/S-LoRA batched-gather matmul): each
+    row of the batch applies ITS OWN adapter.
+
+    ``x``: [S, T, in] per-slot activations; ``node``: packed adapters
+    ``{"a": [S, in, r], "b": [S, r, out]}`` (zero rows for base-model
+    slots — the KV pool's null-object trick applied to weights: a zero
+    adapter contributes an exactly-zero delta); ``scale``: [S] per-slot
+    ``alpha / rank``. Returns ``scale_s * (x_s @ a_s) @ b_s`` as
+    [S, T, out], cast back to ``x.dtype`` so the targeted matmul's
+    dtype story is unchanged.
+
+    Under tp the delta composes with the Megatron sharding exactly like
+    models/lora.py's merge: for a column-parallel target ``b`` arrives
+    out-sharded (the delta is the local columns' delta); for a
+    row-parallel target ``a`` arrives in-sharded and the local delta is
+    a PARTIAL sum that rides the layer's existing RowParallel psum — no
+    new collectives either way."""
+    h = jnp.einsum("std,sdr->str", x, node["a"])
+    return (jnp.einsum("str,sro->sto", h, node["b"])
+            * scale[:, None, None]).astype(x.dtype)
+
+
 def layer_norm_init(dim: int, dtype=jnp.float32):
     return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
 
@@ -121,11 +145,25 @@ def swiglu_init(key, dim: int, hidden: int, *, dtype=jnp.float32):
     }
 
 
-def swiglu_apply(p, x, *, tp_axis: Optional[str] = None):
+def swiglu_apply(p, x, *, tp_axis: Optional[str] = None, lora=None,
+                 lora_scale=None):
     """silu(x@gate) * (x@up) @ down, one psum after down under tp
-    (same ColumnParallel->RowParallel shape as mlp_apply)."""
-    h = jax.nn.silu(jnp.dot(x, p["gate"]["w"])) * jnp.dot(x, p["up"]["w"])
+    (same ColumnParallel->RowParallel shape as mlp_apply).
+
+    ``lora``/``lora_scale``: per-slot packed adapters for the serving
+    multi-LoRA path (:func:`lora_delta`) — each present target
+    (gate/up/down) adds its low-rank delta on that matmul, before the
+    activation/psum, exactly where a merged weight would land."""
+    g = jnp.dot(x, p["gate"]["w"])
+    u = jnp.dot(x, p["up"]["w"])
+    if lora is not None and "gate" in lora:
+        g = g + lora_delta(x, lora["gate"], lora_scale)
+    if lora is not None and "up" in lora:
+        u = u + lora_delta(x, lora["up"], lora_scale)
+    h = jax.nn.silu(g) * u
     y = jnp.dot(h, p["down"]["w"])
+    if lora is not None and "down" in lora:
+        y = y + lora_delta(h, lora["down"], lora_scale)
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     return y
@@ -180,7 +218,7 @@ def mlp_init(key, dim: int, hidden: int, *, dtype=jnp.float32):
 
 
 def mlp_apply(p, x, *, act=gelu, tp_axis: Optional[str] = None,
-              pdrop: float = 0.0, key=None):
+              pdrop: float = 0.0, key=None, lora=None, lora_scale=None):
     """With ``tp_axis``: fc weight is column-sharded [D, hidden/tp] and proj
     row-sharded [hidden/tp, D]; the single psum after proj reproduces the
     reference's ColumnParallel->RowParallel pair (gpt2_mlp.py:98-125).
@@ -188,10 +226,20 @@ def mlp_apply(p, x, *, act=gelu, tp_axis: Optional[str] = None,
     ``pdrop``/``key``: output dropout after the projection — the
     reference's post-c_proj Dropout (gpt2_mlp.py:124-160). Applied after
     the psum so the mask is identical on every tp rank (required: the
-    output is replicated)."""
+    output is replicated).
+
+    ``lora``/``lora_scale``: per-slot packed adapters (serving
+    multi-LoRA, :func:`lora_delta`) — fc's delta lands before the
+    activation, proj's before the psum, exactly where merged weights
+    would put them."""
     # fc bias is sharded with the columns, so it adds locally (no collective)
-    h = act(linear_apply(p["fc"], x))
+    h = linear_apply(p["fc"], x)
+    if lora is not None and "fc" in lora:
+        h = h + lora_delta(x, lora["fc"], lora_scale)
+    h = act(h)
     y = jnp.dot(h, p["proj"]["w"])
+    if lora is not None and "proj" in lora:
+        y = y + lora_delta(h, lora["proj"], lora_scale)
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:

@@ -227,14 +227,18 @@ def stacked_blocks_apply(
     return out
 
 
-def _block_mlp(p, x, *, act, moe_args, ep_axis, tp_axis):
-    """The MLP half of a block (dense or MoE, aux discarded)."""
+def _block_mlp(p, x, *, act, moe_args, ep_axis, tp_axis, lora=None,
+               lora_scale=None):
+    """The MLP half of a block (dense or MoE, aux discarded). ``lora``:
+    this layer's packed per-slot mlp adapters (fc/proj targets; serving
+    multi-LoRA) — MoE blocks have no LoRA targets and ignore it."""
     h = layer_norm_apply(p["ln2"], x)
     if moe_args is not None:
         y, _aux = moe_apply(p["moe"], h, moe_args, ep_axis=ep_axis,
                             tp_axis=tp_axis, act=act)
         return x + y
-    return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis)
+    return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis,
+                         lora=lora, lora_scale=lora_scale)
 
 
 def block_prefill(p, x, *, num_heads: int, act: Callable = gelu,
@@ -257,18 +261,25 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                         moe_args: Optional[MoEArgs] = None,
                         tp_axis: Optional[str] = None,
                         block_tables=None,
-                        block_size: Optional[int] = None):
+                        block_size: Optional[int] = None,
+                        lora=None, lora_scale=None):
     """Chunked-prefill block step over the paged pool (nn/attention.py
     mha_prefill_paged): x [1, P, D] tail hidden states at absolute
     ``positions``, caches are flat pool views — the serve engine's
-    prefix-cached prefill path. Returns (x, k_cache, v_cache)."""
+    prefix-cached prefill path. ``lora``/``lora_scale``: this layer's
+    packed per-slot adapters (serving multi-LoRA; serve/adapters.py).
+    Returns (x, k_cache, v_cache)."""
+    attn_lora = lora.get("attn") if lora is not None else None
     a, k_cache, v_cache = mha_prefill_paged(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
         positions, tail_len, num_heads=num_heads, tp_axis=tp_axis,
-        block_tables=block_tables, block_size=block_size)
+        block_tables=block_tables, block_size=block_size,
+        lora=attn_lora, lora_scale=lora_scale)
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis), k_cache, v_cache
+                      tp_axis=tp_axis,
+                      lora=lora.get("mlp") if lora is not None else None,
+                      lora_scale=lora_scale), k_cache, v_cache
 
 
 def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
@@ -276,34 +287,47 @@ def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
                        moe_args: Optional[MoEArgs] = None,
                        tp_axis: Optional[str] = None,
                        block_tables=None,
-                       block_size: Optional[int] = None):
+                       block_size: Optional[int] = None,
+                       lora=None, lora_scale=None):
     """Batched draft-verify block step (nn/attention.mha_verify_paged):
     x [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
     caches are flat pool views — the serve engine's speculative-decode
-    scoring path (serve/spec.py). Returns (x, k_cache, v_cache)."""
+    scoring path (serve/spec.py). ``lora``/``lora_scale``: this layer's
+    packed per-slot adapters. Returns (x, k_cache, v_cache)."""
+    attn_lora = lora.get("attn") if lora is not None else None
     a, k_cache, v_cache = mha_verify_paged(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
         positions, tail_lens, num_heads=num_heads, tp_axis=tp_axis,
-        block_tables=block_tables, block_size=block_size)
+        block_tables=block_tables, block_size=block_size,
+        lora=attn_lora, lora_scale=lora_scale)
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis), k_cache, v_cache
+                      tp_axis=tp_axis,
+                      lora=lora.get("mlp") if lora is not None else None,
+                      lora_scale=lora_scale), k_cache, v_cache
 
 
 def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                  act: Callable = gelu,
                  moe_args: Optional[MoEArgs] = None,
                  tp_axis: Optional[str] = None,
-                 block_tables=None, block_size: Optional[int] = None):
+                 block_tables=None, block_size: Optional[int] = None,
+                 lora=None, lora_scale=None):
     """Single-token cached block step (nn/attention.py mha_decode).
 
     With ``block_tables``/``block_size`` the caches are paged-pool flat
     views and ``pos`` is per-row — the continuous-batching decode path
-    (quintnet_tpu/serve/); default is the dense single-request cache."""
+    (quintnet_tpu/serve/); default is the dense single-request cache.
+    ``lora``/``lora_scale``: this layer's packed per-slot adapters
+    (multi-tenant LoRA serving)."""
+    attn_lora = lora.get("attn") if lora is not None else None
     a, k_cache, v_cache = mha_decode(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache, pos,
         num_heads=num_heads, tp_axis=tp_axis,
-        block_tables=block_tables, block_size=block_size)
+        block_tables=block_tables, block_size=block_size,
+        lora=attn_lora, lora_scale=lora_scale)
     x = x + a
     return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis), k_cache, v_cache
+                      tp_axis=tp_axis,
+                      lora=lora.get("mlp") if lora is not None else None,
+                      lora_scale=lora_scale), k_cache, v_cache

@@ -26,6 +26,11 @@ scheduling; vLLM-style paged KV blocks):
 - :mod:`families` — the GPT-2 / Llama model adapters (thin reuse of
   nn/attention.mha_decode's paged path and the generate modules'
   embed/logits helpers);
+- :mod:`adapters` — multi-tenant LoRA: an adapter registry (host-side
+  LRU of safetensors adapter weights, refcount pinning) + per-slot
+  packed low-rank factors so heterogeneous-adapter requests batch into
+  the SAME decode step (S-LoRA/Punica style), token-identical to
+  dedicated merged-weight engines;
 - :mod:`api` — blocking ``generate()`` + streaming per-token callbacks;
 - :mod:`metrics` — per-step counters and TTFT / tok/s percentiles.
 
@@ -33,6 +38,7 @@ tools/serve_bench.py replays a synthetic Poisson trace through the
 engine and emits a one-line JSON throughput/latency report.
 """
 
+from quintnet_tpu.serve.adapters import AdapterEntry, AdapterRegistry
 from quintnet_tpu.serve.api import generate, generate_stream
 from quintnet_tpu.serve.engine import ServeEngine
 from quintnet_tpu.serve.families import gpt2_family, llama_family
@@ -42,6 +48,8 @@ from quintnet_tpu.serve.scheduler import Request, RequestProgress, Scheduler
 from quintnet_tpu.serve.spec import NgramDrafter, SpecConfig
 
 __all__ = [
+    "AdapterEntry",
+    "AdapterRegistry",
     "AdmitPlan",
     "KVPool",
     "NgramDrafter",

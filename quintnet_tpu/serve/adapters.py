@@ -1,0 +1,390 @@
+"""Multi-tenant LoRA serving: the adapter registry + batch packing.
+
+Millions of users realistically means thousands of fine-tuned variants
+of ONE base model. Merging each adapter into dedicated weights would
+cost a full replica per tenant; S-LoRA (Sheng et al., 2023) and Punica
+(Chen et al., 2023) show the alternative: keep the base model shared,
+keep adapters as separate low-rank factors, and batch
+heterogeneous-adapter requests into the SAME forward by computing each
+row's delta ``scale * (x @ A_slot) @ B_slot`` with gathered/batched
+low-rank matmuls. This module is the host side of that design:
+
+- :class:`AdapterRegistry` — adapters by id, loaded from
+  :func:`~quintnet_tpu.models.lora.save_lora` safetensors files (or
+  registered directly as in-memory trees). Weights are a host-side LRU
+  under an optional ``byte_budget``: entries evicted under pressure
+  keep their registration and RELOAD from their source file on the
+  next acquire, so a replica that has never served (or has forgotten)
+  an adapter warms it on demand — the fleet's migration path. Per-
+  adapter REFCOUNTS pin the working set: an adapter held by any
+  in-flight request is never an eviction candidate.
+- packing helpers — the engine binds one adapter per slot and packs
+  the batch's adapters into stacked ``[L, S, in, r]`` / ``[L, S, r,
+  out]`` tensors per target matmul (zero rows for base-model slots:
+  the same null-object trick as the KV pool's null block — a zero
+  adapter IS the base model). The rank dimension is padded to a bucket
+  from the ladder pinned in ``analysis/specs.lora_rank_buckets``, so
+  adapters of any rank join and leave with ZERO recompiles.
+
+The compiled-program side lives in serve/engine.py + serve/families.py
+(per-slot deltas on every targeted matmul inside the existing prefill/
+decode/verify programs — nn/layers.lora_delta); the golden contract is
+pinned in tests/test_adapters.py: every request's output is
+token-identical to a dedicated engine serving that adapter's
+``lora_merge_tree`` merged weights, greedy and sampled, including with
+prefix cache on, speculation on, preemption, and migration onto a
+replica that has never seen the adapter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from quintnet_tpu.models.lora import LoRAConfig, _get, _target_paths
+
+
+def adapter_paths(blocks, targets: Sequence[str]) -> List[Tuple[str, ...]]:
+    """Target paths (tuples of dict keys) of every adapted linear in a
+    stacked block tree — the engine's packed-tensor layout is one
+    (a, b) pair per path, in this order."""
+    return _target_paths(blocks, targets)
+
+
+def adapter_factor_paths(tree) -> List[Tuple[str, ...]]:
+    """Paths of every (a, b) factor pair in a LOADED adapter tree —
+    what the adapter actually trained, regardless of what an engine is
+    configured to serve. The engine rejects adapters carrying factors
+    at paths outside its packed set (silently dropping a trained
+    target would break the merged-weights parity contract)."""
+    out: List[Tuple[str, ...]] = []
+
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return
+        if "a" in node and "b" in node \
+                and not isinstance(node["a"], dict):
+            out.append(path)
+            return
+        for k, v in node.items():
+            walk(v, path + (k,))
+
+    walk(tree, ())
+    return out
+
+
+def tree_at(tree, path):
+    """``tree[path[0]]...[path[-1]]`` or None when any key is missing
+    (an adapter that trains a subset of the engine's targets simply
+    contributes zero deltas at the rest)."""
+    node = tree
+    for k in path:
+        if not isinstance(node, dict) or k not in node:
+            return None
+        node = node[k]
+    return node
+
+
+def nest(flat: Dict[Tuple[str, ...], object]) -> Dict:
+    """{path: leaf} -> nested dict (the pytree the compiled programs
+    take; mirrors the block-param structure so families route subtrees
+    by name)."""
+    out: Dict = {}
+    for path, leaf in flat.items():
+        node = out
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = leaf
+    return out
+
+
+def packed_lora_spec_flat(block_specs, paths: Sequence[Tuple[str, ...]]):
+    """{path: {"a": spec, "b": spec}} for the PACKED per-slot adapter
+    tensors, derived from the stacked weight specs exactly like
+    models/lora.lora_partition_specs derives the training specs: for a
+    target weight spec over ``[L, in, out]``, the packed
+    ``a [L, S, in, r]`` inherits the in-dim sharding and
+    ``b [L, S, r, out]`` the out-dim sharding (rank and slot dims
+    unsharded). Column-parallel targets then compute their local
+    columns' delta; row-parallel targets compute a partial delta the
+    layer's existing RowParallel psum completes — no new collectives
+    (analysis/specs.lora_rank_buckets docstring)."""
+    from jax.sharding import PartitionSpec as P
+
+    flat = {}
+    for path in paths:
+        wspec = tuple(_get(block_specs, path)["w"])
+        wspec = wspec + (None,) * (3 - len(wspec))
+        flat[path] = {"a": P(None, None, wspec[-2], None),
+                      "b": P(None, None, None, wspec[-1])}
+    return flat
+
+
+def packed_lora_specs(block_specs, paths: Sequence[Tuple[str, ...]]):
+    """:func:`packed_lora_spec_flat` nested into the pytree shape the
+    compiled programs take (shard_map in_specs)."""
+    return nest(packed_lora_spec_flat(block_specs, paths))
+
+
+@dataclass
+class AdapterEntry:
+    """One registered adapter: identity + metadata always, weights only
+    while resident. ``refs`` counts in-flight pins (engine requests
+    holding the adapter); ``source`` is the safetensors path weights
+    reload from after an eviction (entries registered from an
+    in-memory tree have no source and are never evicted)."""
+
+    adapter_id: str
+    cfg: LoRAConfig
+    source: Optional[str] = None
+    tree: Optional[Dict] = None            # None <=> evicted
+    nbytes: int = 0
+    refs: int = 0
+    last_used: float = 0.0
+    loads: int = 0                         # times brought resident
+
+    @property
+    def rank(self) -> int:
+        return self.cfg.rank
+
+    @property
+    def scale(self) -> float:
+        return self.cfg.scale
+
+    @property
+    def resident(self) -> bool:
+        return self.tree is not None
+
+    @property
+    def evictable(self) -> bool:
+        return self.resident and self.refs == 0 and self.source is not None
+
+
+def _tree_nbytes(tree) -> int:
+    total = 0
+
+    def walk(node):
+        nonlocal total
+        for v in node.values():
+            if isinstance(v, dict):
+                walk(v)
+            else:
+                total += int(np.asarray(v).nbytes)
+
+    walk(tree)
+    return total
+
+
+class AdapterRegistry:
+    """Host-side adapter store: register/evict by id, LRU weights under
+    a byte budget, refcount pinning (see module docstring).
+
+    Thread-safe — fleet replicas ingest on worker threads while the
+    dispatcher reads residency for affinity routing. One registry per
+    engine is the intended shape (per-replica LRU state is what the
+    router's affinity pre-filter keys on); sharing one across replicas
+    is safe but makes residency fleet-global and pins leak when a
+    replica dies without releasing.
+
+    ``byte_budget``: resident-weight ceiling in bytes (None =
+    unbounded). The budget bounds the LRU cache, not the pinned working
+    set: when every resident adapter is pinned the registry runs over
+    budget rather than failing in-flight requests — eviction resumes as
+    soon as pins release."""
+
+    def __init__(self, *, byte_budget: Optional[int] = None,
+                 clock=time.monotonic):
+        if byte_budget is not None and byte_budget <= 0:
+            raise ValueError(f"byte_budget must be positive or None; "
+                             f"got {byte_budget}")
+        self.byte_budget = byte_budget
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._entries: Dict[str, AdapterEntry] = {}
+        self.evictions = 0
+
+    # ---- registration ------------------------------------------------
+    def register(self, adapter_id: str, source: Optional[str] = None, *,
+                 tree: Optional[Dict] = None,
+                 cfg: Optional[LoRAConfig] = None) -> AdapterEntry:
+        """Make ``adapter_id`` servable: either from a ``save_lora``
+        safetensors file (``source`` — weights load now and can
+        reload after eviction) or from an in-memory ``(tree, cfg)``
+        pair (pinned resident: no source to reload from, so the LRU
+        never evicts it). Re-registering an existing id raises —
+        evict/unregister first; silently swapping weights under
+        in-flight requests would break the parity contract."""
+        if not adapter_id or "\x00" in adapter_id:
+            raise ValueError(f"invalid adapter id {adapter_id!r}")
+        if source is not None and (tree is not None or cfg is not None):
+            # ambiguous: the file and the in-memory tree could differ,
+            # and silently preferring one would serve weights the
+            # caller did not intend (the parity contract's worst case)
+            raise ValueError(
+                "register() takes a safetensors source path OR an "
+                "in-memory (tree, cfg) pair, not both")
+        with self._lock:
+            if adapter_id in self._entries:
+                raise ValueError(f"adapter {adapter_id!r} is already "
+                                 f"registered")
+            if source is not None:
+                from quintnet_tpu.models.lora import load_lora
+
+                tree, cfg = load_lora(source)
+            elif tree is None or cfg is None:
+                raise ValueError(
+                    "register() needs a safetensors source path or an "
+                    "explicit (tree, cfg) pair")
+            entry = AdapterEntry(adapter_id=adapter_id, cfg=cfg,
+                                 source=source, tree=tree,
+                                 nbytes=_tree_nbytes(tree), loads=1,
+                                 last_used=self.clock())
+            self._entries[adapter_id] = entry
+            self._shrink_to_budget(keep=adapter_id)
+            return entry
+
+    def unregister(self, adapter_id: str) -> None:
+        """Forget the adapter entirely (refuses while pinned)."""
+        with self._lock:
+            entry = self._require(adapter_id)
+            if entry.refs > 0:
+                raise ValueError(
+                    f"adapter {adapter_id!r} is pinned by {entry.refs} "
+                    f"in-flight request(s); cannot unregister")
+            del self._entries[adapter_id]
+
+    # ---- residency / LRU --------------------------------------------
+    def _require(self, adapter_id: str) -> AdapterEntry:
+        entry = self._entries.get(adapter_id)
+        if entry is None:
+            raise KeyError(f"unknown adapter id {adapter_id!r} "
+                           f"(registered: {sorted(self._entries)})")
+        return entry
+
+    def _shrink_to_budget(self, keep: Optional[str] = None) -> None:
+        if self.byte_budget is None:
+            return
+        while self.bytes_resident > self.byte_budget:
+            cands = [e for e in self._entries.values()
+                     if e.evictable and e.adapter_id != keep]
+            if not cands:
+                return  # everything left is pinned/unreloadable
+            victim = min(cands, key=lambda e: e.last_used)
+            self._evict_entry(victim)
+
+    def _evict_entry(self, entry: AdapterEntry) -> None:
+        entry.tree = None
+        self.evictions += 1
+
+    def ensure_resident(self, adapter_id: str) -> AdapterEntry:
+        """Touch + (re)load without pinning — the validation /
+        prewarming path."""
+        with self._lock:
+            entry = self._require(adapter_id)
+            if not entry.resident:
+                from quintnet_tpu.models.lora import load_lora
+
+                tree, cfg = load_lora(entry.source)
+                if cfg != entry.cfg:
+                    # rank, alpha AND targets must match: serving new
+                    # factors under a stale registered scale (or a
+                    # different target set) would be neither the old
+                    # nor the new adapter
+                    raise ValueError(
+                        f"adapter {adapter_id!r} changed on disk: "
+                        f"reloaded config {cfg} != registered "
+                        f"{entry.cfg}; unregister and re-register to "
+                        f"pick up the new weights")
+                entry.tree = tree
+                entry.nbytes = _tree_nbytes(tree)
+                entry.loads += 1
+            entry.last_used = self.clock()
+            self._shrink_to_budget(keep=adapter_id)
+            return entry
+
+    def acquire(self, adapter_id: str) -> AdapterEntry:
+        """Pin for one in-flight request: loads if evicted, bumps the
+        refcount — a pinned adapter is never an eviction candidate.
+        Pair with :meth:`release` when the request retires."""
+        with self._lock:
+            entry = self.ensure_resident(adapter_id)
+            entry.refs += 1
+            return entry
+
+    def release(self, adapter_id: str) -> None:
+        with self._lock:
+            entry = self._require(adapter_id)
+            if entry.refs <= 0:
+                raise ValueError(
+                    f"adapter {adapter_id!r} released more times than "
+                    f"acquired")
+            entry.refs -= 1
+            self._shrink_to_budget()
+
+    def evict(self, adapter_id: str) -> None:
+        """Drop the weights now (registration and reload source stay).
+        Refuses while pinned and for sourceless entries — both would
+        lose state someone still needs."""
+        with self._lock:
+            entry = self._require(adapter_id)
+            if not entry.resident:
+                return
+            if entry.refs > 0:
+                raise ValueError(
+                    f"adapter {adapter_id!r} is pinned by {entry.refs} "
+                    f"in-flight request(s); cannot evict")
+            if entry.source is None:
+                raise ValueError(
+                    f"adapter {adapter_id!r} was registered from an "
+                    f"in-memory tree (no reload source); unregister "
+                    f"instead of evicting")
+            self._evict_entry(entry)
+
+    # ---- introspection ----------------------------------------------
+    def entry(self, adapter_id: str) -> AdapterEntry:
+        with self._lock:
+            return self._require(adapter_id)
+
+    def is_registered(self, adapter_id: str) -> bool:
+        with self._lock:
+            return adapter_id in self._entries
+
+    def is_resident(self, adapter_id: str) -> bool:
+        """The router's affinity predicate: can this replica serve the
+        adapter without a (re)load?"""
+        with self._lock:
+            entry = self._entries.get(adapter_id)
+            return entry is not None and entry.resident
+
+    @property
+    def adapter_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    @property
+    def resident_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(a for a, e in self._entries.items()
+                          if e.resident)
+
+    @property
+    def bytes_resident(self) -> int:
+        return sum(e.nbytes for e in self._entries.values() if e.resident)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "registered": len(self._entries),
+                "resident": sum(1 for e in self._entries.values()
+                                if e.resident),
+                "pinned": sum(1 for e in self._entries.values()
+                              if e.refs > 0),
+                "bytes_resident": self.bytes_resident,
+                "byte_budget": self.byte_budget,
+                "evictions": self.evictions,
+                "loads": sum(e.loads for e in self._entries.values()),
+            }

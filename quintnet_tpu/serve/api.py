@@ -23,6 +23,7 @@ from quintnet_tpu.serve.scheduler import FINISHED
 
 def generate(engine: ServeEngine, prompts: Sequence, *,
              max_new_tokens, keys=None, priorities=None,
+             adapter_ids=None,
              max_steps: Optional[int] = None) -> List[np.ndarray]:
     """Run ``prompts`` through the engine to completion; returns one
     [T0_i + n_generated_i] array per prompt (order preserved).
@@ -31,6 +32,8 @@ def generate(engine: ServeEngine, prompts: Sequence, *,
     ``keys``: optional per-prompt sampling keys — pass the keys the
     equivalent independent ``gpt2_generate``/``llama_generate`` calls
     would use to get token-identical output (the golden contract).
+    ``adapter_ids``: optional per-prompt LoRA bindings
+    (serve/adapters.py; None entries ride the base model).
     Rows stop early at the engine's ``eos_token_id``, so unlike the
     dense decoder the output is NOT padded to a rectangle."""
     n = len(prompts)
@@ -40,11 +43,14 @@ def generate(engine: ServeEngine, prompts: Sequence, *,
         keys = [None] * n
     if priorities is None:
         priorities = [0] * n
-    if not (len(max_new_tokens) == len(keys) == len(priorities) == n):
+    if adapter_ids is None:
+        adapter_ids = [None] * n
+    if not (len(max_new_tokens) == len(keys) == len(priorities)
+            == len(adapter_ids) == n):
         raise ValueError("per-prompt argument lengths must match prompts")
-    rids = [engine.submit(p, m, key=k, priority=pr)
-            for p, m, k, pr in zip(prompts, max_new_tokens, keys,
-                                   priorities)]
+    rids = [engine.submit(p, m, key=k, priority=pr, adapter_id=a)
+            for p, m, k, pr, a in zip(prompts, max_new_tokens, keys,
+                                      priorities, adapter_ids)]
     engine.run(max_steps=max_steps)
     unfinished = [r for r in rids if engine.request(r).state != FINISHED]
     if unfinished:

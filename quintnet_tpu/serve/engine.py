@@ -28,11 +28,26 @@ compiled programs:
   bit-identical to plain decoding (greedy and sampled — see
   serve/spec.py for the key-chain argument).
 
-The no-recompile invariant is now per program: ONE decode program and
-AT MOST ``len(prefill_buckets)`` prefill programs per (model, mesh)
-config, each behind its own RecompileSentinel with ``max_compiles=1``
-(tests/test_serve.py additionally observes zero backend compiles over
-a mixed trace via a jax.monitoring hook).
+Multi-tenant LoRA (``adapters=AdapterRegistry(...)``,
+serve/adapters.py): each engine slot binds one adapter id; the
+registry's weights are packed per admission into stacked per-slot
+``[L, S, in, r]``/``[L, S, r, out]`` factors (zero rows for base-model
+slots — the null-object trick again) and EVERY program above adds each
+row's low-rank delta ``scale * (x @ A_slot) @ B_slot`` on the targeted
+matmuls (nn/layers.lora_delta). Heterogeneous tenants share one decode
+step at base-model batching; the prefix cache namespaces its index by
+adapter so cross-tenant token coincidences can never alias KV. Golden
+contract: every request's output is token-identical to a dedicated
+engine serving that adapter's ``lora_merge_tree`` merged weights
+(tests/test_adapters.py).
+
+The no-recompile invariant is now per program: ONE decode program
+(adapter-blind engines; one per ``analysis/specs.lora_rank_buckets``
+rank bucket with adapters armed) and AT MOST ``len(prefill_buckets)``
+prefill programs per (model, mesh) config, each behind its own
+RecompileSentinel with ``max_compiles=1`` (tests/test_serve.py
+additionally observes zero backend compiles over a mixed trace via a
+jax.monitoring hook).
 
 Prefix caching (``prefix_cache=True``, the default): on admission the
 engine looks up the longest cached block-chain for ``prompt +
@@ -74,8 +89,11 @@ import numpy as np
 
 from quintnet_tpu.analysis.recompile import (RecompileError,
                                              RecompileSentinel)
+from quintnet_tpu.analysis.specs import lora_rank_buckets as _rank_buckets
 from quintnet_tpu.analysis.specs import prefill_buckets as _spec_buckets
 from quintnet_tpu.models.gpt2_generate import sample_logits
+from quintnet_tpu.serve.adapters import (AdapterRegistry, adapter_paths,
+                                         nest, tree_at)
 from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics
@@ -92,6 +110,10 @@ class ServeEngine:
                  prefill_bucket_sizes: Optional[Sequence[int]] = None,
                  prefix_cache: bool = True,
                  spec: "SpecConfig | bool | None" = None,
+                 adapters: Optional[AdapterRegistry] = None,
+                 lora_targets: Optional[Sequence[str]] = None,
+                 lora_max_rank: int = 8,
+                 lora_rank_bucket_sizes: Optional[Sequence[int]] = None,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, policy: str = "fcfs",
@@ -120,6 +142,93 @@ class ServeEngine:
             spec = None
         self.spec: Optional[SpecConfig] = spec
         self.drafter = NgramDrafter(spec) if spec is not None else None
+
+        # multi-tenant LoRA (serve/adapters.py): None -> adapter-blind
+        # engine whose compiled programs are byte-identical to the
+        # pre-adapter surface; an AdapterRegistry (or True for a fresh
+        # default one) arms per-slot adapter deltas in every program.
+        if adapters is True:
+            adapters = AdapterRegistry()
+        elif adapters is False:
+            adapters = None
+        self.adapters: Optional[AdapterRegistry] = adapters
+        if self.adapters is not None:
+            targets = tuple(lora_targets or family.lora_targets)
+            if not targets:
+                raise ValueError(
+                    f"family {family.name!r} declares no default LoRA "
+                    f"targets; pass lora_targets=")
+            self.lora_targets = targets
+            self._lora_paths = adapter_paths(params["blocks"], targets)
+            if not self._lora_paths:
+                raise ValueError(
+                    f"no LoRA targets {targets} found in the model's "
+                    f"block tree")
+            rb = tuple(sorted(set(
+                int(b) for b in (lora_rank_bucket_sizes
+                                 or _rank_buckets(lora_max_rank)))))
+            if not rb or rb[0] < 1:
+                raise ValueError(f"invalid LoRA rank buckets {rb}")
+            # the canonical ladder (analysis/specs.lora_rank_buckets):
+            # one decode program per bucket; prefill/verify run at the
+            # top bucket (see _lora_args)
+            self.lora_rank_buckets = rb
+            self.lora_max_rank = rb[-1]
+            S, R = self.max_slots, self.lora_max_rank
+            # packed per-slot factors, one (a, b) pair per targeted
+            # matmul: [L, S, in, R] / [L, S, R, out], zero rows for
+            # base-model slots (the KV pool's null-object trick applied
+            # to weights). DEVICE-resident masters updated one slot at
+            # a time on (un)binding — a binding change ships only that
+            # slot's [L, in, R] rows, never the whole pack; the sliced
+            # per-bucket views in _lora_args_cache are device-side
+            # copies rebuilt lazily after a change.
+            self._lora_specs = None
+            flat_specs = None
+            if mesh is not None:
+                from quintnet_tpu.serve.adapters import \
+                    packed_lora_spec_flat
+
+                flat_specs = packed_lora_spec_flat(
+                    family.partition_specs(tp_axis)["blocks"],
+                    self._lora_paths)
+                self._lora_specs = nest(flat_specs)
+            self._lora_shapes: Dict = {}
+            self._lora_dev: Dict = {}
+            for path in self._lora_paths:
+                w = tree_at(params["blocks"], path)["w"]
+                L, fin, fout = w.shape
+                self._lora_shapes[path] = (L, fin, fout)
+                a = jnp.zeros((L, S, fin, R), w.dtype)
+                b = jnp.zeros((L, S, R, fout), w.dtype)
+                if mesh is not None:
+                    from jax.sharding import NamedSharding
+
+                    a = jax.device_put(
+                        a, NamedSharding(mesh, flat_specs[path]["a"]))
+                    b = jax.device_put(
+                        b, NamedSharding(mesh, flat_specs[path]["b"]))
+                self._lora_dev[path] = {"a": a, "b": b}
+            self._lora_scale = np.zeros((S,), np.float32)
+            self._slot_rank = np.zeros((S,), np.int32)
+            self._slot_adapter: List[Optional[str]] = [None] * S
+            self._lora_args_cache: Dict = {}
+
+            # ONE jitted pack-maintenance program for (un)binding: it
+            # writes a single slot's rows into every target's packed
+            # tensors in one dispatch, donating the old pack so the
+            # update is in-place — host->device traffic per binding
+            # change is O(one slot's factors), never the whole pack.
+            # One static signature (slot is a traced scalar); warmup()
+            # compiles it beside the serving programs so binds inside
+            # a zero-recompile trace stay compile-free.
+            def _pack_update(dev, slot, new):
+                return jax.tree.map(
+                    lambda d, n: jax.lax.dynamic_update_slice_in_dim(
+                        d, n[:, None].astype(d.dtype), slot, axis=1),
+                    dev, new)
+
+            self._pack_update = jax.jit(_pack_update, donate_argnums=(0,))
 
         self.max_seq_len = int(max_seq_len or family.max_positions)
         if self.max_seq_len > family.max_positions:
@@ -190,9 +299,22 @@ class ServeEngine:
             b: RecompileSentinel(f"serve.prefill[{b}]", prefill_fn,
                                  max_compiles=1)
             for b in self.prefill_buckets}
-        self._decode = RecompileSentinel(
-            "serve.decode", self._build_decode(donate=(1, 2, 3, 6)),
-            max_compiles=1)
+        # decode: ONE program for adapter-blind engines; with adapters,
+        # one program per LoRA rank bucket (the packed factors' rank
+        # dim is the only signature difference — all buckets share one
+        # jitted callable), chosen per step by the largest bound
+        # adapter. Keyed by bucket; None = the adapter-blind program.
+        decode_fn = self._build_decode(donate=(1, 2, 3, 6))
+        if self.adapters is None:
+            self._decode = RecompileSentinel("serve.decode", decode_fn,
+                                             max_compiles=1)
+            self._decodes: Dict[Optional[int], RecompileSentinel] = {
+                None: self._decode}
+        else:
+            self._decodes = {
+                r: RecompileSentinel(f"serve.decode[r{r}]", decode_fn,
+                                     max_compiles=1)
+                for r in self.lora_rank_buckets}
         # verify programs (speculative decoding): one sentinel per
         # draft-length bucket sharing ONE jitted callable — the bucket
         # only changes the run width P = k + 1. ids donates into the
@@ -224,9 +346,11 @@ class ServeEngine:
     def _build_prefill(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        use_lora = self.adapters is not None
 
         def body(params, k_pool, v_pool, ids, start, t0, table_row,
-                 cow_src, cow_len, key_data):
+                 cow_src, cow_len, key_data, *rest):
+            lora, lora_scale = rest if use_lora else (None, None)
             # copy-on-write: when the reusable prefix chain ends inside
             # a partially-filled cached block, its first cow_len slots
             # are copied from cow_src into this request's first private
@@ -243,7 +367,7 @@ class ServeEngine:
 
             logits, k_pool, v_pool = family.prefill_from(
                 params, k_pool, v_pool, ids, start, t0, table_row, bs,
-                tp_axis=tp_axis)
+                tp_axis=tp_axis, lora=lora, lora_scale=lora_scale)
 
             key = jax.random.wrap_key_data(key_data)
             key2, sub = jax.random.split(key)
@@ -257,11 +381,14 @@ class ServeEngine:
     def _build_decode(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        use_lora = self.adapters is not None
 
-        def body(params, k_pool, v_pool, tok, pos, tables, key_data):
+        def body(params, k_pool, v_pool, tok, pos, tables, key_data,
+                 *rest):
+            lora, lora_scale = rest if use_lora else (None, None)
             logits, k_pool, v_pool = family.decode(
                 params, k_pool, v_pool, tok, pos, tables, bs,
-                tp_axis=tp_axis)
+                tp_axis=tp_axis, lora=lora, lora_scale=lora_scale)
             keys = jax.random.wrap_key_data(key_data)
             pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             nxt = self._sample_rows(logits, pairs[:, 1])
@@ -285,12 +412,15 @@ class ServeEngine:
         is bit-identical to plain decoding (greedy AND sampled)."""
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        use_lora = self.adapters is not None
 
         def body(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-                 key_data):
+                 key_data, *rest):
+            lora, lora_scale = rest if use_lora else (None, None)
             logits, k_pool, v_pool = family.verify(
                 params, k_pool, v_pool, ids, starts, tail_lens, tables,
-                bs, tp_axis=tp_axis)                       # [S, P, V]
+                bs, tp_axis=tp_axis, lora=lora,
+                lora_scale=lora_scale)                     # [S, P, V]
             P = ids.shape[1]
 
             def chain_step(kd, _):
@@ -322,7 +452,10 @@ class ServeEngine:
         rebuilt from host state each call, so their device buffers are
         dead after the step). Under a mesh, shard_map first: params in
         their training layout, pool head-sharded, everything else
-        replicated."""
+        replicated — and with adapters armed, the packed LoRA factors
+        sharded per-target like their weights (adapters.py
+        packed_lora_specs: a in-sharded, b out-sharded; never
+        donated — they persist across steps)."""
         if self.mesh is None:
             return jax.jit(body, donate_argnums=donate)
         from jax.sharding import PartitionSpec as P
@@ -333,16 +466,191 @@ class ServeEngine:
         pspecs = self.family.partition_specs(self.tp_axis)
 
         # prefill body: (params, kp, vp, ids, start, t0, row, cow_src,
-        #                cow_len, key) -> 4 outs
-        # decode  body: (params, kp, vp, tok, pos, tables, key) -> 4 outs
+        #                cow_len, key[, lora, scale]) -> 4 outs
+        # decode  body: (params, kp, vp, tok, pos, tables, key
+        #                [, lora, scale]) -> 4 outs
         # verify  body: (params, kp, vp, ids, starts, tail_lens, tables,
-        #                key) -> 4 outs
+        #                key[, lora, scale]) -> 4 outs
+        lora_specs = ((self._lora_specs, P())
+                      if self.adapters is not None else ())
         smapped = cc.shard_map_fn(
             body, self.mesh,
             in_specs=((pspecs,) + (pool_spec,) * n_pool_args
-                      + (P(),) * n_rest),
+                      + (P(),) * n_rest + lora_specs),
             out_specs=(pool_spec,) * n_pool_args + (P(), P()))
         return jax.jit(smapped, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    # multi-tenant LoRA (serve/adapters.py)
+    # ------------------------------------------------------------------
+    def _adapter_shape_check(self, entry) -> None:
+        """An adapter must target a subset of this engine's packed
+        paths with matching [L, in, r] / [L, r, out] factors and rank
+        within the ladder — checked at submit so a bad tenant file
+        fails its request, never a shared engine step. Factors at
+        paths the engine is NOT configured to pack are an error, not
+        an omission: silently dropping a trained target would diverge
+        from the adapter's merged-weights golden."""
+        from quintnet_tpu.serve.adapters import adapter_factor_paths
+
+        packed = set(self._lora_paths)
+        unserved = [p for p in adapter_factor_paths(entry.tree)
+                    if p not in packed]
+        if unserved:
+            raise ValueError(
+                f"adapter {entry.adapter_id!r} trains "
+                f"{['.'.join(p) for p in unserved]} which this engine "
+                f"does not serve (lora_targets={self.lora_targets}) — "
+                f"its output would silently diverge from the merged "
+                f"weights")
+        found = 0
+        for path in self._lora_paths:
+            node = tree_at(entry.tree, path)
+            if node is None:
+                continue
+            found += 1
+            a, b = np.asarray(node["a"]), np.asarray(node["b"])
+            L, fin, fout = self._lora_shapes[path]
+            r = a.shape[-1]
+            ok = (a.shape == (L, fin, r) and b.shape == (L, r, fout))
+            if not ok:
+                raise ValueError(
+                    f"adapter {entry.adapter_id!r} factor shapes at "
+                    f"{'.'.join(path)} ({a.shape}, {b.shape}) do not "
+                    f"match this engine's blocks "
+                    f"([{L}, {fin}, r], [{L}, r, {fout}])")
+            if r != entry.rank:
+                raise ValueError(
+                    f"adapter {entry.adapter_id!r} rank mismatch at "
+                    f"{'.'.join(path)}: factors have r={r}, config "
+                    f"says {entry.rank}")
+        if found == 0:
+            raise ValueError(
+                f"adapter {entry.adapter_id!r} targets none of this "
+                f"engine's LoRA paths {self.lora_targets}")
+        if entry.rank > self.lora_max_rank:
+            raise ValueError(
+                f"adapter {entry.adapter_id!r} rank {entry.rank} "
+                f"exceeds the engine's top rank bucket "
+                f"{self.lora_max_rank} (lora_max_rank)")
+
+    def validate_adapter(self, adapter_id: str) -> None:
+        """Fail-fast surface: is ``adapter_id`` servable by this engine
+        right now? Raises ValueError/KeyError otherwise. The entry is
+        pinned for the duration of the check — reading ``entry.tree``
+        unpinned would race a concurrent LRU eviction into a spurious
+        rejection — and released before returning."""
+        if self.adapters is None:
+            raise ValueError(
+                "this engine was built without adapters "
+                "(ServeEngine(adapters=AdapterRegistry(...))); "
+                "cannot serve adapter_id requests")
+        entry = self.adapters.acquire(adapter_id)
+        try:
+            self._adapter_shape_check(entry)
+        finally:
+            self.adapters.release(adapter_id)
+
+    def _zero_slot_update(self) -> Dict:
+        """An all-zeros single-slot update tree (unbinding, warmup)."""
+        R = self.lora_max_rank
+        return {p: {"a": np.zeros((L, fin, R), np.float32),
+                    "b": np.zeros((L, R, fout), np.float32)}
+                for p, (L, fin, fout) in self._lora_shapes.items()}
+
+    def _apply_pack_update(self, slot: int, updates: Dict) -> None:
+        """Write one slot's rows into the device-resident pack (one
+        jitted dispatch, old pack donated). The args cache is cleared
+        FIRST: its verify entry aliases the pack tensors directly, and
+        a donated buffer must have no other live reference."""
+        self._lora_args_cache.clear()
+        self._lora_dev = self._pack_update(
+            self._lora_dev, jnp.int32(slot),
+            {p: updates[p] for p in self._lora_paths})
+
+    def _bind_slot_adapter(self, slot: int, adapter_id: str) -> None:
+        """Pack the adapter's factors into the slot's rows of the
+        device-resident stacked [L, S, in, R] / [L, S, R, out] tensors
+        (rank-padded with zeros; targets the adapter does not train
+        stay zero = base behavior for that matmul). Only THIS slot's
+        rows ship to the device."""
+        entry = self.adapters.ensure_resident(adapter_id)
+        tp = (1 if self.mesh is None
+              else self.mesh.shape[self.tp_axis])
+        R = self.lora_max_rank
+        updates = self._zero_slot_update()
+        for path in self._lora_paths:
+            node = tree_at(entry.tree, path)
+            if node is None:
+                continue
+            a = np.asarray(node["a"])
+            b = np.asarray(node["b"])
+            if self.family.lora_layout is not None:
+                b = np.asarray(self.family.lora_layout(path, b, tp))
+            r = a.shape[-1]
+            updates[path]["a"][:, :, :r] = a
+            updates[path]["b"][:, :r, :] = b
+        self._apply_pack_update(slot, updates)
+        self._lora_scale[slot] = entry.scale
+        self._slot_rank[slot] = entry.rank
+        self._slot_adapter[slot] = adapter_id
+
+    def _unbind_slot_adapter(self, slot: int) -> None:
+        if self._slot_adapter[slot] is None:
+            return
+        self._apply_pack_update(slot, self._zero_slot_update())
+        self._lora_scale[slot] = 0.0
+        self._slot_rank[slot] = 0
+        self._slot_adapter[slot] = None
+
+    def _decode_rank_bucket(self) -> int:
+        """Smallest ladder bucket covering the largest bound adapter
+        rank among occupied slots (the smallest bucket when the batch
+        is all base-model — zero factors at any width are exact)."""
+        top = max((int(self._slot_rank[s]) for s in self._active_slots()),
+                  default=0)
+        for b in self.lora_rank_buckets:
+            if b >= top:
+                return b
+        raise AssertionError(
+            f"bound rank {top} exceeds the top bucket — submit-time "
+            f"validation should have rejected the adapter")
+
+    def _lora_args(self, kind: str, *, slot: Optional[int] = None,
+                   rank_bucket: Optional[int] = None):
+        """The (packed tree, scales) argument pair for one program
+        call, viewed/sliced from the device-resident masters and cached
+        until a binding changes (slices are device-side copies — no
+        host traffic on rebuild):
+
+        - ``decode``: full [S]-slot pack at ``rank_bucket`` width (the
+          top bucket passes the masters through unsliced);
+        - ``verify``: full pack at the TOP bucket (one program family);
+        - ``prefill``: the admitted slot's [1]-row slice at the top
+          bucket (one request per prefill call).
+        """
+        if kind == "prefill":
+            key = ("prefill", slot)
+            if key not in self._lora_args_cache:
+                flat = {p: {"a": d["a"][:, slot:slot + 1],
+                            "b": d["b"][:, slot:slot + 1]}
+                        for p, d in self._lora_dev.items()}
+                self._lora_args_cache[key] = (
+                    nest(flat),
+                    jnp.asarray(self._lora_scale[slot:slot + 1]))
+            return self._lora_args_cache[key]
+        R = (rank_bucket if kind == "decode" else self.lora_max_rank)
+        key = (kind, R)
+        if key not in self._lora_args_cache:
+            if R == self.lora_max_rank:
+                flat = dict(self._lora_dev)
+            else:
+                flat = {p: {"a": d["a"][..., :R],
+                            "b": d["b"][:, :, :R, :]}
+                        for p, d in self._lora_dev.items()}
+            self._lora_args_cache[key] = (nest(flat),
+                                          jnp.asarray(self._lora_scale))
+        return self._lora_args_cache[key]
 
     # ------------------------------------------------------------------
     # submission / results
@@ -385,14 +693,39 @@ class ServeEngine:
         self.scheduler.submit(req)
         return req.rid
 
+    def _pin_adapter(self, adapter_id: Optional[str]) -> None:
+        """Submit-time pin + validation: the adapter loads (if
+        evicted), its refcount rises for the request's lifetime — a
+        pinned adapter is never an LRU eviction candidate — and its
+        factor shapes are checked against this engine's blocks so a bad
+        tenant file fails ITS request at the front door."""
+        if adapter_id is None:
+            return
+        if self.adapters is None:
+            raise ValueError(
+                "this engine was built without adapters "
+                "(ServeEngine(adapters=AdapterRegistry(...))); "
+                "cannot serve adapter_id requests")
+        entry = self.adapters.acquire(adapter_id)
+        try:
+            self._adapter_shape_check(entry)
+        except Exception:
+            self.adapters.release(adapter_id)
+            raise
+
     def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
-               key=None, on_token=None) -> int:
+               key=None, on_token=None,
+               adapter_id: Optional[str] = None) -> int:
         """Queue one request; returns its id. ``key``: per-request
         sampling key (defaults to fold_in(key(0), rid)) — pass the SAME
         key an independent ``gpt2_generate`` call would get to reproduce
-        it token-for-token."""
+        it token-for-token. ``adapter_id``: serve this request through
+        the named LoRA adapter (serve/adapters.py; None = base model) —
+        the adapter is pinned in the registry until the request
+        finishes."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._check_admissible(prompt, max_new_tokens)
+        self._pin_adapter(adapter_id)
         rid = self._rid_counter
         self._rid_counter += 1
         if key is None:
@@ -400,7 +733,8 @@ class ServeEngine:
         req = Request(rid=rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       priority=int(priority),
-                      arrival=self._arrival_counter, on_token=on_token)
+                      arrival=self._arrival_counter, on_token=on_token,
+                      adapter_id=adapter_id)
         self._arrival_counter += 1
         req.key_data = np.asarray(jax.random.key_data(key))
         return self._enqueue(req)
@@ -429,12 +763,17 @@ class ServeEngine:
                 f"nothing left to generate: {len(progress.generated)} of "
                 f"{progress.max_new_tokens} tokens already produced")
         self._check_admissible(prompt, progress.max_new_tokens)
+        # the migrated request keeps its adapter binding: this engine's
+        # registry loads the adapter from its source if it has never
+        # served (or has evicted) the tenant — the cold-replica path
+        self._pin_adapter(progress.adapter_id)
         rid = self._rid_counter
         self._rid_counter += 1
         req = Request(rid=rid, prompt=prompt,
                       max_new_tokens=int(progress.max_new_tokens),
                       priority=int(progress.priority),
-                      arrival=self._arrival_counter, on_token=on_token)
+                      arrival=self._arrival_counter, on_token=on_token,
+                      adapter_id=progress.adapter_id)
         self._arrival_counter += 1
         req.generated = list(progress.generated)
         req.key_data = np.array(progress.key_data, copy=True)
@@ -475,6 +814,8 @@ class ServeEngine:
         self._tables[slot] = 0
         self._tok[slot] = 0
         self._pos[slot] = 0
+        if self.adapters is not None:
+            self._unbind_slot_adapter(slot)
 
     def _release_slot_blocks(self, slot: int) -> None:
         """Publish this slot's valid-KV prefix into the prefix index,
@@ -482,11 +823,14 @@ class ServeEngine:
         the number of positions holding valid KV (prefill writes
         ``t0``, every decode step writes one more before pos
         increments), and ``output_ids()[:pos]`` are their token ids.
-        Publish must precede release: release RETAINS published blocks
-        (LRU) instead of freeing them."""
+        The request's adapter id namespaces the publish — KV written
+        under an adapter is only ever a hit for that adapter. Publish
+        must precede release: release RETAINS published blocks (LRU)
+        instead of freeing them."""
         req = self._slot_req[slot]
         blocks = self._slot_blocks[slot]
-        self.pool.publish(req.output_ids(), blocks, int(self._pos[slot]))
+        self.pool.publish(req.output_ids(), blocks, int(self._pos[slot]),
+                          namespace=req.adapter_id)
         self.pool.release(blocks)
 
     def _retire(self, slot: int) -> int:
@@ -495,7 +839,10 @@ class ServeEngine:
         self._clear_slot(slot)
         req.state = FINISHED
         req.finish_time = self.clock()
-        self.metrics.record_finish(req.finish_time - req.submit_time)
+        self.metrics.record_finish(req.finish_time - req.submit_time,
+                                   adapter_id=req.adapter_id)
+        if req.adapter_id is not None:
+            self.adapters.release(req.adapter_id)  # submit-time pin
         return req.rid
 
     def _preempt(self, slot: int) -> None:
@@ -517,10 +864,13 @@ class ServeEngine:
         done (EOS or token budget)."""
         req = self._slot_req[slot]
         req.generated.append(int(token))
+        if req.adapter_id is not None:
+            self.metrics.record_adapter_token(req.adapter_id)
         if req.first_token_time is None:
             req.first_token_time = self.clock()
             self.metrics.record_first_token(
-                req.first_token_time - req.submit_time)
+                req.first_token_time - req.submit_time,
+                adapter_id=req.adapter_id)
         done = (req.remaining_new_tokens <= 0
                 or (self.eos_token_id is not None
                     and int(token) == self.eos_token_id))
@@ -547,7 +897,8 @@ class ServeEngine:
         # the plan the scheduler's budget check approved (same step,
         # no pool mutation in between); computed here only for direct
         # _admit_one callers in tests
-        plan = req.admit_plan or self.pool.plan_admission(tokens, t0 + 1)
+        plan = req.admit_plan or self.pool.plan_admission(
+            tokens, t0 + 1, namespace=req.adapter_id)
         req.admit_plan = None
         # pin the chain FIRST: the private-block acquire below may evict
         # refcount-zero cached blocks, and without the pin it could
@@ -567,11 +918,19 @@ class ServeEngine:
         bucket = self._bucket_for(len(tail))
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :len(tail)] = tail
+        extra = ()
+        if self.adapters is not None:
+            # bind BEFORE the prefill: the tail runs under the
+            # request's adapter (a base request leaves the slot's rows
+            # zero — exactly the base program)
+            if req.adapter_id is not None:
+                self._bind_slot_adapter(slot, req.adapter_id)
+            extra = self._lora_args("prefill", slot=slot)
         kp, vp, tok0, key2 = self._prefills[bucket](
             self.params, *self.pool.caches(), jnp.asarray(ids),
             jnp.int32(start), jnp.int32(t0), jnp.asarray(row),
             jnp.int32(plan.cow_src if plan.cow_src is not None else 0),
-            jnp.int32(plan.cow_len), jnp.asarray(req.key_data))
+            jnp.int32(plan.cow_len), jnp.asarray(req.key_data), *extra)
         self.pool.update(kp, vp)
         if plan.cow_src is not None:
             # the COW source was pinned only for the copy above
@@ -689,10 +1048,13 @@ class ServeEngine:
             starts[slot] = int(self._pos[slot])
             tail_lens[slot] = len(d) + 1
 
+        extra = (self._lora_args("verify")
+                 if self.adapters is not None else ())
         kp, vp, toks, chain = self._verifies[k_bucket](
             self.params, *self.pool.caches(), jnp.asarray(ids),
             jnp.asarray(starts), jnp.asarray(tail_lens),
-            jnp.asarray(self._tables), jnp.asarray(self._key_data))
+            jnp.asarray(self._tables), jnp.asarray(self._key_data),
+            *extra)
         self.pool.update(kp, vp)
         toks = np.asarray(toks)
         chain = np.asarray(chain)
@@ -780,11 +1142,17 @@ class ServeEngine:
                 decode_tokens, draft_tokens, accepted_draft = \
                     self._verify_step(active, drafts, finished)
             else:
-                kp, vp, nxt, key2 = self._decode(
+                if self.adapters is None:
+                    sentinel, extra = self._decode, ()
+                else:
+                    R = self._decode_rank_bucket()
+                    sentinel = self._decodes[R]
+                    extra = self._lora_args("decode", rank_bucket=R)
+                kp, vp, nxt, key2 = sentinel(
                     self.params, *self.pool.caches(),
                     jnp.asarray(self._tok), jnp.asarray(self._pos),
                     jnp.asarray(self._tables),
-                    jnp.asarray(self._key_data))
+                    jnp.asarray(self._key_data), *extra)
                 self.pool.update(kp, vp)
                 nxt = np.asarray(nxt)
                 self._key_data = np.array(key2)
@@ -824,18 +1192,29 @@ class ServeEngine:
         previous one; calling the programs directly can."""
         key = jnp.asarray(jax.random.key_data(jax.random.key(0)))
         zrow = jnp.zeros((self.table_width,), jnp.int32)
+        lora_on = self.adapters is not None
+        if lora_on:
+            # compile the pack-maintenance program too (a zero write is
+            # a no-op on the zeroed pack): the first real bind must not
+            # be the first compile
+            self._apply_pack_update(0, self._zero_slot_update())
+        p_extra = self._lora_args("prefill", slot=0) if lora_on else ()
         for b, sentinel in self._prefills.items():
             kp, vp, _tok, _k = sentinel(
                 self.params, *self.pool.caches(),
                 jnp.zeros((1, b), jnp.int32), jnp.int32(0), jnp.int32(1),
-                zrow, jnp.int32(0), jnp.int32(0), key)
+                zrow, jnp.int32(0), jnp.int32(0), key, *p_extra)
             self.pool.update(kp, vp)
             key = jnp.asarray(np.asarray(_k))
-        kp, vp, _nxt, _keys = self._decode(
-            self.params, *self.pool.caches(), jnp.asarray(self._tok),
-            jnp.asarray(self._pos), jnp.asarray(self._tables),
-            jnp.asarray(self._key_data))
-        self.pool.update(kp, vp)
+        for R, sentinel in self._decodes.items():
+            extra = (self._lora_args("decode", rank_bucket=R)
+                     if lora_on else ())
+            kp, vp, _nxt, _keys = sentinel(
+                self.params, *self.pool.caches(), jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._tables),
+                jnp.asarray(self._key_data), *extra)
+            self.pool.update(kp, vp)
+        v_extra = self._lora_args("verify") if lora_on else ()
         for k, sentinel in self._verifies.items():
             # all-zero tables + zero tail_lens: every write lands in
             # the null block, candidate tokens and chains are discarded
@@ -845,7 +1224,7 @@ class ServeEngine:
                 jnp.zeros((self.max_slots,), jnp.int32),
                 jnp.zeros((self.max_slots,), jnp.int32),
                 jnp.zeros((self.max_slots, self.table_width), jnp.int32),
-                jnp.asarray(self._key_data))
+                jnp.asarray(self._key_data), *v_extra)
             self.pool.update(kp, vp)
 
     def run(self, *, max_steps: Optional[int] = None) -> None:
@@ -913,17 +1292,20 @@ class ServeEngine:
     # ------------------------------------------------------------------
     def compile_stats(self) -> Dict[str, int]:
         """Compiled-program counts for the bounded-compile invariant
-        (tests/test_serve.py): ``decode`` must stay at 1, ``prefill``
-        — the TOTAL across buckets — at most ``len(prefill_buckets)``,
-        and (speculation on) ``verify`` at most
-        ``len(spec.buckets)``, no matter how requests come and go.
-        Counted by the RecompileSentinels (distinct abstract signatures
-        seen = programs jit compiled). The ``verify`` key appears only
-        on spec-enabled engines — a spec-off engine's stats are
+        (tests/test_serve.py): ``decode`` must stay at 1 (adapter-blind
+        engines) or at most ``len(lora_rank_buckets)`` (adapters armed
+        — one program per rank bucket), ``prefill`` — the TOTAL across
+        buckets — at most ``len(prefill_buckets)``, and (speculation
+        on) ``verify`` at most ``len(spec.buckets)``, no matter how
+        requests OR ADAPTERS come and go. Counted by the
+        RecompileSentinels (distinct abstract signatures seen =
+        programs jit compiled). The ``verify`` key appears only on
+        spec-enabled engines — a spec-off engine's stats are
         byte-identical to the pre-speculation surface."""
         out = {"prefill": sum(s.compile_count
                               for s in self._prefills.values()),
-               "decode": self._decode.compile_count}
+               "decode": sum(s.compile_count
+                             for s in self._decodes.values())}
         if self.spec is not None:
             out["verify"] = sum(s.compile_count
                                 for s in self._verifies.values())
@@ -932,14 +1314,19 @@ class ServeEngine:
     def compile_sentinels(self) -> Dict[str, RecompileSentinel]:
         """The per-bucket prefill sentinels (``prefill[<width>]``), the
         per-bucket verify sentinels (``verify[<k>]``, spec-enabled
-        engines only) and the decode sentinel, for callers that
-        aggregate the promise across engines
-        (fleet.assert_compile_count)."""
+        engines only) and the decode sentinel(s) — one ``decode`` key
+        for adapter-blind engines, ``decode[r<rank>]`` per rank bucket
+        with adapters armed — for callers that aggregate the promise
+        across engines (fleet.assert_compile_count)."""
         out: Dict[str, RecompileSentinel] = {
             f"prefill[{b}]": s for b, s in self._prefills.items()}
         for k, s in self._verifies.items():
             out[f"verify[{k}]"] = s
-        out["decode"] = self._decode
+        if self.adapters is None:
+            out["decode"] = self._decode
+        else:
+            for r, s in self._decodes.items():
+                out[f"decode[r{r}]"] = s
         return out
 
     def assert_compile_count(self, prefill: int = 1, decode: int = 1,
@@ -950,9 +1337,23 @@ class ServeEngine:
         one by its own sentinel at call time). ``verify``: exact total
         across the verify buckets; None accepts any total up to
         ``len(spec.buckets)`` — traffic legitimately decides which
-        draft-length buckets ever trigger. Either way the global bound
-        holds: programs <= prefill buckets + verify buckets + 1."""
-        self._decode.assert_compile_count(decode)
+        draft-length buckets ever trigger. With adapters armed,
+        ``decode`` is the exact total across the RANK buckets the same
+        way. Either way the global bound holds: programs <= prefill
+        buckets + verify buckets + (1 decode per rank bucket)."""
+        if self.adapters is None:
+            self._decode.assert_compile_count(decode)
+        else:
+            d_total = sum(s.compile_count
+                          for s in self._decodes.values())
+            if d_total != decode:
+                detail = ", ".join(
+                    f"r{r}: {s.compile_count}"
+                    for r, s in sorted(self._decodes.items()))
+                raise RecompileError(
+                    f"serve.decode: expected {decode} compiled "
+                    f"rank-bucket program(s) in total, observed "
+                    f"{d_total} ({detail})")
         total = sum(s.compile_count for s in self._prefills.values())
         if total != prefill:
             detail = ", ".join(

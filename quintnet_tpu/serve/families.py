@@ -39,12 +39,23 @@ s's first i+1 run tokens — so one forward scores a whole draft + the
 bonus token. The attention math is the gathered-view decode math
 exactly (nn/attention.mha_verify_paged), which is what makes
 verify-committed tokens bit-equal to plain decoded ones.
+
+Multi-tenant LoRA (serve/adapters.py): every contract additionally
+takes ``lora=None, lora_scale=None`` — a nested pytree of PACKED
+per-slot adapter factors, one ``{"a": [L, S_or_1, in, r], "b": [L,
+S_or_1, r, out]}`` node per targeted matmul (leading L rides the layer
+scan exactly like the block params), plus the per-slot ``alpha/rank``
+scales. Each targeted matmul adds its row's low-rank delta
+(nn/layers.lora_delta); zero rows ARE the base model. Decode/verify
+take the full [S]-slot pack; prefill (one request at a time) takes the
+admitted slot's [1]-row slice. ``lora=None`` is byte-identical to the
+pre-adapter programs.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,30 +71,61 @@ class Family:
     head_dim: int
     max_positions: int
     prefill_from: Callable   # (params, kp, vp, ids, start, t0, row, bs,
-    #                           tp_axis) -> (logits, kp, vp)
-    decode: Callable         # (params, kp, vp, tok, pos, tables, bs, tp_axis)
+    #                           tp_axis, lora, lora_scale)
+    #                           -> (logits, kp, vp)
+    decode: Callable         # (params, kp, vp, tok, pos, tables, bs,
+    #                           tp_axis, lora, lora_scale)
     verify: Callable         # (params, kp, vp, ids [S, P], starts [S],
-    #                           tail_lens [S], tables, bs, tp_axis)
+    #                           tail_lens [S], tables, bs, tp_axis,
+    #                           lora, lora_scale)
     #                           -> (logits [S, P, V], kp, vp)
     partition_specs: Callable  # (tp_axis) -> param pytree specs
     kv_dtype: Any = jnp.float32
+    # default LoRA target names for this family's blocks (engine's
+    # lora_targets default — models/lora.py ladder names)
+    lora_targets: Tuple[str, ...] = ()
+    # host-side layout hook: (path, b_factor [L, r, out], tp) -> the
+    # factor permuted into the layout the SERVING weights use under tp.
+    # GPT-2's fused qkv stores tp-BLOCKED columns (gpt2_to_tp_layout);
+    # an adapter's b trained against the standard [q|k|v] layout must
+    # be re-blocked the same way before packing, or its delta would
+    # land on the wrong columns. None = identity (llama: separate
+    # q/k/v, column order preserved per rank).
+    lora_layout: Optional[Callable] = None
 
 
 # --------------------------------------------------------------------
 # GPT-2
 # --------------------------------------------------------------------
 
+def _scan_xs(blocks, k_pool, v_pool, lora):
+    """The layer-scan xs: block params + pool views (+ the packed lora
+    tree when adapters ride — every leaf has leading L)."""
+    return ((blocks, k_pool, v_pool) if lora is None
+            else (blocks, k_pool, v_pool, lora))
+
+
+def _scan_layer(layer, lora):
+    """(blk, kc, vc, per-layer-lora-or-None) from one scan slice."""
+    if lora is None:
+        blk, kc, vc = layer
+        return blk, kc, vc, None
+    blk, kc, vc, lr = layer
+    return blk, kc, vc, lr
+
+
 def gpt2_family(cfg) -> Family:
     from quintnet_tpu.models.gpt2 import gpt2_partition_specs
     from quintnet_tpu.models.gpt2_generate import (_embed_tok, _local_heads,
                                                    _logits)
+    from quintnet_tpu.models.lora import DEFAULT_TARGETS
     from quintnet_tpu.nn.layers import gelu
     from quintnet_tpu.nn.transformer import (block_decode,
                                              block_prefill_paged,
                                              block_verify_paged)
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
-                     block_size, tp_axis=None):
+                     block_size, tp_axis=None, lora=None, lora_scale=None):
         B, P = ids.shape
         emb = params["embedding"]
         positions = start + jnp.arange(P, dtype=jnp.int32)
@@ -95,40 +137,42 @@ def gpt2_family(cfg) -> Family:
         tail_len = t0 - start
 
         def body(x, layer):
-            blk, kc, vc = layer
+            blk, kc, vc, lr = _scan_layer(layer, lora)
             x, kc, vc = block_prefill_paged(
                 blk, x, kc, vc, positions, tail_len, num_heads=heads,
                 act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
-                block_tables=table_row, block_size=block_size)
+                block_tables=table_row, block_size=block_size,
+                lora=lr, lora_scale=lora_scale)
             return x, (kc, vc)
 
-        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
-                                                 k_pool, v_pool))
+        h, (k_pool, v_pool) = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
         return (_logits(params, h_last, cfg, tp_axis)[:, 0, :],
                 k_pool, v_pool)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
-               tp_axis=None):
+               tp_axis=None, lora=None, lora_scale=None):
         emb = params["embedding"]
         x = (_embed_tok(emb, tok[:, None], cfg, tp_axis)
              + jnp.take(emb["wpe"], pos, axis=0)[:, None, :])
         heads = _local_heads(cfg, tp_axis)
 
         def body(h, layer):
-            blk, kc, vc = layer
+            blk, kc, vc, lr = _scan_layer(layer, lora)
             h, kc, vc = block_decode(blk, h, kc, vc, pos, num_heads=heads,
                                      act=gelu, moe_args=cfg.moe_args,
                                      tp_axis=tp_axis, block_tables=tables,
-                                     block_size=block_size)
+                                     block_size=block_size,
+                                     lora=lr, lora_scale=lora_scale)
             return h, (kc, vc)
 
-        h, (k_pool, v_pool) = lax.scan(body, x, (params["blocks"],
-                                                 k_pool, v_pool))
+        h, (k_pool, v_pool) = lax.scan(
+            body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         return _logits(params, h, cfg, tp_axis)[:, 0, :], k_pool, v_pool
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-               block_size, tp_axis=None):
+               block_size, tp_axis=None, lora=None, lora_scale=None):
         S, P = ids.shape
         emb = params["embedding"]
         positions = (starts[:, None]
@@ -139,16 +183,27 @@ def gpt2_family(cfg) -> Family:
         heads = _local_heads(cfg, tp_axis)
 
         def body(x, layer):
-            blk, kc, vc = layer
+            blk, kc, vc, lr = _scan_layer(layer, lora)
             x, kc, vc = block_verify_paged(
                 blk, x, kc, vc, positions, tail_lens, num_heads=heads,
                 act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
-                block_tables=tables, block_size=block_size)
+                block_tables=tables, block_size=block_size,
+                lora=lr, lora_scale=lora_scale)
             return x, (kc, vc)
 
-        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
-                                                 k_pool, v_pool))
+        h, (k_pool, v_pool) = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         return _logits(params, h, cfg, tp_axis), k_pool, v_pool
+
+    def lora_layout(path, b, tp):
+        # fused qkv columns are tp-BLOCKED in the serving layout
+        # (parallel/tp.py gpt2_to_tp_layout); re-block the adapter's b
+        # the same way so its delta lands on the matching columns
+        if path[-1] == "qkv" and tp > 1:
+            from quintnet_tpu.parallel.tp import qkv_blocked_from_standard
+
+            return qkv_blocked_from_standard(b, cfg.n_head, tp)
+        return b
 
     return Family(
         name="gpt2", cfg=cfg, n_layers=cfg.n_layer, n_kv_heads=cfg.n_head,
@@ -156,6 +211,7 @@ def gpt2_family(cfg) -> Family:
         prefill_from=prefill_from, decode=decode, verify=verify,
         partition_specs=lambda tp_axis: gpt2_partition_specs(
             cfg, tp_axis=tp_axis),
+        lora_targets=DEFAULT_TARGETS, lora_layout=lora_layout,
     )
 
 
@@ -170,9 +226,10 @@ def llama_family(cfg) -> Family:
                                            llama_partition_specs,
                                            llama_rope_tables)
     from quintnet_tpu.models.llama_generate import _embed, _full_logits
+    from quintnet_tpu.models.lora import LLAMA_TARGETS
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
-                     block_size, tp_axis=None):
+                     block_size, tp_axis=None, lora=None, lora_scale=None):
         B, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)
         positions = start + jnp.arange(P, dtype=jnp.int32)
@@ -180,39 +237,40 @@ def llama_family(cfg) -> Family:
         tail_len = t0 - start
 
         def body(x, layer):
-            blk, kc, vc = layer
+            blk, kc, vc, lr = _scan_layer(layer, lora)
             x, (kc, vc) = llama_block_prefill_paged(
                 blk, x, kc, vc, positions, tail_len, cfg, cos, sin,
                 tp_axis=tp_axis, block_tables=table_row,
-                block_size=block_size)
+                block_size=block_size, lora=lr, lora_scale=lora_scale)
             return x, (kc, vc)
 
-        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
-                                                 k_pool, v_pool))
+        h, (k_pool, v_pool) = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
         return (_full_logits(params, h_last, cfg, tp_axis)[:, 0, :],
                 k_pool, v_pool)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
-               tp_axis=None):
+               tp_axis=None, lora=None, lora_scale=None):
         x = _embed(params, tok[:, None], cfg, tp_axis)        # [S, 1, D]
         cos, sin = llama_rope_tables(pos, cfg)                # [S, hd]
         cos, sin = cos[:, None, None, :], sin[:, None, None, :]
 
         def body(h, layer):
-            blk, kc, vc = layer
+            blk, kc, vc, lr = _scan_layer(layer, lora)
             h, (kc, vc) = llama_block_decode(
                 blk, h, kc, vc, pos, cfg, cos, sin, tp_axis=tp_axis,
-                block_tables=tables, block_size=block_size)
+                block_tables=tables, block_size=block_size,
+                lora=lr, lora_scale=lora_scale)
             return h, (kc, vc)
 
-        h, (k_pool, v_pool) = lax.scan(body, x, (params["blocks"],
-                                                 k_pool, v_pool))
+        h, (k_pool, v_pool) = lax.scan(
+            body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         return _full_logits(params, h, cfg, tp_axis)[:, 0, :], \
             k_pool, v_pool
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-               block_size, tp_axis=None):
+               block_size, tp_axis=None, lora=None, lora_scale=None):
         S, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)                 # [S, P, D]
         positions = (starts[:, None]
@@ -221,15 +279,15 @@ def llama_family(cfg) -> Family:
         cos, sin = cos[:, None], sin[:, None]                 # [S,1,P,hd]
 
         def body(x, layer):
-            blk, kc, vc = layer
+            blk, kc, vc, lr = _scan_layer(layer, lora)
             x, (kc, vc) = llama_block_verify_paged(
                 blk, x, kc, vc, positions, tail_lens, cfg, cos, sin,
                 tp_axis=tp_axis, block_tables=tables,
-                block_size=block_size)
+                block_size=block_size, lora=lr, lora_scale=lora_scale)
             return x, (kc, vc)
 
-        h, (k_pool, v_pool) = lax.scan(body, h, (params["blocks"],
-                                                 k_pool, v_pool))
+        h, (k_pool, v_pool) = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         return _full_logits(params, h, cfg, tp_axis), k_pool, v_pool
 
     return Family(
@@ -239,4 +297,5 @@ def llama_family(cfg) -> Family:
         prefill_from=prefill_from, decode=decode, verify=verify,
         partition_specs=lambda tp_axis: llama_partition_specs(
             cfg, tp_axis=tp_axis),
+        lora_targets=LLAMA_TARGETS,
     )

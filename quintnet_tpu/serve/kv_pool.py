@@ -322,17 +322,33 @@ class KVPool:
 
     # ---- prefix index -----------------------------------------------
     @staticmethod
-    def _key(tokens: np.ndarray, n: int) -> bytes:
-        return np.ascontiguousarray(tokens[:n], dtype=np.int32).tobytes()
+    def _key(tokens: np.ndarray, n: int,
+             namespace: Optional[str] = None) -> bytes:
+        """Index key for ``tokens[:n]``. ``namespace`` partitions the
+        index (multi-tenant LoRA serving, serve/adapters.py): identical
+        token prefixes hold DIFFERENT KV under different adapters, so a
+        chain cached under one adapter must never hit for another (or
+        for the base model). EVERY key is a NUL-terminated namespace
+        prefix (empty for the base model) + the literal token bytes —
+        adapter ids cannot contain NUL, so the first NUL always delimits
+        the namespace and two keys are equal only when both namespace
+        and token prefix are (a bare token-bytes base key could collide
+        with an id whose bytes happen to open another key's body)."""
+        body = np.ascontiguousarray(tokens[:n], dtype=np.int32).tobytes()
+        if namespace is None:
+            return b"\x00" + body
+        return namespace.encode("utf-8") + b"\x00" + body
 
-    def lookup(self, tokens, max_tokens: Optional[int] = None) -> AdmitPlan:
+    def lookup(self, tokens, max_tokens: Optional[int] = None, *,
+               namespace: Optional[str] = None) -> AdmitPlan:
         """Longest cached block-chain for ``tokens``: full blocks are
         matched at block boundaries, then the longest published partial
         leaf extending the chain. The match is capped at
         ``max_tokens`` (callers pass ``len(tokens) - 1`` so at least
         one token is always prefilled — prefill must produce the
-        next-token logits). Read-only; returns a plan with
-        ``n_new_blocks`` unset."""
+        next-token logits). ``namespace``: the requesting adapter id
+        (chains are shared per adapter — see :meth:`_key`). Read-only;
+        returns a plan with ``n_new_blocks`` unset."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         limit = len(tokens) if max_tokens is None else min(
             int(max_tokens), len(tokens))
@@ -341,21 +357,23 @@ class KVPool:
         bs = self.block_size
         full: List[int] = []
         while (len(full) + 1) * bs <= limit:
-            b = self._index.get(self._key(tokens, (len(full) + 1) * bs))
+            b = self._index.get(self._key(tokens, (len(full) + 1) * bs,
+                                          namespace))
             if b is None:
                 break
             full.append(b)
         m = len(full) * bs
         cow_src, cow_len = None, 0
         for f in range(min(bs - 1, limit - m), 0, -1):
-            b = self._index.get(self._key(tokens, m + f))
+            b = self._index.get(self._key(tokens, m + f, namespace))
             if b is not None:
                 cow_src, cow_len = b, f
                 break
         return AdmitPlan(cached_tokens=m + cow_len, shared_blocks=full,
                          cow_src=cow_src, cow_len=cow_len)
 
-    def plan_admission(self, tokens, total_tokens: int) -> AdmitPlan:
+    def plan_admission(self, tokens, total_tokens: int, *,
+                       namespace: Optional[str] = None) -> AdmitPlan:
         """Best ADMISSIBLE plan for a request whose table must cover
         ``total_tokens`` slots (prefill length + the first decode
         write): the longest cached chain plus the private blocks that
@@ -374,7 +392,8 @@ class KVPool:
         checked ``blocks_for(total) <= usable_blocks``)."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n_total = self.blocks_for(int(total_tokens))
-        plan = self.lookup(tokens, max_tokens=len(tokens) - 1)
+        plan = self.lookup(tokens, max_tokens=len(tokens) - 1,
+                           namespace=namespace)
         plan.n_new_blocks = n_total - len(plan.shared_blocks)
         if self.can_admit(plan) or not plan.pinned_blocks:
             return plan
@@ -395,15 +414,19 @@ class KVPool:
                                if b in self._cached_free)
         return plan.n_new_blocks <= self.num_available - pinned_evictable
 
-    def publish(self, tokens, blocks: Sequence[int], n_tokens: int) -> None:
+    def publish(self, tokens, blocks: Sequence[int], n_tokens: int, *,
+                namespace: Optional[str] = None) -> None:
         """Index ``blocks`` as the cached chain for
         ``tokens[:n_tokens]`` (the retire/preempt path — instead of
         freeing, make the request's KV findable). Full blocks are keyed
         at block boundaries; a trailing partial block at its exact
-        count. A key already mapping to a DIFFERENT block (an identical
-        request published first) keeps the incumbent — the duplicate
-        stays unpublished and will return to the free list on release.
-        Publish BEFORE release: release retains published blocks."""
+        count. ``namespace``: the adapter id whose programs WROTE this
+        KV — the chain is findable only by requests bound to the same
+        adapter (see :meth:`_key`). A key already mapping to a
+        DIFFERENT block (an identical request published first) keeps
+        the incumbent — the duplicate stays unpublished and will return
+        to the free list on release. Publish BEFORE release: release
+        retains published blocks."""
         if not self.prefix_cache or n_tokens <= 0:
             return
         tokens = np.asarray(tokens, np.int32).reshape(-1)
@@ -422,10 +445,12 @@ class KVPool:
                 f"before a request's blocks are published")
         for j in range(q):
             self._publish_one(blocks[j], self._key(tokens, (j + 1)
-                                                   * self.block_size),
+                                                   * self.block_size,
+                                                   namespace),
                               self.block_size)
         if f and q < len(blocks):
-            self._publish_one(blocks[q], self._key(tokens, n_tokens), f)
+            self._publish_one(blocks[q],
+                              self._key(tokens, n_tokens, namespace), f)
 
     def _publish_one(self, b: int, key: bytes, fill: int) -> None:
         cur = self._index.get(key)

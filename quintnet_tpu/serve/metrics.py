@@ -64,6 +64,12 @@ class ServeMetrics:
     peak_kv_utilization: float = 0.0
     peak_running: int = 0
 
+    # per-adapter ledger (multi-tenant LoRA, serve/adapters.py):
+    # adapter id -> {"requests": finished, "gen_tokens": generated,
+    # "ttfts": [s, ...]} — the per-tenant slice of the totals above
+    # (base-model traffic is the remainder)
+    per_adapter: Dict[str, Dict] = field(default_factory=dict)
+
     # per-request marks ----------------------------------------------
     ttfts: List[float] = field(default_factory=list)
     latencies: List[float] = field(default_factory=list)
@@ -106,12 +112,29 @@ class ServeMetrics:
     def record_preempt(self) -> None:
         self.preempted += 1
 
-    def record_first_token(self, ttft_s: float) -> None:
-        self.ttfts.append(ttft_s)
+    def _adapter(self, adapter_id: str) -> Dict:
+        return self.per_adapter.setdefault(
+            adapter_id, {"requests": 0, "gen_tokens": 0, "ttfts": []})
 
-    def record_finish(self, latency_s: float) -> None:
+    def record_adapter_token(self, adapter_id: str) -> None:
+        """One generated token attributed to ``adapter_id`` (the engine
+        calls this beside its committed-token bookkeeping, so adapter
+        ledgers count exactly the tokens the tenant received HERE —
+        a migrated request's earlier tokens stay on the exporter)."""
+        self._adapter(adapter_id)["gen_tokens"] += 1
+
+    def record_first_token(self, ttft_s: float,
+                           adapter_id: Optional[str] = None) -> None:
+        self.ttfts.append(ttft_s)
+        if adapter_id is not None:
+            self._adapter(adapter_id)["ttfts"].append(ttft_s)
+
+    def record_finish(self, latency_s: float,
+                      adapter_id: Optional[str] = None) -> None:
         self.finished += 1
         self.latencies.append(latency_s)
+        if adapter_id is not None:
+            self._adapter(adapter_id)["requests"] += 1
 
     # ---- reporting --------------------------------------------------
     @property
@@ -190,6 +213,11 @@ class ServeMetrics:
             "latency_s": _pcts(self.latencies),
             "peak_kv_utilization": round(self.peak_kv_utilization, 4),
             "peak_running": self.peak_running,
+            "adapters": {
+                aid: {"requests": d["requests"],
+                      "gen_tokens": d["gen_tokens"],
+                      "ttft_s": _pcts(d["ttfts"])}
+                for aid, d in sorted(self.per_adapter.items())},
         }
 
     def log_step(self, logger: Optional[logging.Logger], *,
@@ -227,6 +255,16 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
     for m in all_metrics:
         ttfts.extend(m.ttfts)
         latencies.extend(m.latencies)
+    # per-adapter ledgers merge the same way the totals do: counters
+    # summed across replicas, TTFT sources pooled before percentiles
+    adapters: Dict[str, Dict] = {}
+    for m in all_metrics:
+        for aid, d in m.per_adapter.items():
+            agg = adapters.setdefault(
+                aid, {"requests": 0, "gen_tokens": 0, "ttfts": []})
+            agg["requests"] += d["requests"]
+            agg["gen_tokens"] += d["gen_tokens"]
+            agg["ttfts"].extend(d["ttfts"])
     hit = sum(m.prefix_hit_tokens for m in all_metrics)
     prefill = sum(m.prefill_tokens for m in all_metrics)
     dsteps = sum(m.decode_steps for m in all_metrics)
@@ -263,4 +301,9 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
             4),
         "peak_running": max((m.peak_running for m in all_metrics),
                             default=0),
+        "adapters": {
+            aid: {"requests": d["requests"],
+                  "gen_tokens": d["gen_tokens"],
+                  "ttft_s": _pcts(d["ttfts"])}
+            for aid, d in sorted(adapters.items())},
     }

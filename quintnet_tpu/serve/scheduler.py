@@ -54,6 +54,12 @@ class RequestProgress:
     exported mid-speculation therefore resumes on any replica exactly
     as if it had never speculated (tests/test_fleet.py).
 
+    ``adapter_id`` carries the request's LoRA binding
+    (serve/adapters.py) across preemption and migration: the restoring
+    engine re-binds the same adapter from ITS registry (loading it from
+    the shared safetensors source if it has never served the tenant),
+    so a migrated request keeps producing the adapted stream.
+
     ``rid`` is the EXPORTING engine's request id (engine-local; the
     restoring engine assigns its own)."""
 
@@ -64,6 +70,7 @@ class RequestProgress:
     max_new_tokens: int
     priority: int = 0
     preemptions: int = 0
+    adapter_id: Optional[str] = None
 
 
 @dataclass
@@ -82,6 +89,7 @@ class Request:
     priority: int = 0                       # lower = more urgent
     arrival: int = 0                        # monotone submit stamp
     on_token: Optional[Callable] = None     # streaming callback
+    adapter_id: Optional[str] = None        # LoRA binding (None = base)
 
     # --- runtime (engine-managed) ---
     state: str = WAITING
@@ -125,7 +133,7 @@ class Request:
             key_data=(None if self.key_data is None
                       else np.array(self.key_data, copy=True)),
             max_new_tokens=self.max_new_tokens, priority=self.priority,
-            preemptions=self.preemptions)
+            preemptions=self.preemptions, adapter_id=self.adapter_id)
 
 
 class Scheduler:
@@ -168,9 +176,14 @@ class Scheduler:
         any checkpointed generation) PLUS the first decode write slot,
         so an admitted request can always take at least one step before
         growth/preemption kicks in — but only the blocks NOT already
-        resident in the prefix cache count against the allocator."""
+        resident in the prefix cache count against the allocator.
+        The request's adapter binding namespaces the prefix lookup:
+        identical tokens produce DIFFERENT KV under different adapters,
+        so chains are only shared within one adapter (or the base
+        model)."""
         return self.pool.plan_admission(req.output_ids(),
-                                        req.total_len + 1)
+                                        req.total_len + 1,
+                                        namespace=req.adapter_id)
 
     def blocks_to_admit(self, req: Request) -> int:
         """UNCACHED blocks a request needs at admission (the admission

@@ -112,6 +112,56 @@ def test_lora_save_load_roundtrip(base, tmp_path):
                  lora, back)
 
 
+@pytest.mark.fast
+def test_lora_roundtrip_golden_dtypes_and_llama_targets(tmp_path):
+    """The serving registry's input contract: save_lora/load_lora is a
+    TREE-equal, CONFIG-equal round trip — non-f32 factors keep their
+    dtype (a bf16-trained adapter must not silently upcast on reload)
+    and the full LLAMA_TARGETS name set survives the metadata
+    comma-join."""
+    from quintnet_tpu.models.llama import LlamaConfig, llama_init
+    from quintnet_tpu.models.lora import (LLAMA_TARGETS, load_lora,
+                                          save_lora)
+
+    lcfg_m = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(0), lcfg_m, dtype=jnp.bfloat16)
+    cfg = LoRAConfig(rank=2, alpha=4.0, targets=LLAMA_TARGETS)
+    lora = lora_init(jax.random.key(1), params["blocks"], cfg)
+    # make b non-trivial so equality is a real check, keep bf16
+    lora = jax.tree.map(
+        lambda l: (l + jax.random.normal(jax.random.key(7), l.shape,
+                                         l.dtype) * 0.1).astype(l.dtype),
+        lora)
+    p = str(tmp_path / "llama_adapters.safetensors")
+    save_lora(lora, cfg, p)
+    back, cfg2 = load_lora(p)
+
+    assert cfg2 == cfg                      # rank, alpha AND targets
+    assert cfg2.targets == LLAMA_TARGETS
+    flat_a = jax.tree_util.tree_leaves_with_path(lora)
+    flat_b = jax.tree_util.tree_leaves_with_path(back)
+    assert [k for k, _ in flat_a] == [k for k, _ in flat_b]  # tree-equal
+    for (_, a), (_, b) in zip(flat_a, flat_b):
+        assert b.dtype == jnp.bfloat16     # dtype preserved
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.fast
+def test_lora_config_validation():
+    """Construction-time rejection: rank < 1 is meaningless, and a
+    target name containing ',' would be silently split into phantom
+    targets by the save_lora metadata comma-join on reload."""
+    with pytest.raises(ValueError, match="rank"):
+        LoRAConfig(rank=0)
+    with pytest.raises(ValueError, match="rank"):
+        LoRAConfig(rank=-3)
+    with pytest.raises(ValueError, match=","):
+        LoRAConfig(targets=("qkv", "fc,proj"))
+    with pytest.raises(ValueError, match="non-empty"):
+        LoRAConfig(targets=())
+    LoRAConfig(rank=1)  # the minimum is legal
+
+
 def test_tp_shard_local_merge_matches_single_device(base):
     """The module docstring's claim: with lora_partition_specs, merging
     INSIDE shard_map is exact — no collectives — for column- and

@@ -28,7 +28,19 @@ Exp(rate)). Two trace shapes:
   carries the spec-off numbers, the speedup, the draft acceptance
   rate and ``tokens_per_decode_step`` — the committed-tokens-per-
   program-invocation number that makes the speculation win legible
-  without reading raw metrics.
+  without reading raw metrics;
+- ``--lora-trace``: N tenants spread round-robin over ``--adapters``
+  LoRA adapters (trained variants of one base model, saved through
+  the real safetensors path) — the multi-tenant scenario
+  serve/adapters.py exists for. The A side serves the WHOLE mixed
+  trace through ONE multi-LoRA engine (heterogeneous adapters batched
+  into shared decode steps); the B side is the merged-weight
+  baseline: one DEDICATED engine per adapter serving only its
+  tenant's requests, walls summed — what multi-tenancy costs without
+  adapter batching. The record's value is multi-LoRA tok/s; extras
+  carry the merged totals, the speedup, and the structural signal
+  ``merged_decode_steps / decode_steps`` (shared steps do the work of
+  many dedicated ones, independent of wall-clock noise).
 
 Every mode's extras carry ``decode_steps`` and
 ``tokens_per_decode_step`` (decode_tokens / decode_steps).
@@ -61,11 +73,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine(args, *, prefix_cache: bool, spec: bool = False):
+def build_model(args, params=None):
+    """(family, params) for the bench config — separate from
+    build_engine so the --lora-trace branch can materialise the base
+    params ONCE (for adapter construction and merged baselines)
+    without allocating a throwaway engine's KV pool."""
     import jax
 
-    from quintnet_tpu.serve import (ServeEngine, SpecConfig, gpt2_family,
-                                    llama_family)
+    from quintnet_tpu.serve import gpt2_family, llama_family
 
     # synthetic-config overrides (--n-layer & co): the default tiny
     # model is too small for prefill compute to matter — the
@@ -80,7 +95,8 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False):
 
         cfg = (GPT2Config.tiny(**{"n_layer": 2, **syn_kw})
                if args.synthetic else GPT2Config.base())
-        params = gpt2_init(jax.random.key(args.seed), cfg)
+        if params is None:
+            params = gpt2_init(jax.random.key(args.seed), cfg)
         family = gpt2_family(cfg)
     elif args.model == "llama":
         from quintnet_tpu.models.llama import LlamaConfig, llama_init
@@ -91,11 +107,19 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False):
                for k, v in syn_kw.items()}
         cfg = (LlamaConfig.tiny(**{"n_layers": 2, **lkw})
                if args.synthetic else LlamaConfig())
-        params = llama_init(jax.random.key(args.seed), cfg)
+        if params is None:
+            params = llama_init(jax.random.key(args.seed), cfg)
         family = llama_family(cfg)
     else:
         raise SystemExit(f"unknown --model {args.model}")
+    return family, params
 
+
+def build_engine(args, *, prefix_cache: bool, spec: bool = False,
+                 params=None, adapters=None):
+    from quintnet_tpu.serve import ServeEngine, SpecConfig
+
+    family, params = build_model(args, params=params)
     max_prompt = (args.shared_prefix + args.max_tail if args.prefix_share
                   else args.max_prompt)
     max_seq = min(max_prompt + args.max_new, family.max_positions)
@@ -104,7 +128,8 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False):
         num_blocks=args.num_blocks, max_seq_len=max_seq,
         eos_token_id=args.eos, temperature=args.temperature,
         policy=args.policy, prefix_cache=prefix_cache,
-        spec=SpecConfig(max_draft=args.max_draft) if spec else None)
+        spec=SpecConfig(max_draft=args.max_draft) if spec else None,
+        adapters=adapters, lora_max_rank=args.lora_rank)
 
 
 def poisson_arrivals(rng, n: int, rate: float):
@@ -170,6 +195,40 @@ def prefix_share_trace(args, vocab_size: int):
     return trace
 
 
+def lora_trace(args, vocab_size: int):
+    """The default Poisson trace with each request bound round-robin
+    to one of ``--adapters`` tenants: [(t, prompt, max_new, aid)]."""
+    trace = poisson_trace(args, vocab_size)
+    return [(t, p, m, f"tenant-{i % args.adapters}")
+            for i, (t, p, m) in enumerate(trace)]
+
+
+def make_adapters(args, params, tmpdir: str):
+    """--adapters trained LoRA variants of the base model, each saved
+    through the real safetensors path (the registry's input contract).
+    Returns {adapter_id: (merged_params, path)} — merged weights feed
+    the dedicated-baseline engines."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from quintnet_tpu.models.lora import (LoRAConfig, lora_init,
+                                          lora_merge_tree, save_lora)
+
+    out = {}
+    for i in range(args.adapters):
+        cfg = LoRAConfig(rank=args.lora_rank, alpha=2.0 * args.lora_rank)
+        lora = lora_init(jax.random.key(1000 + i), params["blocks"], cfg)
+        lora = jax.tree.map(
+            lambda l, s=i: l + 0.02 * jax.random.normal(
+                jax.random.key(2000 + s), l.shape), lora)
+        path = os.path.join(tmpdir, f"tenant-{i}.safetensors")
+        save_lora(lora, cfg, path)
+        out[f"tenant-{i}"] = (lora_merge_tree(params, lora, cfg), path)
+    return out
+
+
 def replay(engine, trace, args) -> dict:
     """Warm up (compile EVERY prefill bucket + the decode step OUTSIDE
     the timed window — engine.warmup() invokes each program against
@@ -190,8 +249,11 @@ def replay(engine, trace, args) -> dict:
         if args.steps is not None and step >= args.steps:
             break
         while submitted < len(trace) and trace[submitted][0] <= step:
-            _, prompt, max_new = trace[submitted]
-            engine.submit(prompt, max_new)
+            _, prompt, max_new, *rest = trace[submitted]
+            # --lora-trace entries carry the tenant binding as a 4th
+            # element (None rides the base model)
+            engine.submit(prompt, max_new,
+                          adapter_id=rest[0] if rest else None)
             submitted += 1
         engine.step()
         step += 1
@@ -314,6 +376,72 @@ def run(args) -> dict:
             "extras": extras,
         }
 
+    if args.lora_trace:
+        import tempfile
+
+        from quintnet_tpu.serve import AdapterRegistry
+
+        prefix_cache = args.prefix_cache == "on"
+        spec = args.spec == "on"
+        tmpdir = tempfile.mkdtemp(prefix="serve_bench_lora_")
+        # A: ONE multi-LoRA engine serving the whole mixed-tenant trace
+        _family, base_params = build_model(args)
+        tenants = make_adapters(args, base_params, tmpdir)
+        registry = AdapterRegistry()
+        for aid, (_merged, path) in tenants.items():
+            registry.register(aid, path)
+        eng_lora = build_engine(args, prefix_cache=prefix_cache,
+                                spec=spec, params=base_params,
+                                adapters=registry)
+        trace = lora_trace(args, eng_lora.family.cfg.vocab_size)
+        s_on = replay(eng_lora, trace, args)
+        # B: the merged-weight baseline — one DEDICATED engine per
+        # tenant serving only its own requests (no cross-tenant
+        # batching possible); walls and counters summed. The same
+        # --spec/--prefix-cache settings apply to both sides.
+        merged_wall = merged_gen = merged_steps = merged_dsteps = 0
+        for aid, (merged, _path) in tenants.items():
+            sub = [(t, p, m) for (t, p, m, a) in trace if a == aid]
+            eng_m = build_engine(args, prefix_cache=prefix_cache,
+                                 spec=spec, params=merged)
+            s_m = replay(eng_m, sub, args)
+            merged_wall += s_m["wall_s"]
+            merged_gen += s_m["gen_tokens"]
+            merged_steps += s_m["steps"]
+            merged_dsteps += s_m["decode_steps"]
+        merged_tps = (round(merged_gen / merged_wall, 2)
+                      if merged_wall > 0 else 0.0)
+        extras = _common_extras(args, s_on)
+        extras.update({
+            "lora_trace": True,
+            "adapters": args.adapters,
+            "lora_rank": args.lora_rank,
+            "spec": spec,
+            "prefix_cache": prefix_cache,
+            "per_adapter": s_on["adapters"],
+            "merged_tokens_per_sec": merged_tps,
+            "merged_gen_tokens": merged_gen,
+            "merged_wall_s": round(merged_wall, 4),
+            "merged_decode_steps": merged_dsteps,
+            "merged_steps": merged_steps,
+            # the wall-noise-free signal: one shared multi-LoRA decode
+            # step does the work of many dedicated-engine steps
+            "decode_step_ratio_vs_merged": (
+                round(merged_dsteps / s_on["decode_steps"], 3)
+                if s_on["decode_steps"] else 0.0),
+            "speedup_vs_merged": (
+                round(s_on["tokens_per_sec"] / merged_tps, 3)
+                if merged_tps else 0.0),
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_lora_tokens_per_sec",
+            "value": s_on["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": extras["speedup_vs_merged"],
+            "rc": 0,
+            "extras": extras,
+        }
+
     prefix_cache = args.prefix_cache == "on"
     spec = args.spec == "on"
     engine = build_engine(args, prefix_cache=prefix_cache, spec=spec)
@@ -371,6 +499,16 @@ def main():
                          "spec-on vs spec-off over the same trace")
     ap.add_argument("--pattern", type=int, default=8,
                     help="repeated-pattern length (--spec-trace prompts)")
+    ap.add_argument("--lora-trace", action="store_true",
+                    help="multi-tenant LoRA trace: requests spread over "
+                         "--adapters adapters through ONE multi-LoRA "
+                         "engine, vs dedicated merged-weight engines "
+                         "per adapter over the same trace")
+    ap.add_argument("--adapters", type=int, default=4,
+                    help="distinct LoRA adapters in the --lora-trace")
+    ap.add_argument("--lora-rank", type=int, default=4,
+                    help="rank of the synthetic --lora-trace adapters "
+                         "(and the engine's top rank bucket)")
     ap.add_argument("--max-draft", type=int, default=8,
                     help="max drafted tokens per request per step "
                          "(pins the largest verify bucket)")
