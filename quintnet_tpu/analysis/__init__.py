@@ -37,6 +37,7 @@ from quintnet_tpu.analysis.recompile import (
     RecompileSentinel,
     abstract_signature,
     assert_compile_count,
+    check_serving_compile_counts,
 )
 
 __all__ = [
@@ -55,4 +56,5 @@ __all__ = [
     "RecompileSentinel",
     "abstract_signature",
     "assert_compile_count",
+    "check_serving_compile_counts",
 ]

@@ -123,3 +123,63 @@ def assert_compile_count(expected: Dict[str, int],
     decode=s2)``."""
     for key, n in expected.items():
         sentinels[key].assert_compile_count(n)
+
+
+def check_serving_compile_counts(name: str, counts: Dict[str, int], *,
+                                 max_prefill: Optional[int] = None,
+                                 decode: int = 1) -> None:
+    """The serving bounded-compile promise validated from a PLAIN
+    ``{program: compile_count}`` dict — the form that crosses a
+    process boundary. The sentinels themselves (and their
+    signature-diffing errors) live in the replica process; its
+    dispatcher gets the counts over the wire
+    (``ServeEngine.compile_counts`` → the process fleet's stats frame)
+    and holds them to the same rules the in-process
+    ``ServeFleet.assert_compile_count`` enforces on live sentinels:
+
+    - at most ONE compile per prefill bucket (``prefill[<width>]``),
+      between 1 and ``max_prefill`` (default: the replica's bucket
+      count) in total;
+    - at most one compile per verify bucket (``verify[<k>]``);
+    - exactly ``decode`` compiles of the single ``decode`` program —
+      or 0 when a verify bucket compiled (an engine whose every step
+      speculated legitimately never runs plain decode);
+    - with adapters armed (``decode[r<rank>]`` keys instead), at most
+      one compile per rank bucket.
+
+    Raises :class:`RecompileError` naming the replica and the
+    offending program counts."""
+    per_prefill = {k: v for k, v in counts.items()
+                   if k.startswith("prefill[")}
+    per_verify = {k: v for k, v in counts.items()
+                  if k.startswith("verify[")}
+    per_rank = {k: v for k, v in counts.items()
+                if k.startswith("decode[")}
+    total = sum(per_prefill.values())
+    cap = max_prefill if max_prefill is not None else len(per_prefill)
+    if not 1 <= total <= cap or any(n > 1
+                                    for n in per_prefill.values()):
+        raise RecompileError(
+            f"{name}: expected 1..{cap} compiled prefill bucket "
+            f"program(s) (at most one per bucket), observed {total} "
+            f"({per_prefill})")
+    if any(n > 1 for n in per_verify.values()):
+        raise RecompileError(
+            f"{name}: expected at most one compiled verify program "
+            f"per draft-length bucket, observed {per_verify}")
+    if per_rank:
+        if any(n > 1 for n in per_rank.values()):
+            raise RecompileError(
+                f"{name}: expected at most one compiled decode "
+                f"program per LoRA rank bucket, observed {per_rank}")
+    elif "decode" in counts:
+        d = counts["decode"]
+        has_verify = any(n > 0 for n in per_verify.values())
+        if d != decode and not (has_verify and d == 0):
+            raise RecompileError(
+                f"{name}: expected {decode} compiled decode "
+                f"program(s), observed {d}")
+    else:
+        raise RecompileError(
+            f"{name}: no decode program count reported at all "
+            f"({sorted(counts)})")

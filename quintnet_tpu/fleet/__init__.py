@@ -25,31 +25,56 @@ and failures:
   already guarantees), graceful drain, fleet metrics + per-replica
   compile-count enforcement.
 
+Scaling past one address space (fleet/proc.py + fleet/frontdoor.py +
+fleet/wire.py): :class:`ProcessFleet` runs each replica engine in its
+OWN OS process behind the same submit/stream API — a length-prefixed
+JSON wire protocol, heartbeat-supervised children restarted with
+jittered backoff, and CRASH-SAFE migration from the dispatcher's
+write-ahead token journal (a SIGKILL'd replica's in-flight requests
+resume elsewhere token-identically with zero cooperation from the
+corpse). :class:`FrontDoor` is the asyncio HTTP/SSE server in front of
+either fleet, mapping the typed ``Overloaded`` shedding onto
+429/503 + Retry-After.
+
 tools/fleet_bench.py replays a trace against the fleet per routing
 policy — with a mid-trace replica kill and an over-capacity burst —
-and emits one JSON record per policy (artifacts/fleet_r08.json).
+and emits one JSON record per policy (threads:
+artifacts/fleet_r08.json; ``--process``: artifacts/fleet_r12.json).
 """
 
 from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
 from quintnet_tpu.fleet.fleet import FleetMetrics, FleetRequest, ServeFleet
+from quintnet_tpu.fleet.frontdoor import FrontDoor
 from quintnet_tpu.fleet.health import (CLOSED, DEAD, HALF_OPEN, HEALTHY,
-                                       OPEN, STOPPED, CircuitBreaker)
+                                       OPEN, STALLED, STARTING, STOPPED,
+                                       Backoff, CircuitBreaker,
+                                       HeartbeatMonitor)
+from quintnet_tpu.fleet.proc import ProcessFleet, ProcReplica, replica_main
 from quintnet_tpu.fleet.replica import Replica
-from quintnet_tpu.fleet.router import POLICIES, Router
+from quintnet_tpu.fleet.router import POLICIES, Router, eligible
 
 __all__ = [
     "AdmissionQueue",
+    "Backoff",
     "CircuitBreaker",
     "FleetMetrics",
     "FleetRequest",
+    "FrontDoor",
+    "HeartbeatMonitor",
     "Overloaded",
     "POLICIES",
+    "ProcReplica",
+    "ProcessFleet",
     "Replica",
     "Router",
     "ServeFleet",
+    "eligible",
+    "replica_main",
     "HEALTHY",
     "DEAD",
     "STOPPED",
+    "STARTING",
+    "STALLED",
     "CLOSED",
     "OPEN",
     "HALF_OPEN",

@@ -44,9 +44,10 @@ import numpy as np
 
 from quintnet_tpu.analysis import assert_compile_count as _assert_cc
 from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
-from quintnet_tpu.fleet.health import DEAD, HEALTHY, CircuitBreaker
+from quintnet_tpu.fleet.health import DEAD, CircuitBreaker
 from quintnet_tpu.fleet.replica import Replica
 from quintnet_tpu.fleet.router import Router
+from quintnet_tpu.fleet.router import eligible as router_eligible
 from quintnet_tpu.serve import metrics as serve_metrics
 
 
@@ -76,15 +77,44 @@ class FleetRequest:
         self.output: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
+        # the dispatcher-side WRITE-AHEAD token journal: every token a
+        # replica streams is recorded here BEFORE the user callback
+        # sees it. For the process fleet this journal IS the migration
+        # source — a SIGKILL'd replica cannot be asked to export, but
+        # prompt + journal + (submit key advanced one split per
+        # journaled token) reconstructs its RequestProgress exactly
+        # (fleet/proc.py). The thread fleet keeps it for uniformity
+        # (its migration path uses the engine's own export).
+        self.committed: List[int] = []
+        self.last_seen = False            # a token arrived with is_last
 
     def deliver(self, token: int, last: bool) -> None:
-        """Worker-thread token delivery (streaming surface). Tokens
-        survive migration without duplication: a resumed request only
-        emits tokens generated AFTER its checkpoint."""
+        """Worker-thread token delivery (streaming surface). Journals
+        first (write-ahead), then forwards. Tokens survive migration
+        without duplication: a resumed request only emits tokens
+        generated AFTER its checkpoint."""
+        self.committed.append(int(token))
+        if last:
+            self.last_seen = True
         if self.first_token_time is None:
             self.first_token_time = self._clock()
         if self.on_token is not None:
-            self.on_token(self.fid, token, last)
+            try:
+                self.on_token(self.fid, token, last)
+            except Exception:  # noqa: BLE001
+                # a client callback failing (an SSE writer whose event
+                # loop closed, a buggy consumer) must never propagate
+                # into the replica worker and read as a replica death
+                pass
+
+    def remaining_deadline(self) -> Optional[float]:
+        """Seconds of deadline budget left on the fleet clock (None =
+        no deadline). The dispatcher re-anchors this on a replica
+        engine's own clock at ingest — absolute readings do not
+        transfer between clocks (or processes)."""
+        if self.deadline is None:
+            return None
+        return self.deadline - self._clock()
 
     def outstanding_cost(self) -> int:
         """Tokens of work still owed: the (re-)prefill plus remaining
@@ -107,8 +137,13 @@ class FleetMetrics:
     shed_queue_full: int = 0
     shed_deadline: int = 0
     shed_shutdown: int = 0
+    # admitted requests retired MID-GENERATION at their deadline
+    # (typed serve.DeadlineExceeded) — disjoint from shed_deadline,
+    # which counts requests still QUEUED at expiry
+    deadline_exceeded: int = 0
     migrations: int = 0
     replica_deaths: int = 0
+    stalls: int = 0                     # missed-heartbeat detections
     restarts: int = 0
     ttfts: List[float] = field(default_factory=list)
     latencies: List[float] = field(default_factory=list)
@@ -132,8 +167,10 @@ class FleetMetrics:
             "shed_deadline": self.shed_deadline,
             "shed_shutdown": self.shed_shutdown,
             "shed_rate": round(self.shed_rate, 4),
+            "deadline_exceeded": self.deadline_exceeded,
             "migrations": self.migrations,
             "replica_deaths": self.replica_deaths,
+            "stalls": self.stalls,
             "restarts": self.restarts,
             "ttft_s": serve_metrics._pcts(self.ttfts),
             "latency_s": serve_metrics._pcts(self.latencies),
@@ -214,8 +251,12 @@ class ServeFleet:
 
         ``key`` defaults to ``fold_in(key(0), fid)`` — fleet-level, so
         a request's sampled output does not depend on which replica
-        serves it. ``deadline_s`` is a time-to-first-dispatch budget
-        from now; a request still queued when it expires is shed.
+        serves it. ``deadline_s`` is a whole-request budget from now,
+        enforced end to end: a request still queued when it expires is
+        shed (``Overloaded('deadline')``), and one already DECODING at
+        expiry is retired by its engine with a typed
+        ``serve.DeadlineExceeded`` (blocks published) instead of
+        finishing a stream the client stopped waiting for.
         ``on_token(fid, token, is_last)`` fires from a replica worker
         thread as tokens are produced, across migrations, each token
         exactly once. ``adapter_id``: serve through the named LoRA
@@ -338,11 +379,20 @@ class ServeFleet:
     def _on_reject(self, rep: Replica, freq: FleetRequest,
                    error: BaseException) -> None:
         """A request the engine refused at ingest (ValueError from its
-        submit/restore validation): error that request's waiter; the
-        replica stays healthy."""
+        submit/restore validation) or retired with a typed terminal
+        error (DeadlineExceeded mid-decode, Overloaded('deadline') at
+        ingest): error that request's waiter; the replica stays
+        healthy."""
+        from quintnet_tpu.serve.scheduler import DeadlineExceeded
+
         with self._cv:
             rep.in_flight -= 1
             rep.outstanding_tokens -= freq.cost
+            if isinstance(error, DeadlineExceeded):
+                self.metrics.deadline_exceeded += 1
+            elif (isinstance(error, Overloaded)
+                    and error.reason == "deadline"):
+                self.metrics.shed_deadline += 1
             freq.error = error
             self._open -= 1
             freq.event.set()
@@ -397,7 +447,7 @@ class ServeFleet:
                 continue
             chaos = rep.chaos
             if chaos is not None and getattr(chaos, "rearm", False):
-                chaos.killed = False
+                chaos.rearm_now()
             self._replicas[i] = self._spawn(rep.name, chaos)
             self.metrics.restarts += 1
 
@@ -409,9 +459,7 @@ class ServeFleet:
                 f"instead of serving a result the client stopped "
                 f"waiting for")
         while len(self._queue):
-            cands = [r for r in self._replicas
-                     if r.state == HEALTHY and not r.paused
-                     and r.in_flight < r.max_dispatch]
+            cands = router_eligible(self._replicas)
             if not cands:
                 return
             # adapter affinity: peek the queue head's binding so the
@@ -510,6 +558,23 @@ class ServeFleet:
 
     def breaker(self, name: str) -> CircuitBreaker:
         return self._breakers[name]
+
+    def health(self) -> Dict:
+        """Cheap liveness snapshot (no engine access beyond counters) —
+        what the HTTP front door's /healthz serves
+        (fleet/frontdoor.py); shape-compatible with
+        :meth:`ProcessFleet.health`."""
+        with self._cv:
+            return {
+                "replicas": {r.name: {"state": r.state,
+                                      "steps": r.steps,
+                                      "in_flight": r.in_flight,
+                                      "breaker": self._breakers[r.name].state}
+                             for r in self._replicas},
+                "queue_depth": len(self._queue),
+                "open_requests": self._open,
+                "draining": self._draining,
+            }
 
     def reset_metrics(self) -> None:
         """Fresh ledgers fleet-wide (bench warmup boundary): fleet

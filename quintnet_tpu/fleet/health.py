@@ -1,7 +1,14 @@
-"""Per-replica health state + circuit breaker.
+"""Per-replica health state + circuit breaker + heartbeat/backoff
+policy.
 
 A replica is either serving (``HEALTHY``), dead with its worker thread
 exited on an error (``DEAD``), or cleanly shut down (``STOPPED``).
+Process replicas (fleet/proc.py) add two states a thread can't be in:
+``STARTING`` (spawned, engine still building — not a dispatch
+candidate until its hello lands) and ``STALLED`` (the process is alive
+and its socket open, but heartbeats stopped — a wedge, detected by
+:class:`HeartbeatMonitor`, handled like a death EXCEPT the supervisor
+must also kill the zombie before restarting).
 Whether a DEAD replica gets restarted is the :class:`CircuitBreaker`'s
 call — the classic three-state breaker (Nygard, *Release It!*):
 
@@ -27,10 +34,12 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
-# replica lifecycle states (Replica.state)
+# replica lifecycle states (Replica.state / ProcReplica.state)
 HEALTHY = "healthy"
 DEAD = "dead"
 STOPPED = "stopped"
+STARTING = "starting"   # process spawned, hello not yet received
+STALLED = "stalled"     # alive but not heartbeating (wedged process)
 
 # breaker states (CircuitBreaker.state)
 CLOSED = "closed"
@@ -81,3 +90,57 @@ class CircuitBreaker:
             self.state = HALF_OPEN
             return True
         return False
+
+
+class HeartbeatMonitor:
+    """Liveness by heartbeat age, the ONLY wedge detector that needs no
+    cooperation from the wedged side: a process that SIGKILLs shows an
+    EOF on its socket, but a process that merely stops making progress
+    (deadlocked GIL, runaway compile, swapped-out host) keeps its
+    socket open and looks healthy to everything except the absence of
+    heartbeats. ``budget_s`` is the detection SLA: a replica whose last
+    beat is older than the budget is declared stalled and routed
+    around (fleet/proc.py). The clock is injectable so tests advance
+    time without sleeping."""
+
+    def __init__(self, budget_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        if budget_s <= 0:
+            raise ValueError(f"budget_s must be > 0, got {budget_s}")
+        self.budget_s = float(budget_s)
+        self.clock = clock
+        self.last_beat = clock()   # spawn counts as the first beat
+
+    def beat(self) -> None:
+        self.last_beat = self.clock()
+
+    @property
+    def age_s(self) -> float:
+        return self.clock() - self.last_beat
+
+    @property
+    def expired(self) -> bool:
+        return self.age_s > self.budget_s
+
+
+class Backoff:
+    """Jittered exponential restart backoff (the ft_run supervisor's
+    relaunch discipline, made policy): attempt ``n`` (1-based) waits
+    ``base * 2^(n-1)`` capped at ``cap``, times a jitter factor in
+    ``[1, 1+jitter]`` so N replicas felled by one cause do not
+    restart — and re-fail — in lockstep. ``rand`` is injectable for
+    deterministic tests."""
+
+    def __init__(self, *, base_s: float = 0.05, cap_s: float = 5.0,
+                 jitter: float = 0.25, rand: Callable[[], float] = None):
+        import random
+
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter = float(jitter)
+        self.rand = rand if rand is not None else random.random
+
+    def delay_s(self, attempt: int) -> float:
+        """Backoff before restart attempt ``attempt`` (1-based)."""
+        raw = min(self.base_s * (2 ** max(attempt - 1, 0)), self.cap_s)
+        return raw * (1.0 + self.jitter * self.rand())

@@ -1,0 +1,1235 @@
+"""Process-isolated fleet: each ServeEngine replica is its own OS
+process, and the dispatcher survives any of them dying at any
+instruction.
+
+The thread fleet (fleet/fleet.py) proves the MIGRATION math — exact
+resume from ``prompt + committed tokens + evolved PRNG key`` — but all
+its replicas share one address space: a real SIGKILL, OOM kill, or
+wedged runtime takes out the dispatcher with them, which is precisely
+the failure a production serving tier must absorb (Llumnix-style live
+migration between instances; the tools/ft_run.py supervisor story
+applied to serving). This module promotes replicas to crash domains:
+
+- **process replicas** — :func:`replica_main` runs one engine per
+  spawned process, speaking a small length-prefixed JSON protocol
+  (fleet/wire.py) over a localhost TCP socket: submit, token stream,
+  pause/resume, export, stats, warmup, arm-chaos, stop, heartbeat.
+  JSON + sockets, not pickles + shared memory: a replica can corrupt
+  only itself.
+- **write-ahead token journal** — the dispatcher records every
+  streamed token in :attr:`FleetRequest.committed` BEFORE the client
+  callback sees it. Because the engine's key discipline advances the
+  PRNG chain exactly one split per committed token
+  (serve/engine.py), ``prompt + journal + n-split(submit key, n)`` IS
+  the dead replica's :class:`RequestProgress` — migration needs no
+  cooperation from the corpse. Tokens the victim committed but never
+  flushed are simply regenerated (same key chain ⇒ same tokens), so
+  the client stream stays token-identical with ``is_last`` delivered
+  exactly once.
+- **supervision** — heartbeats from a dedicated child thread (they
+  keep beating through long XLA compiles); a replica whose beat age
+  exceeds ``heartbeat_budget_s`` is declared STALLED (distinct from
+  death: its socket is still open), routed around, its work migrated,
+  and the zombie SIGKILLed. Restarts are gated by the same
+  :class:`CircuitBreaker` the thread fleet uses, spaced by jittered
+  exponential :class:`~quintnet_tpu.fleet.health.Backoff` so a
+  poisoned fleet does not crash-loop in lockstep.
+
+Degradation order under trouble is explicit and monotone: shed new
+work (typed ``Overloaded`` at the bounded queue) → pause admissions →
+drain → migrate. The HTTP front door (fleet/frontdoor.py) maps the
+first rung onto 429/503 + Retry-After.
+
+Engine factories cross the process boundary as a picklable SPEC —
+``{"file": "/abs/builder.py", "func": "build_engine", "kwargs":
+{...}}`` (or ``"module": "pkg.mod"``) — never as closures: the spawn
+child imports the builder and constructs its own engine, which is also
+what guarantees every replica is built from the same (family, params)
+the migration contract requires.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from quintnet_tpu.fleet import wire
+from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
+from quintnet_tpu.fleet.fleet import FleetMetrics, FleetRequest
+from quintnet_tpu.fleet.health import (DEAD, HEALTHY, STALLED, STARTING,
+                                       STOPPED, Backoff, CircuitBreaker,
+                                       HeartbeatMonitor)
+from quintnet_tpu.fleet.router import Router
+from quintnet_tpu.fleet.router import eligible as router_eligible
+
+
+# ---------------------------------------------------------------------------
+# the child: one engine, one process
+# ---------------------------------------------------------------------------
+
+
+def _load_builder(spec: Dict) -> Callable:
+    """Resolve an engine-builder spec in THIS process. ``file`` loads a
+    module by path (tests and tools need no installable package);
+    ``module`` imports by dotted name."""
+    func = spec["func"]
+    if "file" in spec:
+        import importlib.util
+
+        s = importlib.util.spec_from_file_location(
+            "_qt_engine_builder", spec["file"])
+        mod = importlib.util.module_from_spec(s)
+        s.loader.exec_module(mod)
+    elif "module" in spec:
+        import importlib
+
+        mod = importlib.import_module(spec["module"])
+    else:
+        raise ValueError(
+            f"engine spec needs 'file' or 'module', got {sorted(spec)}")
+    return getattr(mod, func)
+
+
+def replica_main(name: str, host: str, port: int, token: str,
+                 engine_spec: Dict, *, heartbeat_s: float = 0.1,
+                 chaos_spec: Optional[Dict] = None,
+                 platform: Optional[str] = None,
+                 poll_s: float = 0.005) -> None:
+    """Entry point of a replica process (multiprocessing 'spawn'
+    target). Builds the engine from ``engine_spec``, connects back to
+    the dispatcher at ``(host, port)``, identifies itself with
+    ``token`` in its hello (so concurrent restarts cannot cross-wire),
+    then serves frames until told to stop — or until chaos/a real
+    fault kills it, which is the point of being a process."""
+    import queue as _queue
+
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from quintnet_tpu.ft.chaos import ChaosMonkey
+
+    engine = _load_builder(engine_spec)(**engine_spec.get("kwargs", {}))
+    chaos = ChaosMonkey(**chaos_spec) if chaos_spec else None
+
+    sock = socket.create_connection((host, port), timeout=30.0)
+    sock.settimeout(None)
+    send_lock = threading.Lock()
+    stop_ev = threading.Event()
+
+    def send(frame: Dict) -> None:
+        with send_lock:
+            wire.send_frame(sock, frame)
+
+    send({"t": "hello", "name": name, "token": token,
+          "pid": os.getpid(), "limits": engine.limits(),
+          "v": wire.WIRE_VERSION})
+
+    cmds: "_queue.Queue" = _queue.Queue()
+
+    def reader() -> None:
+        try:
+            while True:
+                cmds.put(wire.recv_frame(sock))
+        except (wire.ConnectionClosed, OSError):
+            cmds.put(None)      # dispatcher went away -> shut down
+
+    def heartbeat() -> None:
+        # a dedicated thread so heartbeats keep flowing through long
+        # engine.step() calls (first-touch XLA compiles take seconds);
+        # only a genuine wedge — or the stall injector — silences them
+        while not stop_ev.wait(heartbeat_s):
+            if chaos is not None and chaos.stalled:
+                continue
+            try:
+                send({"t": "hb", "steps": steps[0]})
+            except OSError:
+                return
+
+    steps = [0]
+    rid2fid: Dict[int, int] = {}
+    paused = False
+    threading.Thread(target=reader, daemon=True,
+                     name=f"{name}-reader").start()
+    threading.Thread(target=heartbeat, daemon=True,
+                     name=f"{name}-hb").start()
+
+    def deliver(rid: int, tok: int, last: bool) -> None:
+        send({"t": "tok", "fid": rid2fid[rid], "tok": int(tok),
+              "last": bool(last)})
+
+    def handle(cmd: Dict) -> bool:
+        nonlocal paused, chaos
+        t = cmd["t"]
+        if t == "submit":
+            fid = cmd["fid"]
+            try:
+                prog = wire.progress_from_wire(cmd["progress"])
+                rid = engine.restore_progress(prog, on_token=deliver)
+                # registered BEFORE any token can flow: restore only
+                # queues — tokens appear at the next step()
+                rid2fid[rid] = fid
+            except (ValueError, KeyError, wire.WireError) as e:
+                send({"t": "reject", "fid": fid,
+                      "error": wire.error_to_wire(e)})
+        elif t == "pause":
+            paused = True
+        elif t == "resume":
+            paused = False
+        elif t == "export":
+            send({"t": "export", "id": cmd["id"],
+                  "progress": [wire.progress_to_wire(p)
+                               for p in engine.export_progress()]})
+        elif t == "stats":
+            send({"t": "stats", "id": cmd["id"], "steps": steps[0],
+                  "compile": engine.compile_counts(),
+                  "metrics": engine.metrics.summary(),
+                  "admitted": engine.metrics.admitted})
+        elif t == "warmup":
+            engine.warmup()
+            send({"t": "ack", "id": cmd["id"]})
+        elif t == "reset":
+            engine.metrics = type(engine.metrics)(clock=engine.clock)
+            steps[0] = 0
+            send({"t": "ack", "id": cmd["id"]})
+        elif t == "arm_chaos":
+            chaos = ChaosMonkey(**cmd["spec"])
+            send({"t": "ack", "id": cmd["id"]})
+        elif t == "stop":
+            return False
+        return True
+
+    try:
+        running = True
+        while running:
+            # block on the inbox only when idle — a busy engine steps
+            # back-to-back and just peeks for commands between steps
+            idle = (paused or not engine.has_work
+                    or (chaos is not None and chaos.stalled))
+            try:
+                cmd = (cmds.get(timeout=poll_s) if idle
+                       else cmds.get_nowait())
+            except _queue.Empty:
+                cmd = False
+            if cmd is None:
+                return              # dispatcher hung up
+            if cmd is not False:
+                running = handle(cmd)
+                continue            # drain all pending commands first
+            if chaos is not None and chaos.stalled:
+                continue            # wedged: alive, silent, useless
+            if paused or not engine.has_work:
+                continue
+            finished = engine.step()
+            steps[0] += 1
+            for rid in finished:
+                fid = rid2fid.pop(rid)
+                err = engine.request(rid).error
+                if err is not None:
+                    send({"t": "failed", "fid": fid,
+                          "error": wire.error_to_wire(err)})
+                else:
+                    send({"t": "fin", "fid": fid})
+            if chaos is not None:
+                chaos.on_step_end(steps[0])
+        send({"t": "bye"})
+    except Exception as e:  # noqa: BLE001 — cooperative death export
+        # a mode='raise' chaos kill or a real engine fault: export
+        # best-effort (the dispatcher's journal makes this OPTIONAL —
+        # it reconstructs the same payloads if this frame never lands)
+        try:
+            send({"t": "death", "error": wire.error_to_wire(e),
+                  "progress": [wire.progress_to_wire(p)
+                               for p in engine.export_progress()]})
+        except Exception:   # noqa: BLE001
+            pass
+        os._exit(1)
+    finally:
+        stop_ev.set()
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the parent: one socket + one supervisor record per replica
+# ---------------------------------------------------------------------------
+
+
+class ProcReplica:
+    """Dispatcher-side handle for one replica process: the spawn
+    record, the socket (once the hello lands), the reader thread, and
+    the routing counters the fleet lock owns. Exposes the same
+    candidate surface the thread :class:`Replica` does
+    (``state``/``paused``/``in_flight``/``max_dispatch``/
+    ``outstanding_tokens``/``adapter_resident``) so
+    :func:`router.eligible` and the :class:`Router` policies apply
+    unchanged."""
+
+    def __init__(self, name: str, fleet: "ProcessFleet",
+                 chaos_spec: Optional[Dict]):
+        self.name = name
+        self.fleet = fleet
+        self.chaos_spec = chaos_spec
+        self.token = uuid.uuid4().hex
+        self.state = STARTING
+        self.paused = False
+        self.in_flight = 0
+        self.outstanding_tokens = 0
+        self.max_dispatch = fleet._max_dispatch or 0  # sized at hello
+        self.steps = 0
+        self.pid: Optional[int] = None
+        self.limits: Optional[Dict] = None
+        self.sock: Optional[socket.socket] = None
+        self.hb = HeartbeatMonitor(fleet.heartbeat_budget_s,
+                                   clock=fleet.clock)
+        self.spawned_at = fleet.clock()
+        self.restart_at: Optional[float] = None   # set on death/stall
+        self.migrated = False     # this incarnation's work already moved
+        self.error: Optional[BaseException] = None
+        self._fid2freq: Dict[int, FleetRequest] = {}
+        # adapters this incarnation has been sent (affinity heuristic:
+        # the child's registry loaded them on first use; its own LRU
+        # may have evicted — affinity is a preference, never a promise)
+        self._adapters_seen: set = set()
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, tuple] = {}
+        self._rpc_counter = 0
+
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        self.proc = ctx.Process(
+            target=replica_main,
+            args=(name, *fleet._address, self.token, fleet.engine_spec),
+            kwargs={"heartbeat_s": fleet.heartbeat_s,
+                    "chaos_spec": chaos_spec,
+                    "platform": fleet.platform},
+            name=f"fleet-{name}", daemon=True)
+        self.proc.start()
+
+    # ---- wire ---------------------------------------------------------
+    def send(self, frame: Dict) -> None:
+        if self.sock is None:
+            raise OSError(f"replica {self.name} has no connection")
+        with self._send_lock:
+            wire.send_frame(self.sock, frame)
+
+    def rpc(self, frame: Dict, *, timeout: float = 60.0) -> Dict:
+        """Request/response over the frame stream (stats, export,
+        warmup, reset, arm_chaos). The reader thread completes it; a
+        connection loss aborts every outstanding RPC immediately
+        instead of letting callers sit out their full timeout against
+        a corpse."""
+        if self.sock is None:
+            raise OSError(f"replica {self.name} has no connection "
+                          f"(state={self.state})")
+        ev = threading.Event()
+        slot: Dict = {}
+        with self._send_lock:
+            self._rpc_counter += 1
+            rid = self._rpc_counter
+            self._pending[rid] = (ev, slot)
+            frame = dict(frame, id=rid)
+            wire.send_frame(self.sock, frame)
+        if not ev.wait(timeout):
+            self._pending.pop(rid, None)
+            raise TimeoutError(
+                f"replica {self.name}: no reply to {frame['t']!r} "
+                f"within {timeout}s (state={self.state})")
+        if "frame" not in slot:
+            raise OSError(
+                f"replica {self.name}: connection lost before the "
+                f"{frame['t']!r} reply")
+        return slot["frame"]
+
+    def _abort_pending(self) -> None:
+        """Wake every in-flight RPC with no reply (connection gone)."""
+        with self._send_lock:
+            pending, self._pending = self._pending, {}
+        for ev, _slot in pending.values():
+            ev.set()
+
+    def adapter_resident(self, adapter_id: str) -> bool:
+        return adapter_id in self._adapters_seen
+
+    def unfinished(self) -> List[FleetRequest]:
+        return list(self._fid2freq.values())
+
+    def kill(self) -> None:
+        """SIGKILL the child — no cleanup, no cooperation; the journal
+        migration path owes it nothing. Goes through the Process
+        handle, NOT the hello-reported pid: a replica hung while still
+        STARTING (engine build wedged, hello never sent) has no pid
+        yet but must be killable all the same."""
+        try:
+            if self.proc.is_alive():
+                self.proc.kill()
+        except (OSError, ProcessLookupError, ValueError):
+            pass
+
+    # ---- reader -------------------------------------------------------
+    def attach(self, sock: socket.socket, hello: Dict) -> None:
+        """Complete the handshake (fleet lock held by the caller)."""
+        import struct as _struct
+
+        # sends time out at the SOCKET level (SO_SNDTIMEO hits send()
+        # only — the reader thread's blocking recv is untouched): a
+        # replica so wedged it stops draining its socket must fail the
+        # dispatcher's send with OSError (-> death + migration), never
+        # block it inside the fleet lock, where a stuck sendall would
+        # freeze dispatch, stall detection and result delivery alike
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        _struct.pack("ll", 10, 0))
+        self.sock = sock
+        self.pid = hello.get("pid")
+        self.limits = hello.get("limits")
+        if not self.max_dispatch:
+            self.max_dispatch = 2 * int(self.limits["max_slots"])
+        self.hb.beat()
+        self.state = HEALTHY
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name=f"fleet-{self.name}-reader").start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = wire.recv_frame(self.sock)
+                rid = frame.get("id")
+                if rid is not None:
+                    pend = self._pending.pop(rid, None)
+                    if pend is not None:
+                        pend[1]["frame"] = frame
+                        pend[0].set()
+                    continue
+                self.fleet._on_frame(self, frame)
+        except (wire.ConnectionClosed, wire.WireError, OSError):
+            pass
+        # EOF only after every buffered frame was processed — the
+        # journal is as complete as the kernel's view of the stream
+        self._abort_pending()
+        self.fleet._on_conn_lost(self)
+
+
+class ProcessFleet:
+    """N replica PROCESSES behind one submit/stream API — the
+    :class:`~quintnet_tpu.fleet.fleet.ServeFleet` surface with real
+    crash domains. See the module docstring for the design; the
+    operational deltas vs the thread fleet:
+
+    - replicas are spawned from ``engine_spec`` (picklable builder
+      spec), handshake over localhost TCP, and are dispatch candidates
+      only after their hello (state STARTING until then);
+    - migration is journal-driven: a SIGKILL'd or stalled replica's
+      in-flight requests are reconstructed from the dispatcher's
+      write-ahead token journal and resumed elsewhere,
+      token-identically, without any cooperation from the victim;
+    - a stalled replica (heartbeat age > ``heartbeat_budget_s``) is
+      routed around within the budget, its work migrated, the zombie
+      SIGKILLed — the breaker records it exactly like a death, but
+      ``metrics.stalls`` counts it separately;
+    - restarts are breaker-gated AND backoff-spaced (jittered
+      exponential, :class:`~quintnet_tpu.fleet.health.Backoff`);
+    - dispatch-side connection failure = death: the send's requests
+      (and everything in flight there) re-queue at the front and the
+      next healthy replica takes them — the retry-with-backoff story
+      for replica connection failures.
+    """
+
+    def __init__(self, engine_spec: Dict, *, n_replicas: int = 2,
+                 policy: str = "least_work", max_pending: int = 64,
+                 max_dispatch: Optional[int] = None,
+                 trip_after: int = 3, breaker_reset_s: float = 30.0,
+                 heartbeat_s: float = 0.1,
+                 heartbeat_budget_s: Optional[float] = None,
+                 backoff: Optional[Backoff] = None,
+                 chaos: Optional[Sequence[Dict]] = None,
+                 platform: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 name_prefix: str = "p", poll_s: float = 0.02,
+                 spawn_timeout_s: float = 300.0):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.engine_spec = dict(engine_spec)
+        self.platform = platform
+        self.clock = clock
+        self.heartbeat_s = float(heartbeat_s)
+        # default budget: generous vs the beat period (the beat thread
+        # is immune to compiles, so 10 periods of silence means wedged,
+        # not busy), floored for scheduler-noise robustness
+        self.heartbeat_budget_s = float(
+            heartbeat_budget_s if heartbeat_budget_s is not None
+            else max(10 * heartbeat_s, 1.0))
+        self.backoff = backoff or Backoff()
+        self.metrics = FleetMetrics()
+        self._router = Router(policy)
+        self._cv = threading.Condition()
+        self._queue = AdmissionQueue(max_pending, clock=clock)
+        self._requests: Dict[int, FleetRequest] = {}
+        self._fid_counter = 0
+        self._open = 0
+        self._draining = False
+        self._closed = False
+        self._max_dispatch = max_dispatch
+        self._poll_s = poll_s
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self._tokens_delivered = 0   # running journal total: O(1)
+        #                              reads, survives replica deaths
+        # fleet-level limits, cached at the FIRST hello (all replicas
+        # share one spec): submit validation must keep working while
+        # every replica happens to be mid-restart — the thread fleet
+        # just queues in that window, and so must we
+        self._limits: Optional[Dict] = None
+
+        chaos_list = [] if chaos is None else (
+            list(chaos) if isinstance(chaos, (list, tuple)) else [chaos])
+        names = [f"{name_prefix}{i}" for i in range(n_replicas)]
+        by_target: Dict[str, Dict] = {}
+        for spec in chaos_list:
+            spec = dict(spec)
+            target = spec.pop("target", None) or names[0]
+            if target not in names:
+                raise ValueError(
+                    f"chaos target {target!r} names no replica "
+                    f"(have {names})")
+            by_target[target] = spec
+
+        self._breakers = {
+            name: CircuitBreaker(trip_after=trip_after,
+                                 reset_s=breaker_reset_s, clock=clock)
+            for name in names}
+
+        # the listener children dial back into; accept thread matches
+        # hello tokens to replicas so concurrent (re)spawns can't
+        # cross-wire
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        self._address = self._listener.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-accept", daemon=True)
+        self._accept_thread.start()
+
+        self._replicas: List[ProcReplica] = [
+            ProcReplica(name, self, by_target.get(name))
+            for name in names]
+        self._await_hellos()
+
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="fleet-dispatch",
+            daemon=True)
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------------
+    # handshake
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.settimeout(30.0)
+                hello = wire.recv_frame(conn)
+                conn.settimeout(None)
+                if hello.get("t") != "hello":
+                    conn.close()
+                    continue
+            except (wire.ConnectionClosed, wire.WireError, OSError):
+                conn.close()
+                continue
+            with self._cv:
+                rep = next((r for r in self._replicas
+                            if r.token == hello.get("token")
+                            and r.state == STARTING), None)
+                if rep is None or self._closed:
+                    conn.close()
+                    continue
+                rep.attach(conn, hello)
+                if self._limits is None:
+                    self._limits = rep.limits
+                self._cv.notify_all()
+
+    def _await_hellos(self) -> None:
+        deadline = self.clock() + self._spawn_timeout_s
+        with self._cv:
+            while True:
+                missing = [r.name for r in self._replicas
+                           if r.state == STARTING]
+                if not missing:
+                    return
+                dead = [r.name for r in self._replicas
+                        if r.state == STARTING and not r.proc.is_alive()]
+                if dead or self.clock() >= deadline:
+                    self._closed = True
+                    for rep in self._replicas:
+                        rep.kill()
+                    try:
+                        self._listener.close()
+                    except OSError:
+                        pass
+                    raise RuntimeError(
+                        f"replica process(es) failed to start: "
+                        f"{dead or missing} (exited early: {dead}; "
+                        f"spawn timeout {self._spawn_timeout_s}s) — "
+                        f"check the engine builder spec "
+                        f"{self.engine_spec.get('file') or self.engine_spec.get('module')}")
+                self._cv.wait(0.05)
+
+    @property
+    def limits(self) -> Dict:
+        """The shared engine limits (all replicas are built from one
+        spec; the first hello ever received speaks for the fleet —
+        cached, so submit keeps validating while every replica is
+        mid-restart). The constructor's hello barrier guarantees this
+        is set before any submit can run."""
+        if self._limits is not None:
+            return self._limits
+        raise RuntimeError("no replica has completed its handshake")
+
+    # ------------------------------------------------------------------
+    # submission / results
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, key=None,
+               priority: int = 0, deadline_s: Optional[float] = None,
+               on_token=None, adapter_id: Optional[str] = None) -> int:
+        """Queue one request fleet-wide; returns its fleet id. The
+        contract is :meth:`ServeFleet.submit`'s — typed
+        :class:`Overloaded` instead of unbounded queueing, fleet-level
+        default keys, end-to-end deadlines — with admissibility checked
+        against the replicas' hello-reported ``limits`` (no engine
+        lives in this process)."""
+        import jax
+
+        from quintnet_tpu.serve.engine import check_admissible
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        check_admissible(prompt.size, int(max_new_tokens),
+                         **self.limits)
+        with self._cv:
+            self.metrics.submitted += 1
+            if self._draining or self._closed:
+                self.metrics.shed_shutdown += 1
+                raise Overloaded(
+                    "shutdown", "fleet is draining; not accepting work")
+            now = self.clock()
+            if deadline_s is not None and deadline_s <= 0:
+                self.metrics.shed_deadline += 1
+                raise Overloaded(
+                    "deadline", f"deadline_s={deadline_s} already "
+                    f"expired at submit")
+            fid = self._fid_counter
+            self._fid_counter += 1
+            if key is None:
+                key = jax.random.fold_in(jax.random.key(0), fid)
+            freq = FleetRequest(
+                fid, prompt, int(max_new_tokens), key=key,
+                priority=int(priority),
+                deadline=(None if deadline_s is None
+                          else now + float(deadline_s)),
+                on_token=on_token, submit_time=now, clock=self.clock,
+                adapter_id=adapter_id)
+            # the journal's key anchor: the submit key as raw data —
+            # advancing it one split per journaled token reconstructs
+            # any later chain state host-side (no device in the child
+            # needed, no cooperation from a dead one possible)
+            freq.key_data0 = np.asarray(jax.random.key_data(key))
+            try:
+                self._queue.push(freq)
+            except Overloaded:
+                self.metrics.shed_queue_full += 1
+                raise
+            self._requests[fid] = freq
+            self._open += 1
+            self.metrics.accepted += 1
+            self._cv.notify_all()
+            return fid
+
+    def result(self, fid: int, *,
+               timeout: Optional[float] = None) -> np.ndarray:
+        freq = self._requests[fid]
+        if not freq.event.wait(timeout):
+            raise TimeoutError(
+                f"fleet request {fid} unfinished after {timeout}s "
+                f"(replica={freq.replica_name}, "
+                f"migrations={freq.migrations})")
+        if freq.error is not None:
+            raise freq.error
+        return freq.output
+
+    def request(self, fid: int) -> FleetRequest:
+        return self._requests[fid]
+
+    def generate(self, prompts: Sequence, *, max_new_tokens, keys=None,
+                 priorities=None,
+                 timeout: Optional[float] = None) -> List[np.ndarray]:
+        n = len(prompts)
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * n
+        keys = [None] * n if keys is None else keys
+        priorities = [0] * n if priorities is None else priorities
+        if not (len(max_new_tokens) == len(keys) == len(priorities) == n):
+            raise ValueError(
+                "per-prompt argument lengths must match prompts")
+        fids = [self.submit(p, m, key=k, priority=pr)
+                for p, m, k, pr in zip(prompts, max_new_tokens, keys,
+                                       priorities)]
+        return [self.result(f, timeout=timeout) for f in fids]
+
+    # ------------------------------------------------------------------
+    # journal reconstruction — the crash-safe migration payload
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _advance_key_data(key_data: np.ndarray, n: int) -> np.ndarray:
+        """The engine's key discipline, replayed host-side: every
+        committed token advances the per-request chain by exactly one
+        ``split -> take the carry`` (prefill, decode and verify all
+        share it — serve/engine.py). ``n`` journaled tokens after the
+        submit key is therefore ``n`` splits, and the result is
+        BIT-equal to the key_data a cooperative export would have
+        carried."""
+        import jax
+
+        key = jax.random.wrap_key_data(np.asarray(key_data))
+        for _ in range(int(n)):
+            key = jax.random.split(key, 2)[0]
+        return np.asarray(jax.random.key_data(key))
+
+    def _progress_for(self, freq: FleetRequest):
+        """The request's RequestProgress as witnessed by the JOURNAL —
+        what gets (re)dispatched, fresh or migrated. Needs nothing from
+        the replica that was serving it."""
+        from quintnet_tpu.serve.scheduler import RequestProgress
+
+        return RequestProgress(
+            rid=freq.fid, prompt=np.asarray(freq.prompt, np.int32),
+            generated=list(freq.committed),
+            key_data=self._advance_key_data(freq.key_data0,
+                                            len(freq.committed)),
+            max_new_tokens=freq.max_new_tokens,
+            priority=freq.priority,
+            preemptions=0, adapter_id=freq.adapter_id,
+            deadline_s=freq.remaining_deadline())
+
+    # ------------------------------------------------------------------
+    # frame handling (replica reader threads)
+    # ------------------------------------------------------------------
+    def _on_frame(self, rep: ProcReplica, frame: Dict) -> None:
+        t = frame.get("t")
+        if t == "tok":
+            tok, last = int(frame["tok"]), bool(frame["last"])
+            with self._cv:
+                # journal AND deliver under the fleet lock: migration
+                # reads the journal under the same lock, so a late
+                # token racing a stall-triggered migration is either
+                # journaled-and-delivered before the reconstruction
+                # (included in the resumed progress, never repeated)
+                # or dropped here (ownership gone) and regenerated by
+                # the survivor — exactly once, in order, either way.
+                # Delivering outside the lock would open a window
+                # where the survivor's token n+1 beats the victim's
+                # token n to the client. Callbacks are contractually
+                # quick (the thread fleet fires them from its engine
+                # worker for the same reason).
+                freq = rep._fid2freq.get(frame["fid"])
+                if freq is None:
+                    return
+                self._tokens_delivered += 1
+                # deliver() is THE journal-then-forward discipline
+                # (fleet/fleet.py), client-callback faults isolated
+                # there — one implementation for both fleets
+                freq.deliver(tok, last)
+        elif t == "fin":
+            self._finish(rep, frame["fid"])
+        elif t in ("failed", "reject"):
+            self._reject(rep, frame["fid"],
+                         wire.error_from_wire(frame["error"]))
+        elif t == "hb":
+            rep.hb.beat()
+            rep.steps = int(frame.get("steps", rep.steps))
+        elif t == "death":
+            # cooperative death (an in-child raise): same handling as
+            # a connection loss; the export rides along but the
+            # journal supersedes it (one reconstruction path, not two)
+            self._handle_death(rep, stalled=False)
+        elif t == "bye":
+            with self._cv:
+                rep.state = STOPPED
+
+    def _finish(self, rep: ProcReplica, fid: int) -> None:
+        with self._cv:
+            freq = rep._fid2freq.pop(fid, None)
+            if freq is None:
+                return
+            self._finalize_locked(rep, freq)
+
+    def _finalize_locked(self, rep: Optional[ProcReplica],
+                         freq: FleetRequest) -> None:
+        if freq.event.is_set():
+            return      # already shed/finalized (close-path races)
+        if rep is not None:
+            rep.in_flight -= 1
+            rep.outstanding_tokens -= freq.cost
+            self._breakers[rep.name].record_success()
+        # the journal IS the output: prompt + every streamed token
+        freq.output = np.concatenate(
+            [freq.prompt, np.asarray(freq.committed, np.int32)])
+        freq.finish_time = self.clock()
+        self.metrics.finished += 1
+        if freq.first_token_time is not None:
+            self.metrics.ttfts.append(
+                freq.first_token_time - freq.submit_time)
+        self.metrics.latencies.append(
+            freq.finish_time - freq.submit_time)
+        self._open -= 1
+        freq.event.set()
+        self._cv.notify_all()
+
+    def _reject(self, rep: ProcReplica, fid: int,
+                error: BaseException) -> None:
+        from quintnet_tpu.serve.scheduler import DeadlineExceeded
+
+        with self._cv:
+            freq = rep._fid2freq.pop(fid, None)
+            if freq is None:
+                return
+            rep.in_flight -= 1
+            rep.outstanding_tokens -= freq.cost
+            if isinstance(error, DeadlineExceeded):
+                self.metrics.deadline_exceeded += 1
+            elif (isinstance(error, Overloaded)
+                    and error.reason == "deadline"):
+                self.metrics.shed_deadline += 1
+            freq.error = error
+            self._open -= 1
+            freq.event.set()
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # death / stall / restart supervision
+    # ------------------------------------------------------------------
+    def _on_conn_lost(self, rep: ProcReplica) -> None:
+        """Reader-thread EOF: every frame the kernel had buffered has
+        been processed (the journal is complete up to the last byte
+        the victim flushed) — anything beyond it is regenerated
+        deterministically on the survivor."""
+        self._handle_death(rep, stalled=False)
+
+    def _handle_death(self, rep: ProcReplica, *, stalled: bool) -> None:
+        with self._cv:
+            self._handle_death_locked(rep, stalled=stalled)
+
+    def _handle_death_locked(self, rep: ProcReplica, *,
+                             stalled: bool) -> None:
+        """The one death path (fleet lock held): conn-lost EOF, stall
+        detection, cooperative death frames and dispatch-send failures
+        all land here — one body, so a fix applies once."""
+        if rep.state == STOPPED:
+            self._cv.notify_all()
+            return
+        if rep.migrated or (self._closed and not rep.unfinished()):
+            # work already moved (stall handler beat the EOF) or
+            # nothing to move — just make the replica restartable
+            rep.state = DEAD
+            self._cv.notify_all()
+            return
+        rep.state = STALLED if stalled else DEAD
+        rep.migrated = True
+        if stalled:
+            self.metrics.stalls += 1
+        else:
+            self.metrics.replica_deaths += 1
+        breaker = self._breakers[rep.name]
+        breaker.record_failure()
+        rep.restart_at = (self.clock()
+                          + self.backoff.delay_s(
+                              breaker.consecutive_failures))
+        self._migrate_locked(rep)
+        self._cv.notify_all()
+
+    def _migrate_locked(self, rep: ProcReplica) -> None:
+        exports = sorted(rep._fid2freq.items())
+        rep._fid2freq = {}
+        rep.in_flight = 0
+        rep.outstanding_tokens = 0
+        migrated: List[FleetRequest] = []
+        for _fid, freq in exports:
+            if freq.last_seen:
+                # the final token (is_last) was journaled and already
+                # delivered — only the bookkeeping frame died with the
+                # replica; the request is COMPLETE, finalize it here
+                self._finalize_locked(None, freq)
+                continue
+            if self._closed:
+                self._shed_locked(freq, "shutdown",
+                                  "replica died during close")
+                continue
+            freq.migrations += 1
+            self.metrics.migrations += 1
+            migrated.append(freq)
+        self._queue.push_front(migrated)
+
+    def _tend_locked(self) -> None:
+        now = self.clock()
+        for i, rep in enumerate(self._replicas):
+            if rep.state == STARTING:
+                if not rep.proc.is_alive():
+                    # died building its engine: a failure like any
+                    # other, breaker + backoff decide the retry
+                    self._handle_death(rep, stalled=False)
+                elif now - rep.spawned_at > self._spawn_timeout_s:
+                    rep.kill()
+                    self._handle_death(rep, stalled=True)
+                continue
+            if rep.state == HEALTHY and rep.hb.expired:
+                # the wedge path: alive socket, silent process — route
+                # around it within the heartbeat budget, move its work
+                # via the journal, and put the zombie down
+                self._handle_death(rep, stalled=True)
+                rep.kill()
+                continue
+            if rep.state == STALLED and not rep.proc.is_alive():
+                rep.state = DEAD
+            if rep.state != DEAD:
+                continue
+            if rep.restart_at is not None and now < rep.restart_at:
+                continue
+            if not self._breakers[rep.name].allow_restart():
+                continue
+            chaos_spec = rep.chaos_spec
+            if not (chaos_spec or {}).get("rearm", False):
+                chaos_spec = None   # one-shot faults do not respawn
+            self._replicas[i] = ProcReplica(rep.name, self, chaos_spec)
+            self.metrics.restarts += 1
+
+    # ------------------------------------------------------------------
+    # dispatcher
+    # ------------------------------------------------------------------
+    def _shed_locked(self, freq: FleetRequest, reason: str,
+                     message: str) -> None:
+        if freq.event.is_set():
+            # already finalized (close() sheds unfinished() while a
+            # racing EOF handler migrates the same map — whoever is
+            # second must not double-decrement _open)
+            return
+        if reason == "deadline":
+            self.metrics.shed_deadline += 1
+        else:
+            self.metrics.shed_shutdown += 1
+        freq.error = Overloaded(reason, message)
+        self._open -= 1
+        freq.event.set()
+        self._cv.notify_all()
+
+    def _reserve_dispatch_locked(self):
+        """Pick a replica and claim the queue head for it (fleet lock
+        held): ownership — ``rep._fid2freq`` and the routing counters —
+        is established HERE, so the payload construction and the
+        socket write can happen OUTSIDE the lock without racing the
+        journal or a migration. Returns (rep, freq) or None."""
+        for freq in self._queue.shed_expired():
+            self._shed_locked(
+                freq, "deadline",
+                f"request {freq.fid} still queued at its deadline")
+        if not len(self._queue):
+            return None
+        cands = router_eligible(self._replicas)
+        if not cands:
+            return None
+        rep = self._router.pick(
+            cands, adapter_id=self._queue.peek_adapter_id())
+        freq = self._queue.pop()
+        freq.cost = freq.outstanding_cost()
+        freq.replica_name = rep.name
+        rep._fid2freq[freq.fid] = freq
+        rep.in_flight += 1
+        rep.outstanding_tokens += freq.cost
+        if freq.adapter_id is not None:
+            rep._adapters_seen.add(freq.adapter_id)
+        return rep, freq
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                self._tend_locked()
+                job = self._reserve_dispatch_locked()
+                if job is None:
+                    self._cv.wait(self._poll_s)
+                    continue
+            rep, freq = job
+            # payload construction OUTSIDE the lock: the key replay is
+            # one jax split per journaled token — a long-lived
+            # migrated request must not stall token delivery and
+            # stall detection while its key is advanced
+            payload = wire.progress_to_wire(self._progress_for(freq))
+            try:
+                rep.send({"t": "submit", "fid": freq.fid,
+                          "progress": payload})
+            except OSError:
+                # connection failure AT dispatch (dead socket, or a
+                # send timed out against a wedged peer): the replica
+                # is done — this request (and everything else parked
+                # there, via its fid2freq ownership) re-queues at the
+                # front and restarts follow the breaker + jittered
+                # backoff; the retry is free. Idempotent with a
+                # concurrent stall-handler migration (migrated flag).
+                with self._cv:
+                    self._handle_death_locked(rep, stalled=False)
+
+    # ------------------------------------------------------------------
+    # lifecycle / operations
+    # ------------------------------------------------------------------
+    def pause_all(self) -> None:
+        for rep in self._replicas:
+            rep.paused = True
+            if rep.state == HEALTHY:
+                try:
+                    rep.send({"t": "pause"})
+                except OSError:
+                    pass
+
+    def resume_all(self) -> None:
+        with self._cv:
+            for rep in self._replicas:
+                rep.paused = False
+                if rep.state == HEALTHY:
+                    try:
+                        rep.send({"t": "resume"})
+                    except OSError:
+                        pass
+            self._cv.notify_all()
+
+    def pause_replica(self, name: str, paused: bool = True) -> None:
+        rep = self.replica(name)
+        rep.paused = paused
+        if rep.state == HEALTHY:
+            rep.send({"t": "pause" if paused else "resume"})
+        with self._cv:
+            self._cv.notify_all()
+
+    def warmup(self) -> None:
+        """Compile every replica's full program set (prefill buckets +
+        decode [+ verify]) outside any timed window — the bench calls
+        this instead of routing sacrificial requests. Replicas compile
+        CONCURRENTLY (independent processes; serializing the RPCs
+        would multiply warmup wall time by the replica count); the
+        first failure propagates."""
+        errs: List[BaseException] = []
+
+        def one(rep: ProcReplica) -> None:
+            try:
+                rep.rpc({"t": "warmup"}, timeout=600.0)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(rep,),
+                                    name=f"warmup-{rep.name}")
+                   for rep in self._replicas if rep.state == HEALTHY]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def arm_chaos(self, target: str, spec: Dict) -> None:
+        """Arm a ChaosMonkey spec dict (kill_at_step/mode/rearm) inside
+        the RUNNING replica process — the bench arms after warmup so
+        kill_at_step counts replay steps only. The spec also sticks to
+        the parent-side handle so ``rearm=True`` faults re-arm on
+        restart, matching the thread fleet's semantics."""
+        rep = self.replica(target)
+        spec = {k: v for k, v in dict(spec).items() if k != "target"}
+        rep.chaos_spec = dict(spec, target=target)
+        rep.rpc({"t": "arm_chaos", "spec": spec}, timeout=60.0)
+
+    def export_progress(self, name: str) -> List:
+        """A LIVE replica's own view of its unfinished work (graceful
+        ops; the crash path never needs it)."""
+        frames = self.replica(name).rpc({"t": "export"}, timeout=60.0)
+        return [wire.progress_from_wire(p) for p in frames["progress"]]
+
+    def drain(self, *, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown, the last rungs of the degradation ladder:
+        refuse new work (shed typed), let everything accepted finish —
+        migrations included — then stop the processes."""
+        deadline = None if timeout is None else self.clock() + timeout
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+            while self._open > 0:
+                if deadline is not None and self.clock() >= deadline:
+                    raise TimeoutError(
+                        f"drain: {self._open} request(s) still open "
+                        f"after {timeout}s")
+                self._cv.wait(self._poll_s)
+        self.close()
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._draining = True
+            self._closed = True
+            for freq in self._queue.drain_all():
+                self._shed_locked(freq, "shutdown",
+                                  "fleet closed before dispatch")
+            self._cv.notify_all()
+        self._dispatcher.join(timeout=10.0)
+        for rep in self._replicas:
+            try:
+                if rep.state == HEALTHY:
+                    rep.send({"t": "stop"})
+            except OSError:
+                pass
+        for rep in self._replicas:
+            rep.proc.join(timeout=5.0)
+            if rep.proc.is_alive():
+                rep.kill()
+                rep.proc.join(timeout=5.0)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._cv:
+            for rep in self._replicas:
+                for freq in rep.unfinished():
+                    self._shed_locked(
+                        freq, "shutdown",
+                        "fleet closed with the request in flight")
+                # emptied so a trailing EOF handler sees nothing left
+                # to migrate or re-shed
+                rep._fid2freq = {}
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def replicas(self) -> List[ProcReplica]:
+        return list(self._replicas)
+
+    def replica(self, name: str) -> ProcReplica:
+        reps = {r.name: r for r in self._replicas}
+        if name not in reps:
+            raise ValueError(f"no replica named {name!r} "
+                             f"(have {sorted(reps)})")
+        return reps[name]
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        return self._breakers[name]
+
+    def health(self) -> Dict:
+        """Cheap liveness snapshot (no RPCs) — what the HTTP front
+        door's /healthz serves."""
+        with self._cv:
+            return {
+                "replicas": {r.name: {"state": r.state,
+                                      "pid": r.pid,
+                                      "steps": r.steps,
+                                      "in_flight": r.in_flight,
+                                      "heartbeat_age_s": round(
+                                          r.hb.age_s, 3),
+                                      "breaker":
+                                          self._breakers[r.name].state}
+                             for r in self._replicas},
+                "queue_depth": len(self._queue),
+                "open_requests": self._open,
+                "draining": self._draining,
+            }
+
+    def reset_metrics(self) -> None:
+        """Fresh ledgers fleet-wide (bench warmup boundary), including
+        each child engine's ServeMetrics and step counter."""
+        with self._cv:
+            self.metrics = FleetMetrics()
+            self._tokens_delivered = 0
+        for rep in self._replicas:
+            if rep.state == HEALTHY:
+                rep.rpc({"t": "reset"}, timeout=60.0)
+                rep.steps = 0
+
+    def tokens_delivered(self) -> int:
+        """Fleet-wide generated-token count from the dispatcher's own
+        journal — exact even when replicas died mid-run (their
+        engines' ledgers died with them; the journal did not). A
+        running counter, not a scan: summary() must not slow down
+        linearly with requests ever served."""
+        with self._cv:
+            return self._tokens_delivered
+
+    def replica_stats(self) -> Dict[str, Dict]:
+        """Per-LIVE-replica engine stats over the wire ({compile,
+        metrics, steps, admitted}). Dead replicas' engine ledgers died
+        with their process — by design; the parent-side journal and
+        FleetMetrics carry everything the fleet promises to keep."""
+        out: Dict[str, Dict] = {}
+        for rep in self._replicas:
+            if rep.state != HEALTHY:
+                continue
+            try:
+                f = rep.rpc({"t": "stats"}, timeout=60.0)
+            except (TimeoutError, OSError):
+                continue
+            out[rep.name] = {"compile": f["compile"],
+                             "metrics": f["metrics"],
+                             "steps": f["steps"],
+                             "admitted": f["admitted"]}
+        return out
+
+    def summary(self) -> Dict:
+        stats = self.replica_stats()
+        with self._cv:
+            per_replica = {
+                rep.name: {
+                    "state": rep.state,
+                    "pid": rep.pid,
+                    "steps": rep.steps,
+                    "in_flight": rep.in_flight,
+                    "outstanding_tokens": rep.outstanding_tokens,
+                    "breaker": self._breakers[rep.name].state,
+                    "compile_counts": stats.get(rep.name, {}).get(
+                        "compile"),
+                } for rep in self._replicas}
+        out = self.metrics.summary()
+        out["policy"] = self._router.policy
+        out["replicas"] = per_replica
+        out["tokens_delivered"] = self.tokens_delivered()
+        out["engines"] = {name: s["metrics"]
+                          for name, s in stats.items()}
+        return out
+
+    def assert_compile_count(self, prefill: Optional[int] = None,
+                             decode: int = 1) -> None:
+        """The bounded-compile promise, accounted PER PROCESS: each
+        live replica that admitted work reports its sentinel counts
+        over the wire ({program: compiles}) and
+        analysis.check_serving_compile_counts validates the same rules
+        the thread fleet enforces on in-process sentinels."""
+        from quintnet_tpu.analysis import check_serving_compile_counts
+
+        for name, s in self.replica_stats().items():
+            if s["admitted"] == 0:
+                continue
+            check_serving_compile_counts(
+                f"replica {name}", s["compile"],
+                max_prefill=prefill, decode=decode)

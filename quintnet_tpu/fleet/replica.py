@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from quintnet_tpu.fleet.admission import Overloaded
 from quintnet_tpu.fleet.health import DEAD, HEALTHY, STOPPED
 
 
@@ -141,10 +142,21 @@ class Replica:
             _freq.deliver(token, last)
 
         if progress is None:
+            # the fleet's deadline becomes the ENGINE's: remaining
+            # budget re-anchored on this engine's clock, so a request
+            # mid-decode at its deadline is retired typed
+            # (DeadlineExceeded) instead of finishing a stream the
+            # client abandoned
+            deadline_s = freq.remaining_deadline()
+            if deadline_s is not None and deadline_s <= 0:
+                raise Overloaded(
+                    "deadline",
+                    f"request {freq.fid} reached its deadline between "
+                    f"dispatch and ingest")
             rid = self.engine.submit(
                 freq.prompt, freq.max_new_tokens, key=freq.key,
                 priority=freq.priority, on_token=deliver,
-                adapter_id=freq.adapter_id)
+                adapter_id=freq.adapter_id, deadline_s=deadline_s)
         else:
             # progress carries the adapter binding; restore re-pins it
             # from THIS replica's registry (loading on a cold replica)
@@ -167,10 +179,11 @@ class Replica:
                 for freq, progress in work:
                     try:
                         self._ingest(freq, progress)
-                    except ValueError as e:
+                    except (ValueError, KeyError, Overloaded) as e:
                         # a REQUEST-scoped rejection (engine submit/
-                        # restore validation) must not kill the
-                        # replica: error that request's waiter only
+                        # restore validation, unknown adapter, typed
+                        # Overloaded/DeadlineExceeded) must not kill
+                        # the replica: error that request's waiter only
                         self._on_reject(self, freq, e)
                 if paused or not self.engine.has_work:
                     continue
@@ -178,7 +191,14 @@ class Replica:
                 self.steps += 1
                 for rid in finished:
                     freq = self._rid2freq.pop(rid)
-                    self._on_finish(self, freq, self.engine.result(rid))
+                    err = self.engine.request(rid).error
+                    if err is not None:
+                        # typed terminal failure (DeadlineExceeded):
+                        # the waiter gets the error, the replica lives
+                        self._on_reject(self, freq, err)
+                    else:
+                        self._on_finish(self, freq,
+                                        self.engine.result(rid))
                 if self.chaos is not None:
                     self.chaos.on_step_end(self.steps)
         except Exception as e:  # ChaosKilled or a real engine fault

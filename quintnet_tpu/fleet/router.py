@@ -31,7 +31,21 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from quintnet_tpu.fleet.health import HEALTHY
+
 POLICIES = ("least_work", "round_robin")
+
+
+def eligible(replicas: List) -> List:
+    """The dispatch-candidate predicate both fleets share (threads:
+    fleet/fleet.py; processes: fleet/proc.py): serving state, not
+    paused, below its dispatch window. STARTING (process still
+    building its engine) and STALLED (missed heartbeats) replicas fail
+    the state test exactly like DEAD ones — a stalled replica is
+    routed AROUND, never at."""
+    return [r for r in replicas
+            if r.state == HEALTHY and not r.paused
+            and r.in_flight < r.max_dispatch]
 
 
 class Router:

@@ -1,0 +1,284 @@
+"""Versioned wire serialization + length-prefixed framing for the
+cross-process fleet.
+
+Everything that crosses a process boundary in ``fleet/proc.py`` (and
+anything a future remote dispatcher would persist) goes through here:
+
+- **payloads** — ``progress_to_wire``/``progress_from_wire`` for
+  :class:`~quintnet_tpu.serve.scheduler.RequestProgress` (THE migration
+  contract: prompt + committed tokens + evolved PRNG key + adapter
+  binding + remaining deadline), ``request_to_wire``/``request_from_wire``
+  for :class:`~quintnet_tpu.serve.scheduler.Request` submit payloads,
+  and ``error_to_wire``/``error_from_wire`` for the typed rejection
+  types (:class:`~quintnet_tpu.fleet.admission.Overloaded`,
+  :class:`~quintnet_tpu.serve.scheduler.DeadlineExceeded`, plus plain
+  ``ValueError``/``KeyError`` request-scoped rejections). Every payload
+  carries ``{"kind": ..., "v": N}``; a payload whose version this
+  build does not speak is rejected with an actionable
+  :class:`WireVersionError` naming both versions — never a KeyError
+  three fields deep.
+- **framing** — ``send_frame``/``recv_frame``: 4-byte big-endian
+  length prefix + UTF-8 JSON over any stream socket. JSON, not pickle:
+  a replica process must never be able to execute code in the
+  dispatcher by crafting a payload, and the frames stay inspectable
+  with tcpdump. Arrays ride as base64 raw bytes + dtype + shape, so a
+  PRNG key round-trips bit-exactly (a float/list round-trip would not
+  be bit-exact for every dtype and the resume contract IS bit-exactness).
+
+The committed-tokens-only discipline of ``RequestProgress``
+(speculative drafts never reach an export, serve/scheduler.py) is what
+makes this wire format complete: there is no engine-internal state —
+spec drafts, prefix-cache chains, tentative blocks — that needs to
+cross the wire for a resume to be token-identical. The restoring
+engine rebuilds all of it from ``prompt + generated + key_data``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# Bump when a payload's schema changes shape. Readers accept exactly
+# the versions they know how to decode; unknown versions fail with an
+# actionable error instead of silently mis-parsing.
+WIRE_VERSION = 1
+
+_LEN = struct.Struct(">I")
+MAX_FRAME_BYTES = 64 * 1024 * 1024  # a corrupt length prefix must not
+#                                     allocate gigabytes
+
+
+class WireError(ValueError):
+    """Malformed wire payload (bad kind, missing field, bad frame)."""
+
+
+class WireVersionError(WireError):
+    """Payload version this build does not speak."""
+
+
+class ConnectionClosed(ConnectionError):
+    """The peer closed the stream mid-protocol (or before a frame)."""
+
+
+# ---------------------------------------------------------------------------
+# primitives
+
+
+def _enc_array(a: Optional[np.ndarray]) -> Optional[Dict]:
+    if a is None:
+        return None
+    a = np.ascontiguousarray(a)
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _dec_array(d: Optional[Dict]) -> Optional[np.ndarray]:
+    if d is None:
+        return None
+    try:
+        raw = base64.b64decode(d["b64"])
+        a = np.frombuffer(raw, dtype=np.dtype(d["dtype"]))
+        return a.reshape(d["shape"]).copy()
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"malformed array payload {d!r}: {e}") from e
+
+
+def _check_header(payload: Dict, kind: str,
+                  known_versions: Tuple[int, ...] = (WIRE_VERSION,)):
+    if not isinstance(payload, dict):
+        raise WireError(
+            f"expected a {kind!r} payload dict, got {type(payload).__name__}")
+    got_kind = payload.get("kind")
+    if got_kind != kind:
+        raise WireError(
+            f"expected payload kind {kind!r}, got {got_kind!r} — the "
+            f"frame was routed to the wrong decoder")
+    v = payload.get("v")
+    if v not in known_versions:
+        raise WireVersionError(
+            f"{kind} payload version {v!r} is not supported by this "
+            f"build (speaks {list(known_versions)}); upgrade the older "
+            f"side of the connection — dispatcher and replicas must "
+            f"deserialize each other's payloads")
+
+
+def _require(payload: Dict, kind: str, *fields: str):
+    missing = [f for f in fields if f not in payload]
+    if missing:
+        raise WireError(
+            f"{kind} payload (v{payload.get('v')}) is missing required "
+            f"field(s) {missing}: {sorted(payload)} present")
+
+
+# ---------------------------------------------------------------------------
+# RequestProgress — the migration contract
+
+
+def progress_to_wire(p) -> Dict:
+    """Serialize a :class:`RequestProgress` (committed tokens only —
+    see the class docstring for why that is complete)."""
+    return {
+        "kind": "request_progress",
+        "v": WIRE_VERSION,
+        "rid": int(p.rid),
+        "prompt": _enc_array(np.asarray(p.prompt, np.int32)),
+        "generated": [int(t) for t in p.generated],
+        "key_data": _enc_array(None if p.key_data is None
+                               else np.asarray(p.key_data)),
+        "max_new_tokens": int(p.max_new_tokens),
+        "priority": int(p.priority),
+        "preemptions": int(p.preemptions),
+        "adapter_id": p.adapter_id,
+        "deadline_s": (None if p.deadline_s is None
+                       else float(p.deadline_s)),
+    }
+
+
+def progress_from_wire(payload: Dict):
+    from quintnet_tpu.serve.scheduler import RequestProgress
+
+    _check_header(payload, "request_progress")
+    _require(payload, "request_progress", "rid", "prompt", "generated",
+             "key_data", "max_new_tokens")
+    return RequestProgress(
+        rid=int(payload["rid"]),
+        prompt=_dec_array(payload["prompt"]),
+        generated=[int(t) for t in payload["generated"]],
+        key_data=_dec_array(payload["key_data"]),
+        max_new_tokens=int(payload["max_new_tokens"]),
+        priority=int(payload.get("priority", 0)),
+        preemptions=int(payload.get("preemptions", 0)),
+        adapter_id=payload.get("adapter_id"),
+        deadline_s=payload.get("deadline_s"))
+
+
+# ---------------------------------------------------------------------------
+# Request — the submit payload
+
+
+def request_to_wire(req, *, deadline_s: Optional[float] = None) -> Dict:
+    """Serialize a :class:`~quintnet_tpu.serve.scheduler.Request`
+    submit payload (the callback and engine-runtime fields stay local;
+    ``deadline_s`` is the REMAINING budget — absolute clock times do
+    not survive a process boundary)."""
+    return {
+        "kind": "request",
+        "v": WIRE_VERSION,
+        "rid": int(req.rid),
+        "prompt": _enc_array(np.asarray(req.prompt, np.int32)),
+        "max_new_tokens": int(req.max_new_tokens),
+        "priority": int(req.priority),
+        "key_data": _enc_array(None if req.key_data is None
+                               else np.asarray(req.key_data)),
+        "generated": [int(t) for t in req.generated],
+        "adapter_id": req.adapter_id,
+        "deadline_s": None if deadline_s is None else float(deadline_s),
+    }
+
+
+def request_from_wire(payload: Dict):
+    from quintnet_tpu.serve.scheduler import Request
+
+    _check_header(payload, "request")
+    _require(payload, "request", "rid", "prompt", "max_new_tokens")
+    req = Request(
+        rid=int(payload["rid"]),
+        prompt=_dec_array(payload["prompt"]),
+        max_new_tokens=int(payload["max_new_tokens"]),
+        priority=int(payload.get("priority", 0)),
+        adapter_id=payload.get("adapter_id"))
+    req.key_data = _dec_array(payload.get("key_data"))
+    req.generated = [int(t) for t in payload.get("generated", [])]
+    return req, payload.get("deadline_s")
+
+
+# ---------------------------------------------------------------------------
+# typed errors (shed / deadline / request-scoped rejections)
+
+
+def error_to_wire(e: BaseException) -> Dict:
+    from quintnet_tpu.fleet.admission import Overloaded
+    from quintnet_tpu.serve.scheduler import DeadlineExceeded
+
+    out = {"kind": "error", "v": WIRE_VERSION, "message": str(e)}
+    if isinstance(e, Overloaded):
+        out["type"] = "overloaded"
+        out["reason"] = e.reason
+    elif isinstance(e, DeadlineExceeded):
+        out["type"] = "deadline_exceeded"
+        out["rid"] = getattr(e, "rid", None)
+        out["generated"] = getattr(e, "generated", 0)
+    elif isinstance(e, KeyError):
+        out["type"] = "key_error"
+    else:
+        # ValueError and anything else request-scoped: the receiving
+        # side re-raises a ValueError with the original message — the
+        # TYPE of an arbitrary exception does not cross the wire
+        out["type"] = "value_error"
+    return out
+
+
+def error_from_wire(payload: Dict) -> BaseException:
+    from quintnet_tpu.fleet.admission import Overloaded
+    from quintnet_tpu.serve.scheduler import DeadlineExceeded
+
+    _check_header(payload, "error")
+    _require(payload, "error", "type", "message")
+    t, msg = payload["type"], payload["message"]
+    if t == "overloaded":
+        return Overloaded(payload.get("reason", "shutdown"), msg)
+    if t == "deadline_exceeded":
+        return DeadlineExceeded(msg, rid=payload.get("rid"),
+                                generated=int(payload.get("generated", 0)))
+    if t == "key_error":
+        return KeyError(msg)
+    return ValueError(msg)
+
+
+# ---------------------------------------------------------------------------
+# framing
+
+
+def send_frame(sock, obj: Dict) -> None:
+    """One length-prefixed JSON frame. The caller serializes access —
+    two threads interleaving sendall() would corrupt the stream."""
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionClosed(
+                f"peer closed the connection mid-frame "
+                f"({len(buf)}/{n} bytes received)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock) -> Dict:
+    """Blocking read of one frame; raises :class:`ConnectionClosed` on
+    EOF (a SIGKILL'd peer looks like EOF after the kernel flushes
+    whatever it had buffered — the dispatcher drains those frames
+    first, which is what keeps the token journal complete)."""
+    head = sock.recv(_LEN.size)
+    if not head:
+        raise ConnectionClosed("peer closed the connection")
+    if len(head) < _LEN.size:
+        head += _recv_exact(sock, _LEN.size - len(head))
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME_BYTES:
+        raise WireError(
+            f"frame length {n} exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}) — corrupt length prefix or a "
+            f"desynchronized stream")
+    try:
+        return json.loads(_recv_exact(sock, n).decode("utf-8"))
+    except json.JSONDecodeError as e:
+        raise WireError(f"frame is not valid JSON: {e}") from e

@@ -66,18 +66,27 @@ class ChaosMonkey:
     (e.g. ``"r1"``); ``None`` targets the process/first replica.
     In-process replica kills must use ``mode='raise'`` —
     ``hard``/``sigterm`` take down the whole process, which is the
-    ``tools/ft_run.py`` supervisor story, not a single replica's.
-    ``rearm=True`` lets a fleet re-arm the monkey each time it restarts
-    the dead replica (repeated-failure injection for the circuit
-    breaker); the default fires once.
+    ``tools/ft_run.py`` supervisor story (and, for serving, exactly
+    what a PROCESS replica of fleet/proc.py arms: the child vanishes
+    mid-step like a SIGKILL'd node). ``mode='stall'`` is the wedge
+    injector: the process neither dies nor raises — it just stops
+    stepping AND stops heartbeating while keeping its sockets open, so
+    the missed-heartbeat detection path is testable separately from
+    clean death (readers poll :attr:`stalled`). ``rearm=True`` lets a
+    fleet re-arm the monkey each time it restarts the dead replica
+    (repeated-failure injection for the circuit breaker) — stall
+    rearm matches the kill semantics: the restarted replica's fresh
+    step counter re-triggers at ``kill_at_step``; the default fires
+    once.
     """
 
     kill_at_step: Optional[int] = None
-    mode: str = "hard"  # hard | sigterm | raise
+    mode: str = "hard"  # hard | sigterm | raise | stall
     fail_restores: int = 0
     target: Optional[str] = None
     rearm: bool = False
     killed: bool = field(default=False, init=False)
+    stalled: bool = field(default=False, init=False)
     restore_failures_injected: int = field(default=0, init=False)
 
     @staticmethod
@@ -102,6 +111,12 @@ class ChaosMonkey:
         if global_step < self.kill_at_step:
             return
         self.killed = True
+        if self.mode == "stall":
+            # the wedge: no exception, no exit — the poller observes
+            # `stalled` and stops making progress/heartbeating while
+            # its connections stay open (fleet/proc.py replica_main)
+            self.stalled = True
+            return
         if self.mode == "raise":
             raise ChaosKilled(global_step)
         if self.mode == "sigterm":
@@ -113,6 +128,15 @@ class ChaosMonkey:
               flush=True)
         sys.stdout.flush()
         os._exit(CHAOS_KILL_EXIT_CODE)
+
+    def rearm_now(self) -> None:
+        """Reset the fired state so the fault triggers again (the
+        fleet calls this when restarting a chaos-killed replica with
+        ``rearm=True``). Stall and kill share the semantics: the
+        restarted replica's fresh step counter re-arms the same
+        ``kill_at_step``."""
+        self.killed = False
+        self.stalled = False
 
     def on_restore_attempt(self, step: int) -> None:
         """Raise for the first ``fail_restores`` attempts (counted across

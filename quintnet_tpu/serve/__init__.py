@@ -40,17 +40,19 @@ engine and emits a one-line JSON throughput/latency report.
 
 from quintnet_tpu.serve.adapters import AdapterEntry, AdapterRegistry
 from quintnet_tpu.serve.api import generate, generate_stream
-from quintnet_tpu.serve.engine import ServeEngine
+from quintnet_tpu.serve.engine import (ServeEngine, check_admissible)
 from quintnet_tpu.serve.families import gpt2_family, llama_family
 from quintnet_tpu.serve.kv_pool import AdmitPlan, KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
-from quintnet_tpu.serve.scheduler import Request, RequestProgress, Scheduler
+from quintnet_tpu.serve.scheduler import (DeadlineExceeded, Request,
+                                          RequestProgress, Scheduler)
 from quintnet_tpu.serve.spec import NgramDrafter, SpecConfig
 
 __all__ = [
     "AdapterEntry",
     "AdapterRegistry",
     "AdmitPlan",
+    "DeadlineExceeded",
     "KVPool",
     "NgramDrafter",
     "Request",
@@ -60,6 +62,7 @@ __all__ = [
     "ServeMetrics",
     "SpecConfig",
     "aggregate",
+    "check_admissible",
     "generate",
     "generate_stream",
     "gpt2_family",

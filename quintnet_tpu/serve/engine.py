@@ -97,9 +97,53 @@ from quintnet_tpu.serve.adapters import (AdapterRegistry, adapter_paths,
 from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
 from quintnet_tpu.serve.metrics import ServeMetrics
-from quintnet_tpu.serve.scheduler import (FINISHED, Request,
-                                          RequestProgress, Scheduler)
+from quintnet_tpu.serve.scheduler import (FINISHED, DeadlineExceeded,
+                                          Request, RequestProgress,
+                                          Scheduler)
 from quintnet_tpu.serve.spec import NgramDrafter, SpecConfig
+
+
+def check_admissible(prompt_len: int, max_new_tokens: int, *,
+                     max_seq_len: int, prefill_len: int,
+                     usable_blocks: int, block_size: int,
+                     max_slots: int = 0) -> None:
+    """Submit-time rejection of requests an engine with these limits
+    can NEVER run. Standalone (no engine instance) so a remote
+    dispatcher — the process fleet's parent, which has only the
+    engine's ``limits()`` dict from the hello handshake — fails fast at
+    ITS front door instead of round-tripping a doomed request to a
+    replica process. ``max_slots`` rides along in ``limits()`` for
+    dispatch-window sizing and is accepted (unused) here so the dict
+    splats straight in — slot occupancy churns per step and is never an
+    admissibility bound."""
+    if prompt_len < 1:
+        raise ValueError("empty prompt")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    total = prompt_len + int(max_new_tokens)
+    if total > max_seq_len:
+        raise ValueError(
+            f"prompt {prompt_len} + max_new {max_new_tokens} "
+            f"exceeds max_seq_len={max_seq_len}")
+    # a preemption-resume prefills prompt + generated (up to
+    # total - 1 tokens), so prefill_len must cover that, not just
+    # the prompt — cache hits can shrink the tail but are never
+    # guaranteed (the chain may have been evicted)
+    if total - 1 > prefill_len:
+        raise ValueError(
+            f"prompt {prompt_len} + max_new {max_new_tokens} - 1 "
+            f"exceeds prefill_len={prefill_len} (resume after "
+            f"preemption prefills prompt + generated tokens)")
+    # fail fast on requests the pool can NEVER admit: admission
+    # needs blocks_for(total_len + 1) in the worst (cache-cold)
+    # case — otherwise the scheduler would return None forever and
+    # run() would spin
+    worst = -(-total // block_size)
+    if worst > usable_blocks:
+        raise ValueError(
+            f"KV pool too small for this request: needs up to "
+            f"{worst} blocks, pool has {usable_blocks} "
+            f"usable (block_size={block_size})")
 
 
 class ServeEngine:
@@ -655,37 +699,21 @@ class ServeEngine:
     # ------------------------------------------------------------------
     # submission / results
     # ------------------------------------------------------------------
+    def limits(self) -> Dict[str, int]:
+        """The static admissibility surface as a JSON-able dict — what
+        a REMOTE dispatcher needs to run :func:`check_admissible`
+        without an engine in its process (the process fleet's hello
+        handshake ships this, fleet/proc.py)."""
+        return {"max_seq_len": self.max_seq_len,
+                "prefill_len": self.prefill_len,
+                "usable_blocks": self.pool.usable_blocks,
+                "block_size": self.pool.block_size,
+                "max_slots": self.max_slots}
+
     def _check_admissible(self, prompt: np.ndarray,
                           max_new_tokens: int) -> None:
         """Submit-time rejection of requests the engine can NEVER run."""
-        if prompt.size < 1:
-            raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        total = prompt.size + int(max_new_tokens)
-        if total > self.max_seq_len:
-            raise ValueError(
-                f"prompt {prompt.size} + max_new {max_new_tokens} "
-                f"exceeds max_seq_len={self.max_seq_len}")
-        # a preemption-resume prefills prompt + generated (up to
-        # total - 1 tokens), so prefill_len must cover that, not just
-        # the prompt — cache hits can shrink the tail but are never
-        # guaranteed (the chain may have been evicted)
-        if total - 1 > self.prefill_len:
-            raise ValueError(
-                f"prompt {prompt.size} + max_new {max_new_tokens} - 1 "
-                f"exceeds prefill_len={self.prefill_len} (resume after "
-                f"preemption prefills prompt + generated tokens)")
-        # fail fast on requests the pool can NEVER admit: admission
-        # needs blocks_for(total_len + 1) in the worst (cache-cold)
-        # case — otherwise the scheduler would return None forever and
-        # run() would spin
-        worst = self.pool.blocks_for(total)
-        if worst > self.pool.usable_blocks:
-            raise ValueError(
-                f"KV pool too small for this request: needs up to "
-                f"{worst} blocks, pool has {self.pool.usable_blocks} "
-                f"usable (block_size={self.pool.block_size})")
+        check_admissible(prompt.size, max_new_tokens, **self.limits())
 
     def _enqueue(self, req: Request) -> int:
         req.submit_time = self.clock()
@@ -715,16 +743,25 @@ class ServeEngine:
 
     def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
                key=None, on_token=None,
-               adapter_id: Optional[str] = None) -> int:
+               adapter_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its id. ``key``: per-request
         sampling key (defaults to fold_in(key(0), rid)) — pass the SAME
         key an independent ``gpt2_generate`` call would get to reproduce
         it token-for-token. ``adapter_id``: serve this request through
         the named LoRA adapter (serve/adapters.py; None = base model) —
         the adapter is pinned in the registry until the request
-        finishes."""
+        finishes. ``deadline_s``: whole-request latency budget from
+        now, enforced DURING decode, not only at admission — a request
+        whose deadline lapses mid-generation is retired with a typed
+        :class:`DeadlineExceeded` (its blocks published back to the
+        prefix cache) instead of burning pool capacity on a stream
+        nobody is waiting for."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._check_admissible(prompt, max_new_tokens)
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s={deadline_s} already expired at submit")
         self._pin_adapter(adapter_id)
         rid = self._rid_counter
         self._rid_counter += 1
@@ -734,7 +771,9 @@ class ServeEngine:
                       max_new_tokens=int(max_new_tokens),
                       priority=int(priority),
                       arrival=self._arrival_counter, on_token=on_token,
-                      adapter_id=adapter_id)
+                      adapter_id=adapter_id,
+                      deadline=(None if deadline_s is None
+                                else self.clock() + float(deadline_s)))
         self._arrival_counter += 1
         req.key_data = np.asarray(jax.random.key_data(key))
         return self._enqueue(req)
@@ -773,7 +812,10 @@ class ServeEngine:
                       max_new_tokens=int(progress.max_new_tokens),
                       priority=int(progress.priority),
                       arrival=self._arrival_counter, on_token=on_token,
-                      adapter_id=progress.adapter_id)
+                      adapter_id=progress.adapter_id,
+                      deadline=(None if progress.deadline_s is None
+                                else self.clock()
+                                + float(progress.deadline_s)))
         self._arrival_counter += 1
         req.generated = list(progress.generated)
         req.key_data = np.array(progress.key_data, copy=True)
@@ -785,6 +827,8 @@ class ServeEngine:
         if req.state != FINISHED:
             raise RuntimeError(f"request {rid} not finished "
                                f"(state={req.state})")
+        if req.error is not None:
+            raise req.error
         return req.output_ids()
 
     def request(self, rid: int) -> Request:
@@ -844,6 +888,50 @@ class ServeEngine:
         if req.adapter_id is not None:
             self.adapters.release(req.adapter_id)  # submit-time pin
         return req.rid
+
+    def _fail_request(self, req: Request,
+                      error: BaseException) -> None:
+        """Terminal typed failure: the request is FINISHED but
+        ``result()`` raises ``error``. No token is emitted — the typed
+        error is the stream's terminal signal (an ``is_last`` token was
+        never produced)."""
+        req.error = error
+        req.state = FINISHED
+        req.finish_time = self.clock()
+        if req.adapter_id is not None:
+            self.adapters.release(req.adapter_id)  # submit-time pin
+
+    def _sweep_deadlines(self, finished: List[int]) -> None:
+        """Retire every request whose deadline has passed — RUNNING
+        slots included, which is the point: admission-time checks catch
+        a request that arrives late, but only a per-step sweep stops
+        the engine from spending decode steps and pool blocks on a
+        stream whose client has already timed out. The slot's valid KV
+        is PUBLISHED before release (the prefix chain is still good —
+        a retry of the same prompt re-prefills almost nothing)."""
+        now = self.clock()
+        for slot in self._active_slots():
+            req = self._slot_req[slot]
+            if req.deadline is None or now < req.deadline:
+                continue
+            self._release_slot_blocks(slot)
+            self._clear_slot(slot)
+            self._fail_request(req, DeadlineExceeded(
+                f"request {req.rid} exceeded its deadline after "
+                f"{len(req.generated)}/{req.max_new_tokens} tokens; "
+                f"retired mid-decode (blocks published)",
+                rid=req.rid, generated=len(req.generated)))
+            self.metrics.record_deadline_exceeded()
+            finished.append(req.rid)
+        expired = [r for r in self.scheduler.waiting
+                   if r.deadline is not None and now >= r.deadline]
+        for req in expired:
+            self.scheduler.waiting.remove(req)
+            self._fail_request(req, DeadlineExceeded(
+                f"request {req.rid} still waiting at its deadline; "
+                f"never admitted", rid=req.rid, generated=0))
+            self.metrics.record_deadline_exceeded()
+            finished.append(req.rid)
 
     def _preempt(self, slot: int) -> None:
         """Evict: checkpoint progress host-side (generated tokens are
@@ -1111,6 +1199,9 @@ class ServeEngine:
         prefill_tokens = 0
         prefix_hit_tokens = 0
 
+        # 0. deadline enforcement — running slots AND the waiting queue
+        self._sweep_deadlines(finished)
+
         # 1. admissions (prefill; may retire instantly on EOS/budget)
         while not self._admissions_paused:
             free = self._free_slots()
@@ -1279,13 +1370,14 @@ class ServeEngine:
         export is exact at any step boundary, including after the
         owning worker died between steps (the fleet's kill-migration
         path). Read-only: the engine's own state is untouched."""
+        now = self.clock()
         out: List[RequestProgress] = []
         for slot in self._active_slots():
             req = self._slot_req[slot]
             req.key_data = self._key_data[slot].copy()
-            out.append(req.progress())
+            out.append(req.progress(now=now))
         for req in self.scheduler.waiting:
-            out.append(req.progress())
+            out.append(req.progress(now=now))
         out.sort(key=lambda p: p.rid)
         return out
 
@@ -1328,6 +1420,15 @@ class ServeEngine:
             for r, s in self._decodes.items():
                 out[f"decode[r{r}]"] = s
         return out
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Per-sentinel compile counts keyed like
+        :meth:`compile_sentinels` — the JSON-able form that crosses a
+        process boundary (the process fleet's stats frame,
+        fleet/proc.py) so per-replica compile accounting survives the
+        sentinels living in another address space."""
+        return {k: s.compile_count
+                for k, s in self.compile_sentinels().items()}
 
     def assert_compile_count(self, prefill: int = 1, decode: int = 1,
                              verify: Optional[int] = None):

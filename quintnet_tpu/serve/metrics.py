@@ -45,6 +45,10 @@ class ServeMetrics:
     admitted: int = 0
     preempted: int = 0
     finished: int = 0
+    # requests retired MID-GENERATION (or while waiting) because their
+    # deadline passed — typed DeadlineExceeded, blocks published
+    # (serve/engine.py _sweep_deadlines); disjoint from `finished`
+    deadline_exceeded: int = 0
     prefill_tokens: int = 0
     decode_tokens: int = 0
     # prefix-cache ledger: hit tokens are prompt positions served from
@@ -111,6 +115,9 @@ class ServeMetrics:
 
     def record_preempt(self) -> None:
         self.preempted += 1
+
+    def record_deadline_exceeded(self) -> None:
+        self.deadline_exceeded += 1
 
     def _adapter(self, adapter_id: str) -> Dict:
         return self.per_adapter.setdefault(
@@ -195,6 +202,7 @@ class ServeMetrics:
             "admitted": self.admitted,
             "finished": self.finished,
             "preempted": self.preempted,
+            "deadline_exceeded": self.deadline_exceeded,
             "prefill_tokens": self.prefill_tokens,
             "decode_tokens": self.decode_tokens,
             "prefix_hit_tokens": self.prefix_hit_tokens,
@@ -278,6 +286,8 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "admitted": sum(m.admitted for m in all_metrics),
         "finished": sum(m.finished for m in all_metrics),
         "preempted": sum(m.preempted for m in all_metrics),
+        "deadline_exceeded": sum(m.deadline_exceeded
+                                 for m in all_metrics),
         "prefill_tokens": prefill,
         "decode_tokens": dtok,
         "prefix_hit_tokens": hit,

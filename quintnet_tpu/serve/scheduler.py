@@ -31,6 +31,24 @@ RUNNING = "running"
 FINISHED = "finished"
 
 
+class DeadlineExceeded(RuntimeError):
+    """Typed mid-generation retirement: the request's deadline passed
+    while it was DECODING, so the engine stopped spending pool capacity
+    on a stream nobody is waiting for — its blocks are published back
+    to the prefix cache and ``result()`` raises this instead of
+    returning a late answer. Distinct from
+    :class:`~quintnet_tpu.fleet.admission.Overloaded` ``('deadline')``,
+    which sheds a request still QUEUED at its deadline; this one was
+    admitted and partially served (``generated`` counts the tokens it
+    got)."""
+
+    def __init__(self, message: str, *, rid: Optional[int] = None,
+                 generated: int = 0):
+        super().__init__(message)
+        self.rid = rid
+        self.generated = int(generated)
+
+
 @dataclass
 class RequestProgress:
     """Portable host-side resume payload for one unfinished request.
@@ -60,6 +78,12 @@ class RequestProgress:
     the shared safetensors source if it has never served the tenant),
     so a migrated request keeps producing the adapted stream.
 
+    ``deadline_s`` is the REMAINING deadline budget (seconds) at
+    export time, or None — absolute clock readings are meaningless
+    across engines (and across processes: fleet/wire.py ships this
+    exact payload), so the restoring engine re-anchors the budget on
+    its own clock.
+
     ``rid`` is the EXPORTING engine's request id (engine-local; the
     restoring engine assigns its own)."""
 
@@ -71,6 +95,7 @@ class RequestProgress:
     priority: int = 0
     preemptions: int = 0
     adapter_id: Optional[str] = None
+    deadline_s: Optional[float] = None
 
 
 @dataclass
@@ -90,6 +115,7 @@ class Request:
     arrival: int = 0                        # monotone submit stamp
     on_token: Optional[Callable] = None     # streaming callback
     adapter_id: Optional[str] = None        # LoRA binding (None = base)
+    deadline: Optional[float] = None        # absolute ENGINE-clock time
 
     # --- runtime (engine-managed) ---
     state: str = WAITING
@@ -105,6 +131,9 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     preemptions: int = 0
+    # terminal error (DeadlineExceeded): state goes FINISHED but
+    # result() raises this instead of returning output_ids()
+    error: Optional[BaseException] = None
 
     @property
     def total_len(self) -> int:
@@ -121,19 +150,26 @@ class Request:
         return np.concatenate(
             [self.prompt, np.asarray(self.generated, np.int32)])
 
-    def progress(self) -> RequestProgress:
+    def progress(self, *, now: Optional[float] = None) -> RequestProgress:
         """Snapshot the resume payload. Assumes ``key_data`` is CURRENT:
         it is for waiting requests (submit-time key, or the evolved key
         checkpointed at preemption); for RUNNING slots the engine
         refreshes it from device-step state first
-        (:meth:`ServeEngine.export_progress`)."""
+        (:meth:`ServeEngine.export_progress`). ``now`` (the exporting
+        engine's clock) converts an absolute deadline into the REMAINING
+        budget the payload carries; without it a deadline is dropped
+        (clock readings do not transfer across engines)."""
+        deadline_s = None
+        if self.deadline is not None and now is not None:
+            deadline_s = max(self.deadline - now, 0.0)
         return RequestProgress(
             rid=self.rid, prompt=np.array(self.prompt, copy=True),
             generated=list(self.generated),
             key_data=(None if self.key_data is None
                       else np.array(self.key_data, copy=True)),
             max_new_tokens=self.max_new_tokens, priority=self.priority,
-            preemptions=self.preemptions, adapter_id=self.adapter_id)
+            preemptions=self.preemptions, adapter_id=self.adapter_id,
+            deadline_s=deadline_s)
 
 
 class Scheduler:

@@ -1,0 +1,29 @@
+"""Engine builders for PROCESS-fleet tests (quintnet_tpu/fleet/proc.py).
+
+Replica processes load this module by FILE PATH (the fleet's engine
+spec: ``{"file": __file__, "func": "build_tiny_gpt2", "kwargs":
+{...}}``) and call the named builder — a spawn child cannot unpickle a
+test's closure, and must construct its own engine anyway: that is what
+guarantees every replica holds the same (family, params), the
+precondition of the migration contract. Builders are DETERMINISTIC in
+their kwargs (params come from ``gpt2_init(jax.random.key(seed))``),
+so the parent test can build the byte-identical oracle engine/params
+in its own process.
+"""
+
+import jax
+
+
+def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
+                    block_size: int = 4, num_blocks: int = 24,
+                    max_seq_len: int = 24, temperature: float = 0.0,
+                    top_k: int = 0, eos_token_id=None):
+    from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+    from quintnet_tpu.serve import ServeEngine, gpt2_family
+
+    cfg = GPT2Config.tiny(n_layer=n_layer)
+    params = gpt2_init(jax.random.key(seed), cfg)
+    return ServeEngine(gpt2_family(cfg), params, max_slots=max_slots,
+                       block_size=block_size, num_blocks=num_blocks,
+                       max_seq_len=max_seq_len, temperature=temperature,
+                       top_k=top_k, eos_token_id=eos_token_id)

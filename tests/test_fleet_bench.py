@@ -1,8 +1,11 @@
 """tools/fleet_bench.py must never rot unexecuted: the fast suite runs
 the CLI end-to-end (CPU, tiny config, one replica kill) and checks the
-JSON contract, and the bench.py staleness scanner must surface the
-committed fleet artifact (artifacts/fleet_r08.json) the same way it
-surfaces the serving/training/ft records.
+JSON contract — in BOTH modes: thread replicas (artifacts/
+fleet_r08.json) and ``--process`` replicas (fleet/proc.py, artifacts/
+fleet_r12.json, where the kill is an abrupt process exit and the
+migration runs off the dispatcher's write-ahead journal) — and the
+bench.py staleness scanner must surface both committed artifacts the
+same way it surfaces the serving/training/ft records.
 """
 
 import json
@@ -17,6 +20,7 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 FLEET_METRIC = "fleet_gpt2_tiny_tokens_per_sec"
+PROC_METRIC = "fleet_proc_gpt2_tiny_tokens_per_sec"
 
 
 @pytest.mark.fast
@@ -57,6 +61,41 @@ def test_fleet_bench_smoke_cli():
 
 
 @pytest.mark.fast
+def test_fleet_bench_process_smoke_cli():
+    """The same tiny replay through the CROSS-PROCESS fleet: 2 spawned
+    replica engines, burst > capacity, r0's process exits abruptly
+    (mode='hard' chaos — no cleanup, the SIGKILL story) at its 2nd
+    step. The journal migrates its in-flight work, so finished ==
+    accepted even though an engine died mid-run."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_bench.py"),
+         "--synthetic", "--process", "--requests", "8",
+         "--replicas", "2", "--policies", "least_work",
+         "--max-new", "4", "--max-pending", "2", "--max-dispatch", "2",
+         "--kill-at-step", "2", "--kill-replica", "r0",
+         "--timeout-s", "240"],
+        capture_output=True, text=True, timeout=500,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == PROC_METRIC
+    assert rec["rc"] == 0 and rec["unit"] == "tok/s"
+    ex = rec["extras"]
+    assert ex["process"] is True
+    # the process really died and none of its work was lost
+    assert ex["replica_deaths"] == 1
+    assert ex["migrations"] >= 1
+    assert ex["restarts"] >= 1
+    assert ex["finished"] == ex["accepted"]
+    # typed shedding under the burst, bounded queue
+    assert ex["shed"] == ex["submitted"] - ex["accepted"]
+    assert ex["shed"] >= 1
+    # tokens are counted from the dispatcher's journal, which survives
+    # the death — a live-engines-only count would undercount
+    assert ex["gen_tokens"] == ex["finished"] * 4
+
+
+@pytest.mark.fast
 def test_committed_fleet_artifact_surfaces_in_staleness_scan():
     """The committed fleet artifact is discoverable through the same
     last_known_result scanner every other bench uses."""
@@ -91,3 +130,40 @@ def test_committed_fleet_artifact_proves_acceptance_scenario():
         assert 0 < ex["shed_rate"] < 1, policy
         assert ex["ttft_p50_s"] > 0 and ex["ttft_p99_s"] > 0, policy
         assert ex["ttft_p99_s"] >= ex["ttft_p50_s"], policy
+
+
+@pytest.mark.fast
+def test_committed_process_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=PROC_METRIC)
+    assert last is not None
+    assert last["stale"] is True
+    assert last["metric"] == PROC_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_committed_process_artifact_proves_acceptance_scenario():
+    """artifacts/fleet_r12.json documents the PROCESS-fleet acceptance
+    run per policy: 1 of 3 replica PROCESSES dead mid-trace (abrupt
+    exit), its in-flight work migrated off the dispatcher's journal
+    and finished (finished == accepted), the dead process restarted by
+    the supervisor, and the >capacity burst shed typed — with
+    shed_rate / migrations / restarts reported."""
+    recs = json.load(open(os.path.join(REPO, "artifacts",
+                                       "fleet_r12.json")))
+    by_policy = {r["extras"]["policy"]: r for r in recs
+                 if r.get("metric") == PROC_METRIC}
+    assert {"least_work", "round_robin"} <= set(by_policy)
+    for policy, rec in by_policy.items():
+        ex = rec["extras"]
+        assert rec["rc"] == 0 and rec["value"] > 0
+        assert ex["process"] is True and ex["replicas"] == 3
+        assert ex["replica_deaths"] >= 1, policy     # process died
+        assert ex["migrations"] >= 1, policy         # journal migration
+        assert ex["restarts"] >= 1, policy           # supervisor acted
+        assert ex["finished"] == ex["accepted"], policy  # none lost
+        assert ex["shed"] >= 1, policy
+        assert 0 < ex["shed_rate"] < 1, policy
+        assert ex["ttft_p99_s"] >= ex["ttft_p50_s"] > 0, policy

@@ -1,0 +1,409 @@
+"""Cross-process fleet goldens (quintnet_tpu/fleet/proc.py +
+frontdoor.py).
+
+THE contract, upgraded from thread-kill to a real ``os.kill(pid,
+SIGKILL)``: a replica PROCESS killed mid-stream takes nothing with it —
+every in-flight request finishes on a survivor token-identical to an
+undisturbed run (greedy and sampled), reconstructed from the
+dispatcher's write-ahead token journal with zero cooperation from the
+corpse, streams in order with ``is_last`` exactly once, and the
+supervisor restarts the dead replica behind the circuit breaker with
+jittered backoff. Plus the wedge path (a stalled replica stops
+heartbeating but keeps its socket open — detected and routed around
+within the heartbeat budget, distinct from death) and the HTTP front
+door's typed 429/503 + Retry-After backpressure mapping.
+
+Fast tier: a 2-process spawn smoke (tiny synthetic config, CPU) so
+tier-1 exercises the real spawn/handshake/socket path on every run;
+the full 3-replica SIGKILL goldens are slow-tier.
+"""
+
+import http.client
+import json
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from quintnet_tpu.fleet import (HEALTHY, Backoff, FrontDoor, Overloaded,
+                                ProcessFleet, ServeFleet)
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.models.gpt2_generate import gpt2_generate
+from quintnet_tpu.serve import DeadlineExceeded, ServeEngine, gpt2_family
+
+CFG = GPT2Config.tiny(n_layer=2)
+TEMP, TOPK = 0.8, 5
+FACTORY_FILE = os.path.join(os.path.dirname(__file__),
+                            "_proc_factories.py")
+
+
+def _spec(**kw):
+    kwargs = {"temperature": TEMP, "top_k": TOPK, "max_seq_len": 40}
+    kwargs.update(kw)
+    return {"file": FACTORY_FILE, "func": "build_tiny_gpt2",
+            "kwargs": kwargs}
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+def _oracle(params, prompt, max_new, key, temperature=TEMP, top_k=TOPK):
+    return np.asarray(gpt2_generate(
+        params, prompt[None], CFG, max_new_tokens=max_new,
+        temperature=temperature, top_k=top_k, key=key)[0])
+
+
+def _prompts(rng, lengths):
+    return [np.asarray(rng.integers(0, CFG.vocab_size, (t,)), np.int32)
+            for t in lengths]
+
+
+def _wait_until(pred, *, timeout=60.0, msg=""):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for: {msg}")
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------
+# fast tier: the 2-process spawn smoke
+# ---------------------------------------------------------------------
+
+def test_two_process_spawn_smoke(params, rng):
+    """Spawn 2 replica processes (tiny config, CPU), serve sampled
+    requests token-identical to the oracle THROUGH real sockets,
+    answer HTTP at the front door, keep the per-process compile
+    accounting, reject never-admissible work at the parent, and drain
+    cleanly. The one fast-tier test that exercises the whole process
+    path end to end."""
+    fleet = ProcessFleet(_spec(), n_replicas=2, policy="least_work",
+                         platform="cpu")
+    try:
+        # parent-side admissibility: no replica round-trip for a
+        # request no engine could ever run
+        with pytest.raises(ValueError, match="exceeds max_seq_len"):
+            fleet.submit(np.zeros(39, np.int32), 8)
+        with pytest.raises(ValueError, match="empty prompt"):
+            fleet.submit(np.zeros(0, np.int32), 4)
+        assert fleet.metrics.accepted == 0
+
+        prompts = _prompts(rng, (5, 7, 3, 6))
+        keys = [jax.random.key(100 + i) for i in range(4)]
+        outs = fleet.generate(prompts, max_new_tokens=8, keys=keys,
+                              timeout=300)
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(o, _oracle(params, p, 8, k))
+
+        # per-process compile accounting over the wire: counts come
+        # from the CHILD's sentinels via the stats frame
+        fleet.assert_compile_count()
+        stats = fleet.replica_stats()
+        assert sum(s["admitted"] for s in stats.values()) == 4
+        for name, s in stats.items():
+            assert sum(v for k, v in s["compile"].items()
+                       if k.startswith("prefill[")) >= 1, name
+
+        h = fleet.health()
+        assert all(r["state"] == HEALTHY
+                   for r in h["replicas"].values())
+        assert fleet.summary()["tokens_delivered"] == 32
+
+        # the HTTP front door over the PROCESS fleet: one request
+        # end to end + health
+        with FrontDoor(fleet) as fd:
+            conn = http.client.HTTPConnection(fd.host, fd.port,
+                                              timeout=300)
+            conn.request("POST", "/v1/generate", json.dumps(
+                {"prompt": [int(t) for t in prompts[0]],
+                 "max_new_tokens": 6, "seed": 77}), {})
+            r = conn.getresponse()
+            body = json.loads(r.read())
+            assert r.status == 200
+            np.testing.assert_array_equal(
+                np.asarray(body["output"], np.int32),
+                _oracle(params, prompts[0], 6, jax.random.key(77)))
+            conn2 = http.client.HTTPConnection(fd.host, fd.port,
+                                               timeout=30)
+            conn2.request("GET", "/healthz")
+            r2 = conn2.getresponse()
+            assert r2.status == 200
+            assert json.loads(r2.read())["status"] == "ok"
+    finally:
+        fleet.drain(timeout=120)
+    with pytest.raises(Overloaded) as ei:
+        fleet.submit(np.ones(4, np.int32), 4)
+    assert ei.value.reason == "shutdown"
+
+
+def test_stalled_replica_detected_and_routed_around(params, rng):
+    """The wedge path, distinct from clean death: chaos mode='stall'
+    makes p1 stop heartbeating (and stepping) while its SOCKET STAYS
+    OPEN — no EOF ever fires. The dispatcher must detect the silence
+    within the heartbeat budget, migrate p1's in-flight work via the
+    journal, finish everything token-identically on p0, SIGKILL the
+    zombie, and restart it with backoff. ``stalls`` counts separately
+    from ``replica_deaths``."""
+    budget = 0.6
+    fleet = ProcessFleet(_spec(), n_replicas=2, policy="round_robin",
+                         platform="cpu", heartbeat_s=0.05,
+                         heartbeat_budget_s=budget,
+                         backoff=Backoff(base_s=0.01, cap_s=0.1))
+    try:
+        fleet.arm_chaos("p1", {"kill_at_step": 2, "mode": "stall"})
+        prompts = _prompts(rng, (5, 7, 3, 6))
+        keys = [jax.random.key(900 + i) for i in range(4)]
+        fids = [fleet.submit(p, 16, key=k)
+                for p, k in zip(prompts, keys)]
+        outs = [fleet.result(f, timeout=300) for f in fids]
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(o, _oracle(params, p, 16, k))
+
+        m = fleet.metrics
+        assert m.stalls == 1
+        assert m.replica_deaths == 0      # a wedge is NOT a death
+        assert m.migrations >= 1          # p1's work moved over
+        assert m.finished == 4 and m.shed == 0
+        # detection honored the budget: the stalled replica was out of
+        # the candidate set and its work COMPLETED elsewhere — if the
+        # dispatcher had waited for an EOF that never comes, result()
+        # above would have timed out
+        _wait_until(lambda: fleet.metrics.restarts >= 1,
+                    msg="breaker-gated restart of the stalled replica")
+        _wait_until(lambda: fleet.replica("p1").state == HEALTHY,
+                    timeout=180,
+                    msg="restarted replica back to healthy")
+    finally:
+        fleet.drain(timeout=120)
+
+
+# ---------------------------------------------------------------------
+# fast tier: front-door backpressure mapping (thread fleet — the HTTP
+# contract is fleet-implementation-agnostic)
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def thread_fleet(params):
+    def factory():
+        return ServeEngine(gpt2_family(CFG), params, max_slots=2,
+                           block_size=4, num_blocks=24, max_seq_len=24,
+                           temperature=TEMP, top_k=TOPK)
+
+    fleet = ServeFleet(factory, n_replicas=1, max_pending=2)
+    yield fleet
+    fleet.close()
+
+
+def _post(fd, payload, timeout=300):
+    conn = http.client.HTTPConnection(fd.host, fd.port, timeout=timeout)
+    conn.request("POST", "/v1/generate", json.dumps(payload), {})
+    r = conn.getresponse()
+    return r.status, dict(r.getheaders()), r.read()
+
+
+def test_frontdoor_overload_maps_to_typed_429_503(thread_fleet,
+                                                  params, rng):
+    """Overload becomes PROTOCOL, not latency: queue_full -> 429 +
+    Retry-After, expired deadline at submit -> 503 + Retry-After,
+    draining fleet -> 503 + Retry-After; a never-admissible request
+    -> 400; the bounded queue never grows past max_pending."""
+    fleet = thread_fleet
+    with FrontDoor(fleet, retry_after_s=2.0) as fd:
+        fleet.pause_all()           # nothing dispatches: queue fills
+        body = {"prompt": [1, 2, 3], "max_new_tokens": 4}
+        # fill the bounded queue out-of-band so every HTTP probe below
+        # is an IMMEDIATE typed rejection (a 200-path request would
+        # block on its stream until the fleet resumes)
+        fleet.submit([1, 2, 3], 4)
+        fleet.submit([1, 2, 3], 4)
+        for _ in range(2):
+            status, headers, raw = _post(fd, body, timeout=30)
+            assert status == 429
+            assert headers.get("Retry-After") == "2"
+            payload = json.loads(raw)
+            assert payload["error"] == "overloaded"
+            assert payload["reason"] == "queue_full"
+        assert len(fleet._queue) <= 2        # the bound held
+
+        # expired-at-submit deadline -> 503 (typed reason rides along)
+        status, headers, raw = _post(
+            fd, dict(body, deadline_s=0), timeout=30)
+        assert status == 503
+        assert headers.get("Retry-After") == "2"
+        assert json.loads(raw)["reason"] == "deadline"
+
+        # never admissible -> 400, not a 5xx
+        status, _h, raw = _post(
+            fd, {"prompt": [1] * 23, "max_new_tokens": 8}, timeout=30)
+        assert status == 400
+        assert "max_seq_len" in json.loads(raw)["message"]
+
+        fleet.resume_all()
+        _wait_until(lambda: fleet.metrics.finished
+                    >= fleet.metrics.accepted, timeout=300,
+                    msg="queued work finishes after resume")
+
+        fleet.drain(timeout=120)
+        status, headers, raw = _post(fd, body, timeout=30)
+        assert status == 503
+        assert json.loads(raw)["reason"] == "shutdown"
+        assert headers.get("Retry-After") == "2"
+
+
+def test_frontdoor_stream_and_error_mapping_units(thread_fleet):
+    """SSE streaming delivers every token exactly once then a done
+    event; the typed-error -> status table is pinned as a unit
+    (DeadlineExceeded -> 504 is hard to schedule over real HTTP
+    without wall-clock flake; the mapping is what matters)."""
+    fleet = thread_fleet
+    fd = FrontDoor(fleet, retry_after_s=1.0)
+    status, payload, headers = fd._error_response(
+        Overloaded("queue_full", "full"))
+    assert (status, headers["Retry-After"]) == (429, "1")
+    status, payload, _ = fd._error_response(
+        DeadlineExceeded("late", rid=1, generated=3))
+    assert status == 504 and payload["generated"] == 3
+    status, _p, headers = fd._error_response(
+        Overloaded("shutdown", "bye"))
+    assert status == 503 and headers["Retry-After"] == "1"
+    status, _p, _h = fd._error_response(TimeoutError("slow"))
+    assert status == 504
+    status, _p, _h = fd._error_response(ValueError("bad"))
+    assert status == 400
+
+    with FrontDoor(fleet) as live:
+        conn = http.client.HTTPConnection(live.host, live.port,
+                                          timeout=300)
+        conn.request("POST", "/v1/generate", json.dumps(
+            {"prompt": [4, 5, 6], "max_new_tokens": 5, "seed": 9,
+             "stream": True}), {})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.getheader("Content-Type") == "text/event-stream"
+        events = [e for e in r.read().decode().split("\n\n")
+                  if e.strip()]
+        toks = [json.loads(e.split("data: ", 1)[1])
+                for e in events if e.startswith("data: ")]
+        done = [e for e in events if e.startswith("event: done")]
+        assert len(done) == 1
+        final = json.loads(done[0].split("data: ", 1)[1])
+        assert [t["token"] for t in toks] == final["output"][3:]
+        assert [t["last"] for t in toks].count(True) == 1
+        assert toks[-1]["last"] is True
+
+
+# ---------------------------------------------------------------------
+# slow tier: THE process-kill goldens (real SIGKILL, 3 replicas)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("temperature,top_k", [(0.0, 0), (TEMP, TOPK)],
+                         ids=["greedy", "sampled"])
+def test_sigkill_one_of_three_migrates_token_identically(
+        params, rng, temperature, top_k):
+    """THE golden, now across real process boundaries: ``os.kill(pid,
+    SIGKILL)`` on replica p1-of-3 while its requests are mid-stream.
+    No export, no exit handler, no flush — the dispatcher reconstructs
+    every in-flight request from its write-ahead journal (prompt +
+    committed tokens + host-side key replay) and survivors finish them
+    token-identical to the undisturbed oracle, greedy AND sampled.
+    The streamed request delivers in order with is_last exactly once
+    and nothing re-delivered; the supervisor restarts the corpse."""
+    fleet = ProcessFleet(
+        _spec(temperature=temperature, top_k=top_k), n_replicas=3,
+        policy="round_robin", platform="cpu", heartbeat_s=0.05,
+        backoff=Backoff(base_s=0.01, cap_s=0.1))
+    try:
+        prompts = _prompts(rng, (5, 7, 3, 6, 4, 8, 5, 6, 4))
+        keys = [jax.random.key(500 + i) for i in range(9)]
+        streamed = []
+        fids = []
+        for i, (p, k) in enumerate(zip(prompts, keys)):
+            cb = ((lambda fid, tok, last:
+                   streamed.append((tok, last)))
+                  if i == 1 else None)  # round_robin: i=1 -> p1
+            fids.append(fleet.submit(p, 24, key=k, on_token=cb))
+        victim = fleet.replica("p1")
+        # kill MID-STREAM, deterministically: after the p1-routed
+        # request has produced some tokens but before it can finish
+        _wait_until(lambda: len(streamed) >= 3, timeout=120,
+                    msg="victim replica streaming")
+        assert len(streamed) < 24
+        os.kill(victim.pid, signal.SIGKILL)
+
+        outs = [fleet.result(f, timeout=300) for f in fids]
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(
+                o, _oracle(params, p, 24, k, temperature=temperature,
+                           top_k=top_k))
+
+        m = fleet.metrics
+        assert m.replica_deaths == 1
+        assert m.migrations >= 1          # in-flight work moved over
+        assert m.finished == 9 and m.shed == 0
+        # the streamed request survived the SIGKILL with an intact,
+        # in-order, exactly-once token stream
+        toks = [t for t, _ in streamed]
+        np.testing.assert_array_equal(
+            np.asarray(toks, np.int32), outs[1][len(prompts[1]):])
+        assert [last for _, last in streamed].count(True) == 1
+        assert streamed[-1][1] is True
+        _wait_until(lambda: fleet.metrics.restarts >= 1,
+                    msg="supervisor restart of the killed replica")
+        # survivors kept the bounded-compile promise
+        fleet.assert_compile_count()
+    finally:
+        fleet.drain(timeout=180)
+
+
+@pytest.mark.slow
+def test_repeated_kills_trip_breaker_and_backoff_spaces_restarts(
+        params, rng):
+    """Chaos armed with rearm: every restarted p0 dies again at its
+    2nd step (mode='hard' — the process exits with no cleanup, exactly
+    like the ft_run supervisor's kill story). After trip_after
+    consecutive deaths the breaker opens: restarts STOP, the fleet
+    keeps serving on p1, and every accepted request still finishes
+    golden."""
+    fleet = ProcessFleet(
+        _spec(), n_replicas=2, policy="round_robin", platform="cpu",
+        heartbeat_s=0.05, trip_after=2, breaker_reset_s=3600.0,
+        backoff=Backoff(base_s=0.01, cap_s=0.05),
+        chaos={"target": "p0", "kill_at_step": 2, "mode": "hard",
+               "rearm": True})
+    try:
+        # sustained traffic: each request outlives kill_at_step, so
+        # whenever the rearmed p0 is back up and receives work it dies
+        # again — two consecutive deaths trip the breaker
+        served = 0
+        deadline = time.monotonic() + 240.0
+        while (fleet.breaker("p0").state != "open"
+               and time.monotonic() < deadline):
+            p = _prompts(rng, (5,))[0]
+            k = jax.random.key(700 + served)
+            fid = fleet.submit(p, 8, key=k)
+            np.testing.assert_array_equal(
+                fleet.result(fid, timeout=300),
+                _oracle(params, p, 8, k))
+            served += 1
+            time.sleep(0.05)
+        assert fleet.breaker("p0").state == "open", \
+            f"breaker never tripped after {served} requests"
+        assert fleet.metrics.replica_deaths >= 2
+        assert fleet.metrics.restarts >= 1   # 2nd death tripped instead
+        assert fleet.metrics.finished == served
+        assert fleet.replica("p1").state == HEALTHY
+        # open breaker: p0 stays down, the fleet keeps serving on p1
+        p = _prompts(rng, (6,))[0]
+        k = jax.random.key(999)
+        np.testing.assert_array_equal(
+            fleet.generate([p], max_new_tokens=6, keys=[k],
+                           timeout=300)[0],
+            _oracle(params, p, 6, k))
+    finally:
+        fleet.drain(timeout=180)

@@ -1,0 +1,234 @@
+"""Wire-serialization goldens (quintnet_tpu/fleet/wire.py).
+
+THE contract: everything a cross-process migration needs round-trips
+through versioned JSON payloads BIT-exactly — prompt/generated tokens,
+the evolved PRNG key (raw dtype bytes, not a float detour), the
+adapter binding, the remaining deadline — and a payload from a future
+(or corrupt) version is rejected with an actionable error naming both
+versions, never a KeyError three fields deep. Plus the framing layer
+(length-prefixed JSON over a socket) and the end-to-end golden: an
+engine's exported progress serialized to JSON, parsed back, and
+restored on a second engine continues token-identically.
+"""
+
+import json
+import socket
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from quintnet_tpu.fleet import Overloaded
+from quintnet_tpu.fleet import wire
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.models.gpt2_generate import gpt2_generate
+from quintnet_tpu.serve import (DeadlineExceeded, ServeEngine,
+                                SpecConfig, gpt2_family)
+from quintnet_tpu.serve.scheduler import Request, RequestProgress
+
+CFG = GPT2Config.tiny(n_layer=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+def _progress(**over):
+    base = dict(
+        rid=7, prompt=np.asarray([3, 1, 4, 1, 5], np.int32),
+        generated=[9, 2, 6], key_data=np.asarray(
+            jax.random.key_data(jax.random.key(11))),
+        max_new_tokens=12, priority=2, preemptions=1,
+        adapter_id="tenant-a", deadline_s=3.25)
+    base.update(over)
+    return RequestProgress(**base)
+
+
+class TestProgressRoundTrip:
+    def test_all_fields_survive_json(self):
+        p = _progress()
+        # through actual JSON text — what the socket carries
+        q = wire.progress_from_wire(
+            json.loads(json.dumps(wire.progress_to_wire(p))))
+        assert q.rid == 7 and q.max_new_tokens == 12
+        assert q.priority == 2 and q.preemptions == 1
+        assert q.adapter_id == "tenant-a"
+        assert q.deadline_s == pytest.approx(3.25)
+        assert q.generated == [9, 2, 6]
+        np.testing.assert_array_equal(q.prompt, p.prompt)
+        assert q.prompt.dtype == np.int32
+        # the PRNG key is BIT-exact, dtype preserved (b64 raw bytes)
+        np.testing.assert_array_equal(q.key_data, p.key_data)
+        assert q.key_data.dtype == p.key_data.dtype
+
+    def test_optional_fields_none(self):
+        p = _progress(adapter_id=None, deadline_s=None, key_data=None)
+        q = wire.progress_from_wire(wire.progress_to_wire(p))
+        assert q.adapter_id is None and q.deadline_s is None
+        assert q.key_data is None
+
+    def test_unknown_version_rejected_actionably(self):
+        payload = wire.progress_to_wire(_progress())
+        payload["v"] = 99
+        with pytest.raises(wire.WireVersionError,
+                           match="version 99.*not supported.*upgrade"):
+            wire.progress_from_wire(payload)
+
+    def test_missing_version_rejected(self):
+        payload = wire.progress_to_wire(_progress())
+        del payload["v"]
+        with pytest.raises(wire.WireVersionError, match="None"):
+            wire.progress_from_wire(payload)
+
+    def test_wrong_kind_rejected(self):
+        payload = wire.progress_to_wire(_progress())
+        payload["kind"] = "request"
+        with pytest.raises(wire.WireError, match="wrong decoder"):
+            wire.progress_from_wire(payload)
+
+    def test_missing_field_named_not_keyerror(self):
+        payload = wire.progress_to_wire(_progress())
+        del payload["key_data"]
+        with pytest.raises(wire.WireError,
+                           match=r"missing required field.*key_data"):
+            wire.progress_from_wire(payload)
+
+    def test_malformed_array_payload(self):
+        payload = wire.progress_to_wire(_progress())
+        payload["prompt"] = {"dtype": "int32", "b64": "!!!"}
+        with pytest.raises(wire.WireError, match="malformed array"):
+            wire.progress_from_wire(payload)
+
+
+class TestRequestRoundTrip:
+    def test_submit_payload_survives(self):
+        req = Request(rid=4, prompt=np.asarray([5, 6, 7], np.int32),
+                      max_new_tokens=9, priority=1,
+                      adapter_id="tenant-b")
+        req.key_data = np.asarray(
+            jax.random.key_data(jax.random.key(3)))
+        req.generated = [11, 12]
+        out, deadline_s = wire.request_from_wire(json.loads(
+            json.dumps(wire.request_to_wire(req, deadline_s=1.5))))
+        assert out.rid == 4 and out.max_new_tokens == 9
+        assert out.priority == 1 and out.adapter_id == "tenant-b"
+        assert out.generated == [11, 12]
+        assert deadline_s == pytest.approx(1.5)
+        np.testing.assert_array_equal(out.prompt, req.prompt)
+        np.testing.assert_array_equal(out.key_data, req.key_data)
+
+    def test_version_gate(self):
+        req = Request(rid=0, prompt=np.asarray([1], np.int32),
+                      max_new_tokens=1)
+        payload = wire.request_to_wire(req)
+        payload["v"] = 2
+        with pytest.raises(wire.WireVersionError):
+            wire.request_from_wire(payload)
+
+
+class TestErrorRoundTrip:
+    @pytest.mark.parametrize("reason", ["queue_full", "deadline",
+                                        "shutdown"])
+    def test_overloaded_keeps_reason(self, reason):
+        e = wire.error_from_wire(json.loads(json.dumps(
+            wire.error_to_wire(Overloaded(reason, "nope")))))
+        assert isinstance(e, Overloaded)
+        assert e.reason == reason and "nope" in str(e)
+
+    def test_deadline_exceeded_keeps_progress_count(self):
+        e = wire.error_from_wire(wire.error_to_wire(
+            DeadlineExceeded("late", rid=5, generated=7)))
+        assert isinstance(e, DeadlineExceeded)
+        assert e.generated == 7 and "late" in str(e)
+
+    def test_value_and_key_errors(self):
+        assert isinstance(
+            wire.error_from_wire(wire.error_to_wire(
+                ValueError("bad prompt"))), ValueError)
+        assert isinstance(
+            wire.error_from_wire(wire.error_to_wire(
+                KeyError("tenant-z"))), KeyError)
+
+
+class TestFraming:
+    def test_frames_round_trip_over_a_socket(self):
+        a, b = socket.socketpair()
+        try:
+            frames = [{"t": "hb", "steps": 3},
+                      {"t": "submit",
+                       "progress": wire.progress_to_wire(_progress())}]
+
+            def sender():
+                for f in frames:
+                    wire.send_frame(a, f)
+                a.close()
+
+            t = threading.Thread(target=sender)
+            t.start()
+            got = [wire.recv_frame(b), wire.recv_frame(b)]
+            assert got[0] == {"t": "hb", "steps": 3}
+            q = wire.progress_from_wire(got[1]["progress"])
+            assert q.adapter_id == "tenant-a"
+            with pytest.raises(wire.ConnectionClosed):
+                wire.recv_frame(b)      # peer gone == EOF, typed
+            t.join()
+        finally:
+            b.close()
+
+    def test_corrupt_length_prefix_rejected(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall((wire.MAX_FRAME_BYTES + 1).to_bytes(4, "big"))
+            with pytest.raises(wire.WireError, match="length"):
+                wire.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestCrossEngineWireGolden:
+    """The payload actually does its job: progress exported from one
+    engine, pushed through JSON text, restored on a FRESH engine,
+    continues token-identically — sampled traffic, spec-enabled
+    exporter (whose progress must carry committed tokens only), and
+    the deadline budget re-anchored on the restorer's clock."""
+
+    def test_export_json_restore_token_identical(self, params, rng):
+        def make(spec=None):
+            return ServeEngine(gpt2_family(CFG), params, max_slots=2,
+                               block_size=4, num_blocks=32,
+                               max_seq_len=40, temperature=0.8,
+                               top_k=5, spec=spec)
+
+        src = make(spec=SpecConfig())   # exporter speculates
+        prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (n,)),
+                              np.int32) for n in (5, 7)]
+        keys = [jax.random.key(40 + i) for i in range(2)]
+        rids = [src.submit(p, 16, key=k, deadline_s=120.0)
+                for p, k in zip(prompts, keys)]
+        for _ in range(5):
+            src.step()
+        payloads = [json.loads(json.dumps(wire.progress_to_wire(p)))
+                    for p in src.export_progress()]
+        assert payloads, "exporter finished too fast to export"
+        dst = make()
+        out = {}
+        for payload in payloads:
+            prog = wire.progress_from_wire(payload)
+            # spec drafts never leak: committed tokens only
+            assert len(prog.generated) < prog.max_new_tokens
+            assert prog.deadline_s is not None
+            assert 0 < prog.deadline_s <= 120.0
+            out[prog.rid] = dst.restore_progress(prog)
+        dst.run(max_steps=500)
+        for rid, p, k in zip(rids, prompts, keys):
+            oracle = np.asarray(gpt2_generate(
+                params, p[None], CFG, max_new_tokens=16,
+                temperature=0.8, top_k=5, key=k)[0])
+            if rid in out:
+                np.testing.assert_array_equal(dst.result(out[rid]),
+                                              oracle)
+            else:   # finished before the export — still golden
+                np.testing.assert_array_equal(src.result(rid), oracle)

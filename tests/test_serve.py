@@ -468,6 +468,83 @@ def test_submit_validation(params):
         eng.submit(np.zeros(4, np.int32), 0)
     with pytest.raises(ValueError, match="empty"):
         eng.submit(np.zeros(0, np.int32), 2)
+    with pytest.raises(ValueError, match="deadline_s"):
+        eng.submit(np.zeros(4, np.int32), 2, deadline_s=0)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_deadline_mid_decode_retires_typed_and_publishes(params, rng):
+    """A request whose deadline passes MID-GENERATION is retired with a
+    typed DeadlineExceeded — not finished late, not silently dropped —
+    and its blocks are PUBLISHED: the pool holds no live references
+    afterwards and a retry of the same prompt re-prefills almost
+    nothing. An unconstrained request in the same batch is untouched."""
+    from quintnet_tpu.serve import DeadlineExceeded
+
+    clk = _FakeClock()
+    eng = _engine(params, clock=clk)
+    p1, p2 = _prompts(rng, (6, 5))
+    k2 = jax.random.key(21)
+    r1 = eng.submit(p1, 16, key=jax.random.key(20), deadline_s=5.0)
+    r2 = eng.submit(p2, 8, key=k2)
+    for _ in range(3):
+        eng.step()
+    got_before = len(eng.request(r1).generated)
+    assert 0 < got_before < 16          # genuinely mid-generation
+    clk.t = 10.0                        # r1's deadline lapses
+    finished = eng.step()
+    assert r1 in finished
+    with pytest.raises(DeadlineExceeded) as ei:
+        eng.result(r1)
+    assert ei.value.generated == got_before
+    assert eng.metrics.deadline_exceeded == 1
+    # the survivor finishes golden
+    eng.run()
+    np.testing.assert_array_equal(eng.result(r2),
+                                  _oracle(params, p2, 8, k2))
+    assert eng.pool.num_used == 0       # nothing leaked: published,
+    #                                     released, only cached remains
+    # the published prefix is live: resubmitting the same prompt hits
+    # the cache instead of re-prefilling
+    hits0 = eng.metrics.prefix_hit_tokens
+    eng.submit(p1, 4, key=jax.random.key(22))
+    eng.run()
+    assert eng.metrics.prefix_hit_tokens > hits0
+
+
+def test_deadline_expired_while_waiting_is_typed_too(params, rng):
+    """A queued (never admitted) request whose deadline passes is
+    failed with DeadlineExceeded(generated=0) at the next step — the
+    scheduler does not leak it, and admissions behind it proceed."""
+    from quintnet_tpu.serve import DeadlineExceeded
+
+    clk = _FakeClock()
+    eng = _engine(params, max_slots=1, clock=clk)
+    p1, p2, p3 = _prompts(rng, (4, 4, 5))
+    k3 = jax.random.key(32)
+    r1 = eng.submit(p1, 8, key=jax.random.key(30))
+    r2 = eng.submit(p2, 8, key=jax.random.key(31), deadline_s=5.0)
+    r3 = eng.submit(p3, 6, key=k3)
+    eng.step()                          # r1 occupies the single slot
+    assert eng.request(r2).state == "waiting"
+    clk.t = 6.0
+    eng.step()
+    with pytest.raises(DeadlineExceeded) as ei:
+        eng.result(r2)
+    assert ei.value.generated == 0
+    eng.run()
+    np.testing.assert_array_equal(eng.result(r3),
+                                  _oracle(params, p3, 6, k3))
+    # exported progress carries REMAINING deadline budget for the
+    # migration contract (none of the survivors had one here)
+    assert eng.result(r1) is not None
 
 
 # ---------------------------------------------------------------------

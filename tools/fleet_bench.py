@@ -18,11 +18,23 @@ warmup, so the victim dies at its K-th replay step and its in-flight
 requests migrate — finished counts include them, token-identical
 (tests/test_fleet.py holds the identity; here we count).
 
+``--process`` replays the SAME trace through the cross-process fleet
+(quintnet_tpu/fleet/proc.py): each replica is its own spawned OS
+process behind the wire protocol, the armed kill is a mode='hard'
+``os._exit`` — the process vanishes mid-run with no cleanup, the
+SIGKILL story — and the dispatcher's write-ahead journal migrates the
+victim's in-flight requests to survivors (finished == accepted).
+Reported tokens come from the dispatcher's journal
+(``tokens_delivered``), which survives replica deaths; the metric name
+gains a ``proc`` tag so the thread and process records never alias.
+
 Modes:
   python tools/fleet_bench.py --synthetic                # tiny, CPU-ok
   python tools/fleet_bench.py --synthetic --requests 6 \
       --policies least_work                              # CI smoke
   python tools/fleet_bench.py --synthetic --out artifacts/fleet_r08.json
+  python tools/fleet_bench.py --synthetic --process \
+      --out artifacts/fleet_r12.json                     # process fleet
 
 ``--out FILE`` appends the records to an artifacts JSON list
 (bench.last_known_result scans them — same staleness story as the
@@ -39,28 +51,77 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_factory(args):
+def model_setup(model: str, synthetic: bool, seed: int):
+    """THE single source of the benched model: (family, params). Both
+    modes — the thread factory and the process children, each in their
+    own interpreter — construct the model HERE from the same seed, so
+    they cannot drift apart and every replica holds identical
+    (family, params), the migration-contract precondition."""
     import jax
 
-    from quintnet_tpu.serve import ServeEngine, gpt2_family, llama_family
+    from quintnet_tpu.serve import gpt2_family, llama_family
 
-    if args.model == "gpt2":
+    if model == "gpt2":
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
 
-        cfg = (GPT2Config.tiny(n_layer=2) if args.synthetic
-               else GPT2Config.base())
-        params = gpt2_init(jax.random.key(args.seed), cfg)
-        family = gpt2_family(cfg)
-    elif args.model == "llama":
+        cfg = GPT2Config.tiny(n_layer=2) if synthetic else GPT2Config.base()
+        return gpt2_family(cfg), gpt2_init(jax.random.key(seed), cfg)
+    if model == "llama":
         from quintnet_tpu.models.llama import LlamaConfig, llama_init
 
-        cfg = (LlamaConfig.tiny(n_layers=2) if args.synthetic
+        cfg = (LlamaConfig.tiny(n_layers=2) if synthetic
                else LlamaConfig())
-        params = llama_init(jax.random.key(args.seed), cfg)
-        family = llama_family(cfg)
-    else:
-        raise SystemExit(f"unknown --model {args.model}")
+        return llama_family(cfg), llama_init(jax.random.key(seed), cfg)
+    raise SystemExit(f"unknown --model {model}")
 
+
+def build_engine(*, model="gpt2", synthetic=True, seed=0, slots=2,
+                 block_size=16, num_blocks=64, max_seq_len=40,
+                 eos=None, temperature=0.0):
+    """One replica engine, DETERMINISTIC in its kwargs — the builder
+    the process fleet's spawn children load by file path."""
+    from quintnet_tpu.serve import ServeEngine
+
+    family, params = model_setup(model, synthetic, seed)
+    return ServeEngine(
+        family, params, max_slots=slots, block_size=block_size,
+        num_blocks=num_blocks,
+        max_seq_len=min(max_seq_len, family.max_positions),
+        eos_token_id=eos, temperature=temperature)
+
+
+def engine_kwargs(args) -> dict:
+    return {"model": args.model, "synthetic": bool(args.synthetic),
+            "seed": args.seed, "slots": args.slots,
+            "block_size": args.block_size,
+            "num_blocks": args.num_blocks,
+            "max_seq_len": args.max_prompt + args.max_new,
+            "eos": args.eos, "temperature": args.temperature}
+
+
+def vocab_size(args) -> int:
+    """Vocab for trace generation WITHOUT materializing params (the
+    process mode's parent never builds a model)."""
+    if args.model == "gpt2":
+        from quintnet_tpu.models.gpt2 import GPT2Config
+
+        return (GPT2Config.tiny(n_layer=2) if args.synthetic
+                else GPT2Config.base()).vocab_size
+    from quintnet_tpu.models.llama import LlamaConfig
+
+    return (LlamaConfig.tiny(n_layers=2) if args.synthetic
+            else LlamaConfig()).vocab_size
+
+
+def build_factory(args):
+    """Thread-mode factory: model_setup() called ONCE, params shared
+    by every replica engine in this process (the process mode cannot
+    share — each child runs the same model_setup from the same seed,
+    which is the point)."""
+    from quintnet_tpu.serve import ServeEngine
+
+    family, params = model_setup(args.model, bool(args.synthetic),
+                                 args.seed)
     max_seq = min(args.max_prompt + args.max_new, family.max_positions)
 
     def factory():
@@ -182,6 +243,102 @@ def run_policy(args, policy: str, factory, vocab_size: int) -> dict:
     }
 
 
+def run_policy_process(args, policy: str) -> dict:
+    """One replay through the CROSS-PROCESS fleet: spawn --replicas
+    engine processes, warm every compiled program over the wire, arm a
+    mode='hard' chaos kill (abrupt process exit, no cleanup — the
+    SIGKILL story) in the target child, replay the same bursty trace,
+    and report from the dispatcher's journal — which is why
+    finished == accepted survives the kill."""
+    import time
+
+    from quintnet_tpu.fleet import Overloaded, ProcessFleet
+    from quintnet_tpu.fleet.health import Backoff
+
+    spec = {"file": os.path.abspath(__file__), "func": "build_engine",
+            "kwargs": engine_kwargs(args)}
+    fleet = ProcessFleet(
+        spec, n_replicas=args.replicas, policy=policy,
+        max_pending=args.max_pending, max_dispatch=args.max_dispatch,
+        trip_after=args.trip_after, heartbeat_s=0.05,
+        backoff=Backoff(base_s=0.02, cap_s=0.5), name_prefix="r")
+    try:
+        # compile every child's full program set OUTSIDE the timed
+        # window (one warmup RPC per replica), then fresh ledgers
+        fleet.warmup()
+        fleet.reset_metrics()
+        if args.kill_at_step is not None:
+            fleet.arm_chaos(args.kill_replica,
+                            {"kill_at_step": args.kill_at_step,
+                             "mode": "hard"})
+
+        trace = make_trace(args, vocab_size(args))
+        fids = []
+        t0 = time.perf_counter()
+        for delay, prompt, max_new in trace:
+            if delay:
+                time.sleep(delay)
+            try:
+                fids.append(fleet.submit(prompt, max_new))
+            except Overloaded:
+                pass                   # counted in fleet.summary()
+        for fid in fids:
+            try:
+                fleet.result(fid, timeout=args.timeout_s)
+            except Overloaded:
+                pass
+        # no device lives in THIS process: every token in the journal
+        # was already streamed over a socket by a child whose step
+        # completed — the wall delta is true end-to-end serving time
+        wall = time.perf_counter() - t0  # qtcheck: ok[QT106]
+
+        s = fleet.summary()
+    finally:
+        fleet.drain(timeout=args.timeout_s)
+    gen_tokens = s["tokens_delivered"]
+    engines = s.get("engines", {})
+    tag = "tiny" if args.synthetic else "full"
+    return {
+        "metric": f"fleet_proc_{args.model}_{tag}_tokens_per_sec",
+        "value": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 1.0,
+        "rc": 0,
+        "extras": {
+            "policy": policy,
+            "process": True,
+            "replicas": args.replicas,
+            "requests": args.requests,
+            "submitted": s["submitted"],
+            "accepted": s["accepted"],
+            "finished": s["finished"],
+            "shed": s["shed"],
+            "shed_rate": s["shed_rate"],
+            "migrations": s["migrations"],
+            "replica_deaths": s["replica_deaths"],
+            "stalls": s["stalls"],
+            "restarts": s["restarts"],
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "ttft_p99_s": s["ttft_s"]["p99"],
+            "latency_p50_s": s["latency_s"]["p50"],
+            "latency_p99_s": s["latency_s"]["p99"],
+            "gen_tokens": gen_tokens,
+            "live_engine_steps": sum(e["steps"]
+                                     for e in engines.values()),
+            "engines_reporting": len(engines),
+            "wall_s": round(wall, 4),
+            "kill_at_step": args.kill_at_step,
+            "kill_replica": args.kill_replica,
+            "burst": args.burst,
+            "max_pending": args.max_pending,
+            "rate": args.rate,
+            "slots": args.slots,
+            "model": args.model,
+            "synthetic": bool(args.synthetic),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2", choices=("gpt2", "llama"))
@@ -215,6 +372,12 @@ def main():
                     help="arm a mode='raise' ChaosMonkey: the target "
                          "replica dies after its K-th replay step")
     ap.add_argument("--kill-replica", default="r1")
+    ap.add_argument("--process", action="store_true",
+                    help="replicas as spawned OS processes "
+                         "(fleet/proc.py) instead of threads; the "
+                         "armed kill becomes an abrupt process exit "
+                         "and migration runs off the dispatcher's "
+                         "write-ahead journal")
     ap.add_argument("--timeout-s", type=float, default=600.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
@@ -223,11 +386,16 @@ def main():
     if args.burst is None:
         args.burst = args.requests
 
-    factory, vocab = build_factory(args)
     records = []
-    for policy in [p for p in args.policies.split(",") if p]:
-        records.append(run_policy(args, policy, factory, vocab))
-        print(json.dumps(records[-1]))
+    if args.process:
+        for policy in [p for p in args.policies.split(",") if p]:
+            records.append(run_policy_process(args, policy))
+            print(json.dumps(records[-1]))
+    else:
+        factory, vocab = build_factory(args)
+        for policy in [p for p in args.policies.split(",") if p]:
+            records.append(run_policy(args, policy, factory, vocab))
+            print(json.dumps(records[-1]))
 
     if args.out:
         prev = []
