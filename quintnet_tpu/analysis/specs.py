@@ -256,6 +256,31 @@ def expected_serve_verify(n_layers: int, *,
                                  vocab_parallel=vocab_parallel)
 
 
+def expected_serve_sp_prefill(n_layers: int, sp: int, *,
+                              sp_axis: str = "sp") -> CensusDict:
+    """One compiled SEQUENCE-PARALLEL prefill bucket (long-context
+    serving, serve/longctx.py + nn/attention.ring_paged_prefill), per
+    layer:
+
+    - ``2 * sp`` **ppermutes** — the ring: the stacked chunk K/V pair
+      and its position vector each rotate once per scan step, ``sp``
+      steps (scan body x trip count, exactly how the 1F1B ppermutes
+      are counted);
+    - one **all_gather** — the chunk's K/V reassembled in rank order
+      for the (sp-replicated) pool scatter;
+
+    plus ONE program-wide **all_reduce**: the masked psum that
+    replicates position ``t0 - 1``'s hidden row for the logits read.
+    Independent of the bucket width (sp shards it, never changes the
+    collective count), so every bucket program must match this same
+    spec — and the count is a pure function of (n_layers, sp): any
+    extra collective XLA or a refactor sneaks in fails the census test
+    with a named diff."""
+    return {sp_axis: {"ppermute": 2 * sp * n_layers,
+                      "all_gather": n_layers,
+                      "all_reduce": 1}}
+
+
 def lora_rank_buckets(max_rank: int, *, floor: int = 4) -> Tuple[int, ...]:
     """THE canonical adapter-rank ladder for multi-tenant LoRA serving
     (serve/adapters.py): powers of two from ``floor`` up to (and capped

@@ -135,6 +135,7 @@ def progress_to_wire(p) -> Dict:
         "adapter_id": p.adapter_id,
         "deadline_s": (None if p.deadline_s is None
                        else float(p.deadline_s)),
+        "prefilled": int(p.prefilled),
     }
 
 
@@ -153,7 +154,10 @@ def progress_from_wire(payload: Dict):
         priority=int(payload.get("priority", 0)),
         preemptions=int(payload.get("preemptions", 0)),
         adapter_id=payload.get("adapter_id"),
-        deadline_s=payload.get("deadline_s"))
+        deadline_s=payload.get("deadline_s"),
+        # chunked-prefill high-water mark (serve/longctx.py) —
+        # informational; absent on pre-longctx payloads
+        prefilled=int(payload.get("prefilled", 0)))
 
 
 # ---------------------------------------------------------------------------

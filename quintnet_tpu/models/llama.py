@@ -482,6 +482,34 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
     return x, (kc, vc)
 
 
+def llama_block_prefill_paged_sp(p, x, kc, vc, start, t0,
+                                 cfg: LlamaConfig, cos, sin, *,
+                                 sp_axis: str,
+                                 tp_axis: Optional[str] = None,
+                                 block_tables=None,
+                                 block_size: Optional[int] = None):
+    """Sequence-parallel chunked prefill block (the serve engine's
+    long-context path): x [1, Pl, D] is this sp rank's slice of the
+    chunk's hidden states; ``cos``/``sin`` [Pl, hd] must be built from
+    the rank's LOCAL absolute positions (``start + rank*Pl +
+    arange(Pl)``) so rope lands exactly where the dense path puts it.
+    Attention runs through nn/attention.ring_paged_prefill — K/V
+    sharded over ``sp_axis`` during the score pass (GQA UNrepeated on
+    the wire), reassembled by one all_gather for the sp-replicated pool
+    scatter. Returns (x, (kc, vc))."""
+    from quintnet_tpu.nn.attention import ring_paged_prefill
+
+    tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
+    q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
+    o, kc, vc = ring_paged_prefill(
+        q, k, v, start, t0, kc, vc, sp_axis=sp_axis,
+        block_tables=block_tables, block_size=block_size)
+    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
+    x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
+    return x, (kc, vc)
+
+
 def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
                              cfg: LlamaConfig, cos, sin,
                              tp_axis: Optional[str] = None,

@@ -315,6 +315,161 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
     return y, k_cache, v_cache
 
 
+def _online_merge(m, l, acc, m_new, l_new, o_new):
+    """Fold one chunk's (row-max, prob-sum, weighted-V) into running
+    online-softmax accumulators; identity element (-inf, 0, 0). The
+    same recurrence ops/ring_attention.py uses — duplicated here (it is
+    ten lines) because nn/ must not import ops/ (ops/ulysses_attention
+    already imports this module)."""
+    m_tot = jnp.maximum(m, m_new)
+    m_base = jnp.where(jnp.isfinite(m_tot), m_tot, 0.0)
+    c_old = jnp.exp(jnp.where(jnp.isfinite(m), m - m_base, -jnp.inf))
+    c_old = jnp.where(jnp.isfinite(c_old), c_old, 0.0)
+    c_new = jnp.exp(jnp.where(jnp.isfinite(m_new), m_new - m_base,
+                              -jnp.inf))
+    c_new = jnp.where(jnp.isfinite(c_new), c_new, 0.0)
+    return (m_tot, l * c_old + l_new * c_new,
+            acc * c_old[..., None] + o_new * c_new[..., None])
+
+
+def ring_paged_prefill(q, k, v, start, t0, k_cache, v_cache, *,
+                       sp_axis: str, block_tables, block_size: int):
+    """Sequence-parallel chunk attention over the paged pool: ring
+    attention (Liu et al., RingAttention — PAPERS.md) across mesh axis
+    ``sp_axis`` for the chunk's own K/V, merged online with each local
+    query's attention over the already-resident pool prefix, then ONE
+    all_gather reassembles the full chunk K/V for the (replica-local,
+    sp-replicated) pool scatter.
+
+    Inside a shard_map over ``sp_axis``: ``q`` [1, Hq, Pl, Dh] is this
+    rank's slice of the chunk's queries (rank i owns global positions
+    ``start + i*Pl .. start + (i+1)*Pl``), ``k``/``v`` [1, Hkv, Pl, Dh]
+    the matching UNrepeated K/V slice (GQA repeats locally, never on
+    the wire). ``start``/``t0`` are the chunk's dynamic token bounds:
+    positions at or beyond ``t0`` are bucket pad — their keys are
+    masked out of every score and their pool writes land in the null
+    block, exactly :func:`paged_prefill_update`'s convention.
+
+    Per call the sp wire carries ``2*sp`` ppermutes (the stacked K/V
+    pair and its position vector rotate ``sp`` scan steps) plus one
+    all_gather — the census analysis/specs.expected_serve_sp_prefill
+    pins. Peak score memory is O(Pl * pool_row) per device instead of
+    O(P * pool_row): the chunk's [P, P] score block never exists on any
+    one rank, which is the RingAttention point — context length scales
+    with device count, not one chip's memory.
+
+    Returns (o [1, Hq, Pl, Dh] normalized local attention output,
+    k_cache, v_cache with the WHOLE chunk scattered)."""
+    sp = lax.axis_size(sp_axis)
+    idx = lax.axis_index(sp_axis)
+    b, hq, pl, dh = q.shape
+    rep = hq // k.shape[1]
+    scale = 1.0 / math.sqrt(dh)
+    q_pos = start + idx * pl + jnp.arange(pl, dtype=jnp.int32)   # [Pl]
+    qf = q.astype(jnp.float32)
+
+    def contrib(k_in, v_in, mask):
+        """(m, l, o) of local queries vs one K/V chunk under ``mask``
+        [Pl, T] — fully-masked rows yield the merge identity."""
+        kf = repeat_kv(k_in, rep).astype(jnp.float32)
+        vf = repeat_kv(v_in, rep).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhtd->bhqt", qf, kf) * scale
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        m = jnp.max(s, axis=-1)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        p = jnp.where(mask[None, None], jnp.exp(s - m_safe[..., None]),
+                      0.0)
+        return m, jnp.sum(p, axis=-1), \
+            jnp.einsum("bhqt,bhtd->bhqd", p, vf)
+
+    # resident-prefix contribution: the pool BEFORE this chunk's
+    # scatter holds exactly positions [0, start) of this request —
+    # every local query sees all of them (they precede the chunk)
+    k_pool = paged_gather(k_cache, block_tables[None],
+                          block_size=block_size)
+    v_pool = paged_gather(v_cache, block_tables[None],
+                          block_size=block_size)
+    pool_mask = jnp.broadcast_to(
+        jnp.arange(k_pool.shape[2])[None, :] < start,
+        (pl, k_pool.shape[2]))
+    m, l, acc = contrib(k_pool, v_pool, pool_mask)
+
+    # ring over the chunk itself: K/V (stacked) + their positions
+    # rotate sp times; causal masking is positional, so pad keys
+    # (k_pos >= t0) drop out with the same predicate
+    def body(carry, _):
+        m, l, acc, kv, k_pos = carry
+        mask = ((k_pos[None, :] <= q_pos[:, None])
+                & (k_pos[None, :] < t0))
+        m, l, acc = _online_merge(m, l, acc,
+                                  *contrib(kv[0], kv[1], mask))
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        return (m, l, acc, lax.ppermute(kv, sp_axis, perm),
+                lax.ppermute(k_pos, sp_axis, perm)), None
+
+    (m, l, acc, _, _), _ = lax.scan(
+        body, (m, l, acc, jnp.stack([k, v]), q_pos), None, length=sp)
+    o = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+    # one all_gather reassembles the chunk's K/V in rank (= sequence)
+    # order for the replicated pool scatter; positions need no wire —
+    # they are start + arange(P) by construction
+    kv_full = lax.all_gather(jnp.stack([k[0], v[0]]), sp_axis, axis=2,
+                             tiled=True)               # [2, Hkv, P, Dh]
+    positions = start + jnp.arange(pl * sp, dtype=jnp.int32)
+    k_cache, v_cache = paged_prefill_update(
+        k_cache, v_cache, kv_full[0], kv_full[1], positions, t0 - start,
+        block_tables=block_tables, block_size=block_size)
+    return o, k_cache, v_cache
+
+
+def sp_last_hidden(h, start, t0, *, sp_axis: str):
+    """Replicate the chunk's LAST true position's hidden row across
+    the sp ranks: ``h`` [1, Pl, D] is a rank's slice of the chunk
+    (global positions ``start + rank*Pl + arange(Pl)``); position
+    ``t0 - 1`` lives on exactly one rank, so a masked psum (one
+    all_reduce — far cheaper than gathering the whole [1, P, D] chunk
+    for one row) hands every rank the [1, 1, D] row the logits head
+    reads. Model-independent: both families' ``prefill_from_sp`` end
+    with this."""
+    pl = h.shape[1]
+    j = t0 - 1 - start - lax.axis_index(sp_axis) * pl
+    own = (j >= 0) & (j < pl)
+    h_loc = lax.dynamic_slice_in_dim(h, jnp.clip(j, 0, pl - 1), 1,
+                                     axis=1)
+    return lax.psum(jnp.where(own, h_loc, jnp.zeros_like(h_loc)),
+                    sp_axis)
+
+
+def mha_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
+                         num_heads: int, sp_axis: str,
+                         tp_axis: Optional[str] = None,
+                         block_tables=None,
+                         block_size: Optional[int] = None):
+    """:func:`mha_prefill_paged`'s sequence-parallel sibling: ``x``
+    [1, Pl, D] is this sp rank's slice of the chunk's hidden states;
+    the attention runs through :func:`ring_paged_prefill` (K/V sharded
+    over ``sp_axis`` during the score pass, reassembled once for the
+    pool write). The output projection is position-wise, so it stays
+    local. LoRA is deliberately absent — the engine rejects the
+    (adapters, sp) combination at construction."""
+    qkv = linear_apply(p["qkv"], x)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
+    k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
+    v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
+    o, k_cache, v_cache = ring_paged_prefill(
+        q, k, v, start, t0, k_cache, v_cache, sp_axis=sp_axis,
+        block_tables=block_tables, block_size=block_size)
+    o = rearrange(o, "b h s d -> b s (h d)")
+    y = jnp.dot(o, p["proj"]["w"])
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    if "b" in p["proj"]:
+        y = y + p["proj"]["b"]
+    return y, k_cache, v_cache
+
+
 def paged_verify_update(k_cache, v_cache, k, v, positions, tail_lens, *,
                         block_tables, block_size: int):
     """Write EVERY row's short token run into the paged pool in one

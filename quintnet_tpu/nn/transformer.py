@@ -20,7 +20,9 @@ import jax
 import jax.numpy as jnp
 
 from quintnet_tpu.nn.attention import (mha_apply, mha_decode, mha_init,
-                                       mha_prefill_paged, mha_verify_paged)
+                                       mha_prefill_paged,
+                                       mha_prefill_paged_sp,
+                                       mha_verify_paged)
 from quintnet_tpu.nn.layers import (
     gelu,
     layer_norm_apply,
@@ -280,6 +282,29 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                       tp_axis=tp_axis,
                       lora=lora.get("mlp") if lora is not None else None,
                       lora_scale=lora_scale), k_cache, v_cache
+
+
+def block_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
+                           num_heads: int, sp_axis: str,
+                           act: Callable = gelu,
+                           moe_args: Optional[MoEArgs] = None,
+                           tp_axis: Optional[str] = None,
+                           block_tables=None,
+                           block_size: Optional[int] = None):
+    """Sequence-parallel chunked-prefill block step (nn/attention.py
+    mha_prefill_paged_sp): x [1, Pl, D] is this sp rank's slice of the
+    chunk's hidden states at positions ``start + rank*Pl + arange(Pl)``;
+    the attention rides ring_paged_prefill over ``sp_axis`` while the
+    LN/MLP halves are position-wise and stay local. Returns
+    (x, k_cache, v_cache) with the whole chunk's K/V scattered into the
+    (sp-replicated) pool."""
+    a, k_cache, v_cache = mha_prefill_paged_sp(
+        p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
+        start, t0, num_heads=num_heads, sp_axis=sp_axis, tp_axis=tp_axis,
+        block_tables=block_tables, block_size=block_size)
+    x = x + a
+    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                      tp_axis=tp_axis), k_cache, v_cache
 
 
 def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,

@@ -31,6 +31,12 @@ scheduling; vLLM-style paged KV blocks):
   packed low-rank factors so heterogeneous-adapter requests batch into
   the SAME decode step (S-LoRA/Punica style), token-identical to
   dedicated merged-weight engines;
+- :mod:`longctx` — long-context serving: Sarathi-style chunked prefill
+  (a prompt longer than the largest compiled bucket is admitted whole
+  and streamed through the existing bucket programs under a per-step
+  token budget, so concurrent decodes never starve) and the planning
+  half of the ring-attention sequence-parallel prefill path (chunk K/V
+  sharded over an ``sp`` mesh axis while scoring);
 - :mod:`api` — blocking ``generate()`` + streaming per-token callbacks;
 - :mod:`metrics` — per-step counters and TTFT / tok/s percentiles.
 
@@ -43,6 +49,7 @@ from quintnet_tpu.serve.api import generate, generate_stream
 from quintnet_tpu.serve.engine import (ServeEngine, check_admissible)
 from quintnet_tpu.serve.families import gpt2_family, llama_family
 from quintnet_tpu.serve.kv_pool import AdmitPlan, KVPool
+from quintnet_tpu.serve.longctx import ChunkState, plan_chunks
 from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
 from quintnet_tpu.serve.scheduler import (DeadlineExceeded, Request,
                                           RequestProgress, Scheduler)
@@ -52,6 +59,7 @@ __all__ = [
     "AdapterEntry",
     "AdapterRegistry",
     "AdmitPlan",
+    "ChunkState",
     "DeadlineExceeded",
     "KVPool",
     "NgramDrafter",
@@ -67,4 +75,5 @@ __all__ = [
     "generate_stream",
     "gpt2_family",
     "llama_family",
+    "plan_chunks",
 ]

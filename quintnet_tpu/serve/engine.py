@@ -49,6 +49,23 @@ RecompileSentinel with ``max_compiles=1`` (tests/test_serve.py
 additionally observes zero backend compiles over a mixed trace via a
 jax.monitoring hook).
 
+Long context (``chunked_prefill=True``, serve/longctx.py): a prompt
+longer than the largest prefill bucket — inadmissible above — is
+admitted WHOLE (block table allocated up front; the ceiling becomes
+pool capacity) and streamed through the SAME bucket programs across
+engine steps at dynamic offsets, at most ``prefill_chunk_budget``
+prompt tokens per step (Sarathi-Serve), so generating slots keep
+emitting one token every step instead of stalling behind a monolithic
+prefill. Chunked output is bit-identical to a single-shot prefill
+(each chunk's attention gathers the pool row the previous chunks
+wrote — the prefix-cache math), and mid-prefill slots compose with
+preemption/deadlines/migration through ``_pos`` (valid-KV count) and
+the untouched submit key. With a mesh carrying an ``sp`` axis
+(``sp_axis=``), each chunk's attention additionally runs
+ring-sharded across the ranks (nn/attention.ring_paged_prefill;
+census in analysis/specs.expected_serve_sp_prefill) — ``sp`` absent
+or 1 builds exactly the plain programs.
+
 Prefix caching (``prefix_cache=True``, the default): on admission the
 engine looks up the longest cached block-chain for ``prompt +
 generated`` (serve/kv_pool.py), pins and clones those table entries,
@@ -106,7 +123,8 @@ from quintnet_tpu.serve.spec import NgramDrafter, SpecConfig
 def check_admissible(prompt_len: int, max_new_tokens: int, *,
                      max_seq_len: int, prefill_len: int,
                      usable_blocks: int, block_size: int,
-                     max_slots: int = 0) -> None:
+                     max_slots: int = 0,
+                     chunked_prefill: bool = False) -> None:
     """Submit-time rejection of requests an engine with these limits
     can NEVER run. Standalone (no engine instance) so a remote
     dispatcher — the process fleet's parent, which has only the
@@ -115,7 +133,10 @@ def check_admissible(prompt_len: int, max_new_tokens: int, *,
     replica process. ``max_slots`` rides along in ``limits()`` for
     dispatch-window sizing and is accepted (unused) here so the dict
     splats straight in — slot occupancy churns per step and is never an
-    admissibility bound."""
+    admissibility bound. ``chunked_prefill`` (serve/longctx.py) lifts
+    the prefill-window bound: a chunked engine streams any prompt
+    through bucket-sized chunks, so only ``max_seq_len`` and pool
+    capacity remain."""
     if prompt_len < 1:
         raise ValueError("empty prompt")
     if max_new_tokens < 1:
@@ -128,12 +149,18 @@ def check_admissible(prompt_len: int, max_new_tokens: int, *,
     # a preemption-resume prefills prompt + generated (up to
     # total - 1 tokens), so prefill_len must cover that, not just
     # the prompt — cache hits can shrink the tail but are never
-    # guaranteed (the chain may have been evicted)
-    if total - 1 > prefill_len:
+    # guaranteed (the chain may have been evicted). Chunked engines
+    # have no such window: any prefill streams through the buckets.
+    if total - 1 > prefill_len and not chunked_prefill:
         raise ValueError(
             f"prompt {prompt_len} + max_new {max_new_tokens} - 1 "
             f"exceeds prefill_len={prefill_len} (resume after "
-            f"preemption prefills prompt + generated tokens)")
+            f"preemption prefills prompt + generated tokens). Long "
+            f"prompts are served by the chunked-prefill mode: "
+            f"ServeEngine(chunked_prefill=True) admits any prompt the "
+            f"pool can hold and streams it through bucket-sized "
+            f"chunks without starving decode (docs/serving.md, "
+            f"'Long context')")
     # fail fast on requests the pool can NEVER admit: admission
     # needs blocks_for(total_len + 1) in the worst (cache-cold)
     # case — otherwise the scheduler would return None forever and
@@ -161,7 +188,11 @@ class ServeEngine:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, policy: str = "fcfs",
-                 mesh=None, tp_axis: str = "tp", kv_dtype=None,
+                 mesh=None, tp_axis: str = "tp",
+                 sp_axis: Optional[str] = None,
+                 chunked_prefill: bool = False,
+                 prefill_chunk_budget: Optional[int] = None,
+                 kv_dtype=None,
                  logger=None, log_every: int = 0,
                  clock=time.monotonic):
         self.family = family
@@ -173,6 +204,43 @@ class ServeEngine:
         self.top_p = float(top_p)
         self.mesh = mesh
         self.tp_axis = tp_axis if mesh is not None else None
+        # sequence-parallel prefill (serve/longctx.py): an ``sp`` mesh
+        # axis of size > 1 swaps the prefill programs for ring-attention
+        # ones (chunk K/V sharded across the ranks while scoring, one
+        # all_gather for the replica-local pool write). sp absent or of
+        # size 1 builds EXACTLY today's programs — the byte-identity
+        # contract engine(sp=1) promises.
+        self.sp_axis: Optional[str] = None
+        if sp_axis is not None and (mesh is None
+                                    or sp_axis not in mesh.shape):
+            # an explicitly-requested sp axis the mesh does not carry
+            # is a misconfiguration, not a degenerate case — silently
+            # running replicated would burn N devices for nothing
+            raise ValueError(
+                f"sp_axis={sp_axis!r} is not an axis of the mesh "
+                f"({None if mesh is None else tuple(mesh.shape)}); "
+                f"pass a mesh with that axis (size 1 falls back to "
+                f"the plain programs) or drop sp_axis")
+        if (mesh is not None and sp_axis is not None
+                and mesh.shape[sp_axis] > 1):
+            if family.prefill_from_sp is None:
+                raise ValueError(
+                    f"family {family.name!r} has no sequence-parallel "
+                    f"prefill path (Family.prefill_from_sp is None)")
+            if tp_axis in mesh.shape and mesh.shape[tp_axis] > 1:
+                raise NotImplementedError(
+                    "sequence-parallel prefill does not yet compose "
+                    "with tensor parallelism — use an sp-only mesh "
+                    "(tp x sp is a future extension)")
+            if adapters:
+                raise NotImplementedError(
+                    "sequence-parallel prefill does not yet compose "
+                    "with multi-tenant adapters")
+            self.sp_axis = sp_axis
+        if self.sp_axis is not None or (
+                mesh is not None and tp_axis not in mesh.shape):
+            # sp-only mesh: params/pool replicated, no tp collectives
+            self.tp_axis = None
         self.logger = logger
         self.log_every = int(log_every)
         self.clock = clock
@@ -295,12 +363,31 @@ class ServeEngine:
                 f"prefill_len={self.prefill_len} (a preemption-resume "
                 f"prefill can need the full length)")
         self.prefill_buckets = buckets
+        if self.sp_axis is not None:
+            from quintnet_tpu.serve.longctx import validate_sp_buckets
+
+            validate_sp_buckets(buckets, mesh.shape[self.sp_axis])
+
+        # chunked prefill (serve/longctx.py): prompts longer than the
+        # top bucket are admitted whole and streamed through the
+        # EXISTING bucket programs across steps, at most
+        # ``prefill_chunk_budget`` prefill tokens per engine step
+        # (Sarathi-style) so decoding slots keep emitting every step
+        self.chunked_prefill = bool(chunked_prefill)
+        self.prefill_chunk_budget = (buckets[-1]
+                                     if prefill_chunk_budget is None
+                                     else int(prefill_chunk_budget))
+        if self.prefill_chunk_budget < 1:
+            raise ValueError(
+                f"prefill_chunk_budget must be >= 1; got "
+                f"{self.prefill_chunk_budget}")
 
         sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            sharding = NamedSharding(mesh, P(None, None, tp_axis, None))
+            sharding = NamedSharding(mesh,
+                                     P(None, None, self.tp_axis, None))
         self.pool = KVPool(
             n_layers=family.n_layers, n_kv_heads=family.n_kv_heads,
             head_dim=family.head_dim, block_size=block_size,
@@ -320,6 +407,10 @@ class ServeEngine:
             jax.random.key_data(jax.random.split(jax.random.key(0), S)))
         self._slot_req: List[Optional[Request]] = [None] * S
         self._slot_blocks: List[List[int]] = [[] for _ in range(S)]
+        # chunked-prefill progress per slot (serve/longctx.ChunkState);
+        # a non-None entry means the slot is mid-prefill: it owns its
+        # table but does not ride decode/verify steps yet
+        self._slot_chunk: List[Optional[object]] = [None] * S
 
         self._results: Dict[int, Request] = {}
         self._rid_counter = 0
@@ -390,6 +481,7 @@ class ServeEngine:
     def _build_prefill(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        sp_axis = self.sp_axis
         use_lora = self.adapters is not None
 
         def body(params, k_pool, v_pool, ids, start, t0, table_row,
@@ -400,7 +492,9 @@ class ServeEngine:
             # are copied from cow_src into this request's first private
             # block BEFORE the tail lands — the cached copy stays
             # immutable while the index references it. cow_len == 0
-            # degenerates to masked writes into the null block.
+            # degenerates to masked writes into the null block. (Under
+            # sp the pool is replicated — every rank does the identical
+            # copy.)
             sl = jnp.arange(bs)
             M = table_row.shape[0]
             dst = table_row[jnp.clip(start // bs, 0, M - 1)]
@@ -409,9 +503,18 @@ class ServeEngine:
             k_pool = k_pool.at[:, dst_idx].set(k_pool[:, src_idx])
             v_pool = v_pool.at[:, dst_idx].set(v_pool[:, src_idx])
 
-            logits, k_pool, v_pool = family.prefill_from(
-                params, k_pool, v_pool, ids, start, t0, table_row, bs,
-                tp_axis=tp_axis, lora=lora, lora_scale=lora_scale)
+            if sp_axis is None:
+                logits, k_pool, v_pool = family.prefill_from(
+                    params, k_pool, v_pool, ids, start, t0, table_row,
+                    bs, tp_axis=tp_axis, lora=lora,
+                    lora_scale=lora_scale)
+            else:
+                # sequence-parallel chunk: ids arrives as this rank's
+                # [1, P/sp] slice (the shard_map below splits dim 1);
+                # ring attention inside (nn/attention.ring_paged_prefill)
+                logits, k_pool, v_pool = family.prefill_from_sp(
+                    params, k_pool, v_pool, ids, start, t0, table_row,
+                    bs, sp_axis=sp_axis, tp_axis=tp_axis)
 
             key = jax.random.wrap_key_data(key_data)
             key2, sub = jax.random.split(key)
@@ -420,7 +523,8 @@ class ServeEngine:
             return (k_pool, v_pool, tok.astype(jnp.int32),
                     jax.random.key_data(key2))
 
-        return self._wrap(body, n_pool_args=2, n_rest=7, donate=donate)
+        return self._wrap(body, n_pool_args=2, n_rest=7, donate=donate,
+                          ids_sharded=True)
 
     def _build_decode(self, *, donate):
         family, bs = self.family, self.pool.block_size
@@ -489,7 +593,8 @@ class ServeEngine:
 
         return self._wrap(body, n_pool_args=2, n_rest=5, donate=donate)
 
-    def _wrap(self, body, *, n_pool_args: int, n_rest: int, donate):
+    def _wrap(self, body, *, n_pool_args: int, n_rest: int, donate,
+              ids_sharded: bool = False):
         """jit, donating the aliasable arguments: the pool buffers
         (decode-state updates are in-place on device) plus the per-step
         host-shipped rows that alias an output (tok/t0/key_data are
@@ -499,12 +604,31 @@ class ServeEngine:
         replicated — and with adapters armed, the packed LoRA factors
         sharded per-target like their weights (adapters.py
         packed_lora_specs: a in-sharded, b out-sharded; never
-        donated — they persist across steps)."""
+        donated — they persist across steps).
+
+        Under an ``sp`` mesh (sequence-parallel prefill) everything is
+        REPLICATED — params, pool, per-step rows — except the prefill's
+        ids, sharded over sp on the token dim (``ids_sharded``): the
+        collectives live inside the body (ring ppermutes + the chunk
+        K/V all_gather), not in the data layout. Decode/verify run
+        fully replicated: every rank computes the identical step, so
+        engine semantics (and outputs) match the single-device program
+        exactly."""
         if self.mesh is None:
             return jax.jit(body, donate_argnums=donate)
         from jax.sharding import PartitionSpec as P
 
         from quintnet_tpu.core import collectives as cc
+
+        if self.sp_axis is not None:
+            rest = [P()] * n_rest
+            if ids_sharded:
+                rest[0] = P(None, self.sp_axis)
+            smapped = cc.shard_map_fn(
+                body, self.mesh,
+                in_specs=(P(),) * (1 + n_pool_args) + tuple(rest),
+                out_specs=(P(),) * n_pool_args + (P(), P()))
+            return jax.jit(smapped, donate_argnums=donate)
 
         pool_spec = P(None, None, self.tp_axis, None)
         pspecs = self.family.partition_specs(self.tp_axis)
@@ -708,7 +832,8 @@ class ServeEngine:
                 "prefill_len": self.prefill_len,
                 "usable_blocks": self.pool.usable_blocks,
                 "block_size": self.pool.block_size,
-                "max_slots": self.max_slots}
+                "max_slots": self.max_slots,
+                "chunked_prefill": self.chunked_prefill}
 
     def _check_admissible(self, prompt: np.ndarray,
                           max_new_tokens: int) -> None:
@@ -853,6 +978,14 @@ class ServeEngine:
             req.on_token(req.rid, int(token), last)
 
     def _clear_slot(self, slot: int) -> None:
+        st = self._slot_chunk[slot]
+        if st is not None and st.cow_pinned:
+            # the admission plan's COW-source pin is normally released
+            # right after the first chunk copies from it; a slot
+            # cleared before any chunk ran (preempt/deadline) must
+            # release it here or the block leaks a refcount forever
+            self.pool.release([st.cow_src])
+        self._slot_chunk[slot] = None
         self._slot_req[slot] = None
         self._slot_blocks[slot] = []
         self._tables[slot] = 0
@@ -954,11 +1087,16 @@ class ServeEngine:
         req.generated.append(int(token))
         if req.adapter_id is not None:
             self.metrics.record_adapter_token(req.adapter_id)
+        now = self.clock()
         if req.first_token_time is None:
-            req.first_token_time = self.clock()
+            req.first_token_time = now
             self.metrics.record_first_token(
-                req.first_token_time - req.submit_time,
-                adapter_id=req.adapter_id)
+                now - req.submit_time, adapter_id=req.adapter_id)
+        elif req.last_token_time is not None:
+            # inter-token gap: the starvation signal a monolithic
+            # prefill inflates and the chunk budget bounds
+            self.metrics.record_itl(now - req.last_token_time)
+        req.last_token_time = now
         done = (req.remaining_new_tokens <= 0
                 or (self.eos_token_id is not None
                     and int(token) == self.eos_token_id))
@@ -975,22 +1113,19 @@ class ServeEngine:
             f"{self.prefill_buckets[-1]} — _check_admissible should "
             f"have rejected this request")
 
-    def _admit_one(self, slot: int, req: Request) -> Tuple[int, int]:
-        """Admit ``req`` into ``slot``: reuse the longest cached prefix
-        chain, prefill only the uncached tail in the smallest bucket
-        that holds it. Returns (tail tokens prefilled, cached tokens
-        reused)."""
+    def _allocate_slot(self, slot: int, req: Request):
+        """The admission prologue both prefill paths share: resolve
+        the plan the scheduler's budget check approved (same step, no
+        pool mutation in between; recomputed only for direct callers
+        in tests), pin the cached chain FIRST — the private-block
+        acquire below may evict refcount-zero cached blocks, and
+        without the pin it could evict the very chain this admission
+        is about to reference — then acquire the private blocks and
+        build the slot's table row. Returns the plan."""
         t0 = req.total_len
-        tokens = req.output_ids()
-        # the plan the scheduler's budget check approved (same step,
-        # no pool mutation in between); computed here only for direct
-        # _admit_one callers in tests
         plan = req.admit_plan or self.pool.plan_admission(
-            tokens, t0 + 1, namespace=req.adapter_id)
+            req.output_ids(), t0 + 1, namespace=req.adapter_id)
         req.admit_plan = None
-        # pin the chain FIRST: the private-block acquire below may evict
-        # refcount-zero cached blocks, and without the pin it could
-        # evict the very chain this admission is about to reference
         self.pool.acquire_cached(plan.pinned_blocks)
         new = self.pool.acquire(plan.n_new_blocks)
         assert new is not None  # admission checked the budget
@@ -1000,6 +1135,17 @@ class ServeEngine:
         row = np.zeros((self.table_width,), np.int32)
         row[:len(blocks)] = blocks
         self._tables[slot] = row
+        return plan
+
+    def _admit_one(self, slot: int, req: Request) -> Tuple[int, int]:
+        """Admit ``req`` into ``slot``: reuse the longest cached prefix
+        chain, prefill only the uncached tail in the smallest bucket
+        that holds it. Returns (tail tokens prefilled, cached tokens
+        reused)."""
+        t0 = req.total_len
+        tokens = req.output_ids()
+        plan = self._allocate_slot(slot, req)
+        row = self._tables[slot]
 
         start = plan.cached_tokens
         tail = tokens[start:t0]
@@ -1031,6 +1177,106 @@ class ServeEngine:
         if self._append_token(slot, tok0):
             self._retire(slot)
         return len(tail), start
+
+    # ------------------------------------------------------------------
+    # chunked prefill (serve/longctx.py)
+    # ------------------------------------------------------------------
+    def _admit_slot_chunked(self, slot: int, req: Request) -> int:
+        """Chunked admission: allocate the request's WHOLE block table
+        up front — the prompt-length ceiling becomes pool capacity, not
+        the compile ladder — but run no prefill compute yet;
+        :meth:`_feed_chunks` streams the uncached tail through the
+        bucket programs under the per-step token budget. Returns the
+        prefix-cache hit (positions already resident)."""
+        from quintnet_tpu.serve.longctx import ChunkState
+
+        t0 = req.total_len
+        plan = self._allocate_slot(slot, req)
+        # mid-prefill invariants: _pos counts exactly the positions
+        # holding valid KV (so publish-on-preempt/deadline stays
+        # correct), and the PRNG key has NOT advanced — sampling
+        # happens once, on the final chunk — so an export mid-prefill
+        # carries the submit key and resumes bit-identically anywhere
+        self._pos[slot] = plan.cached_tokens
+        self._tok[slot] = 0
+        self._key_data[slot] = np.array(req.key_data, copy=True)
+        req.prefilled = plan.cached_tokens
+        if self.adapters is not None and req.adapter_id is not None:
+            self._bind_slot_adapter(slot, req.adapter_id)
+        self._slot_chunk[slot] = ChunkState(
+            next=plan.cached_tokens, t0=t0, cow_src=plan.cow_src,
+            cow_len=plan.cow_len, cow_pinned=plan.cow_src is not None)
+        return plan.cached_tokens
+
+    def _run_chunk(self, slot: int, req: Request, st, n: int,
+                   finished: List[int]) -> None:
+        """One ``n``-token chunk through the smallest covering bucket
+        program — the SAME compiled ``prefill_from`` call a prefix-
+        cache tail uses, at dynamic offset ``st.next``. Intermediate
+        chunks discard the program's sampled token and split key (the
+        chain must advance exactly once per prefill); the final chunk
+        adopts both, exactly like a single-shot admission."""
+        tokens = req.output_ids()
+        chunk = tokens[st.next:st.next + n]
+        bucket = self._bucket_for(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = chunk
+        cow = st.cow_pinned
+        extra = (self._lora_args("prefill", slot=slot)
+                 if self.adapters is not None else ())
+        kp, vp, tok0, key2 = self._prefills[bucket](
+            self.params, *self.pool.caches(), jnp.asarray(ids),
+            jnp.int32(st.next), jnp.int32(st.next + n),
+            jnp.asarray(self._tables[slot]),
+            jnp.int32(st.cow_src if cow else 0),
+            jnp.int32(st.cow_len if cow else 0),
+            jnp.asarray(self._key_data[slot]), *extra)
+        self.pool.update(kp, vp)
+        if cow:
+            # the COW source was pinned only for the copy above
+            self.pool.release([st.cow_src])
+            st.cow_pinned = False
+        st.next += n
+        st.chunks_done += 1
+        self._pos[slot] = st.next
+        req.prefilled = st.next
+        if not st.done:
+            return  # intermediate chunk: tok0/key2 discarded
+        self._slot_chunk[slot] = None
+        self._key_data[slot] = np.asarray(key2)
+        tok0 = int(tok0)
+        self._tok[slot] = tok0
+        self.metrics.record_admit()
+        if self._append_token(slot, tok0):
+            finished.append(self._retire(slot))
+
+    def _feed_chunks(self, finished: List[int]) -> Tuple[int, int]:
+        """Stream queued chunk work through the bucket programs — at
+        most ``prefill_chunk_budget`` prompt tokens this step (the
+        Sarathi-Serve knob: bounded prefill work per iteration keeps
+        the decode step below emitting every step). Oldest admissions
+        first, whole budget to one request before the next (finishing
+        a prefill early beats fair-sharing TTFT across all of them).
+        Returns (prompt tokens prefilled, chunk invocations)."""
+        budget = self.prefill_chunk_budget
+        top = self.prefill_buckets[-1]
+        tokens_done = chunks = 0
+        order = sorted(
+            (s for s in self._active_slots()
+             if self._slot_chunk[s] is not None),
+            key=lambda s: self._slot_req[s].admit_seq)
+        for slot in order:
+            req = self._slot_req[slot]
+            st = self._slot_chunk[slot]
+            while budget > 0 and self._slot_chunk[slot] is st:
+                n = min(st.remaining, top, budget)
+                self._run_chunk(slot, req, st, n, finished)
+                budget -= n
+                tokens_done += n
+                chunks += 1
+            if budget <= 0:
+                break
+        return tokens_done, chunks
 
     def _grow_or_preempt(self) -> None:
         """Ensure every active slot holds the block its next write
@@ -1192,9 +1438,10 @@ class ServeEngine:
         return committed, drafted, accepted
 
     def step(self) -> List[int]:
-        """One scheduler iteration: admit -> grow/preempt -> one decode
-        step for every active slot -> retire finished rows. Returns the
-        request ids that finished this step."""
+        """One scheduler iteration: admit -> (chunked mode) feed
+        budget-capped prefill chunks -> grow/preempt -> one decode
+        step for every GENERATING slot -> retire finished rows.
+        Returns the request ids that finished this step."""
         finished: List[int] = []
         prefill_tokens = 0
         prefix_hit_tokens = 0
@@ -1202,36 +1449,56 @@ class ServeEngine:
         # 0. deadline enforcement — running slots AND the waiting queue
         self._sweep_deadlines(finished)
 
-        # 1. admissions (prefill; may retire instantly on EOS/budget)
+        # 1. admissions — chunked mode allocates slot + table only
+        # (the budget-capped chunk feed below does the compute); plain
+        # mode prefills the whole tail here, as always
         while not self._admissions_paused:
             free = self._free_slots()
             req = self.scheduler.next_admission(len(free))
             if req is None:
                 break
             slot = free[0]
-            tail, hit = self._admit_one(slot, req)
-            prefill_tokens += tail
-            prefix_hit_tokens += hit
-            if self._slot_req[slot] is None:  # instant retire
-                finished.append(req.rid)
+            if self.chunked_prefill:
+                prefix_hit_tokens += self._admit_slot_chunked(slot, req)
+            else:
+                tail, hit = self._admit_one(slot, req)
+                prefill_tokens += tail
+                prefix_hit_tokens += hit
+                if self._slot_req[slot] is None:  # instant retire
+                    finished.append(req.rid)
+
+        # 1b. chunk feed (chunked mode): at most prefill_chunk_budget
+        # prompt tokens through the bucket programs this step — the
+        # decode step below still runs for every generating slot, so
+        # in-flight streams emit a token per step no matter how long
+        # the prompt being prefilled is (Sarathi-Serve)
+        prefill_chunks = 0
+        if self.chunked_prefill:
+            fed, prefill_chunks = self._feed_chunks(finished)
+            prefill_tokens += fed
 
         # 2. block growth / preemption for the upcoming writes
         self._grow_or_preempt()
 
-        # 3. one decode step for all active slots — or, when the
-        # drafter found a worthwhile proposal for some slot, ONE
-        # batched verify step scoring every slot's draft (slots with
-        # no draft ride along with a 1-token run, bit-equal to decode)
+        # 3. one decode step for every GENERATING slot (mid-prefill
+        # slots sit out — their first token comes from their final
+        # chunk) — or, when the drafter found a worthwhile proposal,
+        # ONE batched verify step scoring every decoding slot's draft
+        # (slots with no draft ride along with a 1-token run,
+        # bit-equal to decode)
         active = self._active_slots()
+        decoding = [s for s in active if self._slot_chunk[s] is None]
+        prefilling = [s for s in active
+                      if self._slot_chunk[s] is not None]
         decode_tokens = 0
         draft_tokens = accepted_draft = 0
         spec_step = False
-        if active:
-            drafts = self._propose_drafts(active)
+        if decoding:
+            drafts = self._propose_drafts(decoding)
             if drafts is not None:
                 spec_step = True
                 decode_tokens, draft_tokens, accepted_draft = \
-                    self._verify_step(active, drafts, finished)
+                    self._verify_step(decoding, drafts, finished)
             else:
                 if self.adapters is None:
                     sentinel, extra = self._decode, ()
@@ -1239,15 +1506,34 @@ class ServeEngine:
                     R = self._decode_rank_bucket()
                     sentinel = self._decodes[R]
                     extra = self._lora_args("decode", rank_bucket=R)
+                tok, pos, tables = self._tok, self._pos, self._tables
+                if prefilling:
+                    # mid-prefill rows must look INACTIVE to the
+                    # decode program: zero table/pos routes their
+                    # write to the null block (their real table must
+                    # not take a garbage token at position _pos, which
+                    # the next chunk would otherwise have to overwrite)
+                    tok = tok.copy()
+                    pos = pos.copy()
+                    tables = tables.copy()
+                    for s in prefilling:
+                        tok[s] = 0
+                        pos[s] = 0
+                        tables[s] = 0
                 kp, vp, nxt, key2 = sentinel(
                     self.params, *self.pool.caches(),
-                    jnp.asarray(self._tok), jnp.asarray(self._pos),
-                    jnp.asarray(self._tables),
+                    jnp.asarray(tok), jnp.asarray(pos),
+                    jnp.asarray(tables),
                     jnp.asarray(self._key_data), *extra)
                 self.pool.update(kp, vp)
                 nxt = np.asarray(nxt)
-                self._key_data = np.array(key2)
-                for slot in active:
+                key2 = np.array(key2)
+                for s in prefilling:
+                    # a mid-prefill slot's chain must not advance —
+                    # its one split happens on its final chunk
+                    key2[s] = self._key_data[s]
+                self._key_data = key2
+                for slot in decoding:
                     token = int(nxt[slot])
                     self._tok[slot] = token
                     self._pos[slot] += 1
@@ -1266,7 +1552,8 @@ class ServeEngine:
             prefix_hit_tokens=prefix_hit_tokens,
             spec_step=spec_step,
             draft_tokens=draft_tokens,
-            accepted_draft_tokens=accepted_draft)
+            accepted_draft_tokens=accepted_draft,
+            prefill_chunks=prefill_chunks)
         if self.log_every:
             self.metrics.log_step(self.logger, every=self.log_every)
         return finished

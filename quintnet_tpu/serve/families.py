@@ -80,6 +80,12 @@ class Family:
     #                           lora, lora_scale)
     #                           -> (logits [S, P, V], kp, vp)
     partition_specs: Callable  # (tp_axis) -> param pytree specs
+    # sequence-parallel prefill (long-context serving, serve/longctx.py):
+    # same contract as prefill_from except ids is THIS SP RANK's slice
+    # [1, P/sp] of the bucket (the engine's shard_map splits dim 1) and
+    # the body runs ring attention over sp_axis
+    # (nn/attention.ring_paged_prefill). None = family has no sp path.
+    prefill_from_sp: Optional[Callable] = None
     kv_dtype: Any = jnp.float32
     # default LoRA target names for this family's blocks (engine's
     # lora_targets default — models/lora.py ladder names)
@@ -119,9 +125,11 @@ def gpt2_family(cfg) -> Family:
     from quintnet_tpu.models.gpt2_generate import (_embed_tok, _local_heads,
                                                    _logits)
     from quintnet_tpu.models.lora import DEFAULT_TARGETS
+    from quintnet_tpu.nn.attention import sp_last_hidden
     from quintnet_tpu.nn.layers import gelu
     from quintnet_tpu.nn.transformer import (block_decode,
                                              block_prefill_paged,
+                                             block_prefill_paged_sp,
                                              block_verify_paged)
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
@@ -195,6 +203,38 @@ def gpt2_family(cfg) -> Family:
             body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         return _logits(params, h, cfg, tp_axis), k_pool, v_pool
 
+    def prefill_from_sp(params, k_pool, v_pool, ids, start, t0,
+                        table_row, block_size, *, sp_axis: str,
+                        tp_axis=None):
+        # ids: [1, P/sp] — THIS sp rank's slice of the padded chunk
+        # (the engine shard_maps the bucket over sp); positions are the
+        # rank's absolute offsets, so embedding/rope/masking all land
+        # exactly where the single-device program puts them
+        B, Pl = ids.shape
+        idx = lax.axis_index(sp_axis)
+        emb = params["embedding"]
+        positions = (start + idx * Pl
+                     + jnp.arange(Pl, dtype=jnp.int32))
+        safe_pos = jnp.clip(positions, 0, emb["wpe"].shape[0] - 1)
+        h = (_embed_tok(emb, ids, cfg, tp_axis)
+             + jnp.take(emb["wpe"], safe_pos, axis=0)[None])
+        heads = _local_heads(cfg, tp_axis)
+
+        def body(x, layer):
+            blk, kc, vc, _ = _scan_layer(layer, None)
+            x, kc, vc = block_prefill_paged_sp(
+                blk, x, kc, vc, start, t0, num_heads=heads,
+                sp_axis=sp_axis, act=gelu, moe_args=cfg.moe_args,
+                tp_axis=tp_axis, block_tables=table_row,
+                block_size=block_size)
+            return x, (kc, vc)
+
+        h, (k_pool, v_pool) = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, None))
+        h_last = sp_last_hidden(h, start, t0, sp_axis=sp_axis)
+        return (_logits(params, h_last, cfg, tp_axis)[:, 0, :],
+                k_pool, v_pool)
+
     def lora_layout(path, b, tp):
         # fused qkv columns are tp-BLOCKED in the serving layout
         # (parallel/tp.py gpt2_to_tp_layout); re-block the adapter's b
@@ -209,6 +249,7 @@ def gpt2_family(cfg) -> Family:
         name="gpt2", cfg=cfg, n_layers=cfg.n_layer, n_kv_heads=cfg.n_head,
         head_dim=cfg.n_embd // cfg.n_head, max_positions=cfg.n_positions,
         prefill_from=prefill_from, decode=decode, verify=verify,
+        prefill_from_sp=prefill_from_sp,
         partition_specs=lambda tp_axis: gpt2_partition_specs(
             cfg, tp_axis=tp_axis),
         lora_targets=DEFAULT_TARGETS, lora_layout=lora_layout,
@@ -222,11 +263,13 @@ def gpt2_family(cfg) -> Family:
 def llama_family(cfg) -> Family:
     from quintnet_tpu.models.llama import (llama_block_decode,
                                            llama_block_prefill_paged,
+                                           llama_block_prefill_paged_sp,
                                            llama_block_verify_paged,
                                            llama_partition_specs,
                                            llama_rope_tables)
     from quintnet_tpu.models.llama_generate import _embed, _full_logits
     from quintnet_tpu.models.lora import LLAMA_TARGETS
+    from quintnet_tpu.nn.attention import sp_last_hidden
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
                      block_size, tp_axis=None, lora=None, lora_scale=None):
@@ -290,11 +333,38 @@ def llama_family(cfg) -> Family:
             body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
         return _full_logits(params, h, cfg, tp_axis), k_pool, v_pool
 
+    def prefill_from_sp(params, k_pool, v_pool, ids, start, t0,
+                        table_row, block_size, *, sp_axis: str,
+                        tp_axis=None):
+        # ids: [1, P/sp] — this sp rank's chunk slice; rope tables come
+        # from the rank's LOCAL absolute positions
+        B, Pl = ids.shape
+        idx = lax.axis_index(sp_axis)
+        h = _embed(params, ids, cfg, tp_axis)
+        positions = (start + idx * Pl
+                     + jnp.arange(Pl, dtype=jnp.int32))
+        cos, sin = llama_rope_tables(positions, cfg)      # [Pl, hd]
+
+        def body(x, layer):
+            blk, kc, vc, _ = _scan_layer(layer, None)
+            x, (kc, vc) = llama_block_prefill_paged_sp(
+                blk, x, kc, vc, start, t0, cfg, cos, sin,
+                sp_axis=sp_axis, tp_axis=tp_axis,
+                block_tables=table_row, block_size=block_size)
+            return x, (kc, vc)
+
+        h, (k_pool, v_pool) = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, None))
+        h_last = sp_last_hidden(h, start, t0, sp_axis=sp_axis)
+        return (_full_logits(params, h_last, cfg, tp_axis)[:, 0, :],
+                k_pool, v_pool)
+
     return Family(
         name="llama", cfg=cfg, n_layers=cfg.n_layers,
         n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
         max_positions=cfg.n_positions,
         prefill_from=prefill_from, decode=decode, verify=verify,
+        prefill_from_sp=prefill_from_sp,
         partition_specs=lambda tp_axis: llama_partition_specs(
             cfg, tp_axis=tp_axis),
         lora_targets=LLAMA_TARGETS,

@@ -1,0 +1,128 @@
+"""Long-context serving: chunked prefill scheduling + sp-prefill plans.
+
+Two halves, one goal — a prompt longer than the largest compiled
+prefill bucket is served without compiling anything new:
+
+**Chunked prefill** (Sarathi-Serve, Agrawal et al. — PAPERS.md): with
+``ServeEngine(chunked_prefill=True)`` a long prompt is admitted WHOLE
+(its block table allocated up front, so the ceiling is pool capacity,
+not the compile ladder) and streamed through the EXISTING
+``prefill_from`` bucket programs across successive engine steps — each
+chunk lands at a dynamic ``start`` offset exactly like a prefix-cache
+tail, so no new compiled program exists for any prompt length. A
+per-step **prefill token budget** caps how much chunk work one engine
+step may do; the decode step for already-generating slots runs every
+step regardless, so in-flight streams keep emitting one token per step
+instead of stalling behind a monolithic prefill (the Sarathi
+piggybacking insight: prefill throughput is traded at the margin for
+bounded decode latency). Because every chunk is an ordinary
+``prefill_from`` call whose attention gathers the pool row written by
+the chunks before it, the chunked output is BIT-identical to a
+hypothetical single-shot prefill of the same tokens — per-position
+compute chains are equal term by term (tests/test_longctx.py proves it
+against a widened single-bucket engine).
+
+Mid-prefill state composes with the rest of the serving stack through
+the machinery that already exists:
+
+- **preemption / deadline retirement** publish the slot's valid-KV
+  prefix (``_pos`` counts exactly the positions whose chunks have
+  landed) into the prefix cache, so a resume re-prefills almost
+  nothing — and nothing at all if the chain survives;
+- **kill-migration** exports the ordinary
+  :class:`~quintnet_tpu.serve.scheduler.RequestProgress` (the PRNG key
+  has not advanced — sampling happens once, on the final chunk), and
+  the restoring engine simply re-chunks ``prompt + generated``;
+  ``prefilled`` rides the payload so operators can see how far a
+  migrated prefill had gotten;
+- **the prefix cache** sees every completed chunk when the request
+  retires/preempts, keyed as today — two long documents sharing a
+  prefix pay for it once.
+
+**Sequence-parallel prefill** (RingAttention, Liu et al. — PAPERS.md):
+with a mesh carrying an ``sp`` axis, each chunk's attention runs
+sequence-sharded via :func:`~quintnet_tpu.nn.attention.ring_paged_prefill`
+— K/V rotate around the ring (2·sp ppermutes per layer, census pinned
+in analysis/specs.expected_serve_sp_prefill) while every rank holds
+only 1/sp of the chunk's queries, so the chunk's score block never
+materializes on one chip and the practical chunk size scales with the
+device count. The pool stays replica-local (replicated over sp); one
+all_gather per layer reassembles the chunk's K/V for the scatter.
+``sp`` absent or of size 1 builds exactly the plain programs.
+
+This module holds the host-side planning pieces; the compiled-program
+builders live in serve/families.py (``prefill_from_sp``) and the step
+orchestration in serve/engine.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclass
+class ChunkState:
+    """Host-side progress of one slot's in-flight chunked prefill.
+
+    ``next`` is the first token position whose KV is NOT yet in the
+    pool (starts at the admission plan's ``cached_tokens``); ``t0`` is
+    the prefill target — ``prompt + generated`` length, after which the
+    final chunk samples the first new token. ``cow_src``/``cow_len``
+    carry the admission plan's copy-on-write instruction to the FIRST
+    chunk (the only one that can land inside a partially-shared block);
+    ``cow_pinned`` remembers that the COW source still holds the
+    admission-time pin so it is released exactly once — after the first
+    chunk runs, or when the slot is cleared before any chunk ran."""
+
+    next: int
+    t0: int
+    cow_src: Optional[int] = None
+    cow_len: int = 0
+    cow_pinned: bool = False
+    chunks_done: int = 0
+
+    @property
+    def remaining(self) -> int:
+        return self.t0 - self.next
+
+    @property
+    def done(self) -> bool:
+        return self.next >= self.t0
+
+
+def plan_chunks(tail_len: int, *, buckets: Sequence[int],
+                budget: int) -> List[Tuple[int, int]]:
+    """Split a ``tail_len``-token prefill into budget-sized chunks:
+    ``[(offset, chunk_len), ...]`` with every chunk at most
+    ``min(budget, buckets[-1])`` tokens (each runs in the smallest
+    bucket covering it). Pure planning helper — the engine feeds chunks
+    incrementally (budget is per STEP, and decode interleaves between
+    steps), but benches/tests use this to reason about how many steps a
+    given prompt needs."""
+    if tail_len < 0:
+        raise ValueError(f"tail_len must be >= 0; got {tail_len}")
+    if budget < 1:
+        raise ValueError(f"budget must be >= 1; got {budget}")
+    cap = min(int(budget), int(buckets[-1]))
+    out: List[Tuple[int, int]] = []
+    off = 0
+    while off < tail_len:
+        n = min(cap, tail_len - off)
+        out.append((off, n))
+        off += n
+    return out
+
+
+def validate_sp_buckets(buckets: Sequence[int], sp: int) -> None:
+    """Every prefill bucket must split evenly over the sp ranks — the
+    bucket IS the shard_map'd chunk width. Raises with the offending
+    bucket named (fix: pass ``prefill_bucket_sizes`` / ``prefill_len``
+    divisible by the sp degree)."""
+    bad = [b for b in buckets if b % sp]
+    if bad:
+        raise ValueError(
+            f"prefill bucket(s) {bad} not divisible by sp={sp}: the "
+            f"sequence-parallel prefill shards each bucket's ids over "
+            f"the sp axis — pass prefill_bucket_sizes (or a "
+            f"prefill_len) divisible by {sp}")

@@ -65,6 +65,14 @@ class ServeMetrics:
     spec_steps: int = 0
     draft_tokens: int = 0
     accepted_draft_tokens: int = 0
+    # chunked-prefill ledger (serve/longctx.py): prefill_chunks counts
+    # chunk program invocations; chunk_steps the engine steps that ran
+    # >= 1 chunk; chunk_tokens the prompt tokens those steps pushed
+    # through chunk programs — chunk_tokens / chunk_steps is the
+    # realized per-step prefill spend the Sarathi budget caps
+    prefill_chunks: int = 0
+    chunk_steps: int = 0
+    chunk_tokens: int = 0
     peak_kv_utilization: float = 0.0
     peak_running: int = 0
 
@@ -77,6 +85,11 @@ class ServeMetrics:
     # per-request marks ----------------------------------------------
     ttfts: List[float] = field(default_factory=list)
     latencies: List[float] = field(default_factory=list)
+    # inter-token gaps (seconds between a request's consecutive
+    # tokens, pooled across requests) — the decode-starvation signal:
+    # a monolithic prefill shows up as one giant gap in every
+    # concurrent stream, a budgeted chunked prefill does not
+    itls: List[float] = field(default_factory=list)
     _t0: Optional[float] = None
     _t_end: Optional[float] = None
 
@@ -87,7 +100,8 @@ class ServeMetrics:
                     prefix_hit_tokens: int = 0,
                     spec_step: bool = False,
                     draft_tokens: int = 0,
-                    accepted_draft_tokens: int = 0) -> None:
+                    accepted_draft_tokens: int = 0,
+                    prefill_chunks: int = 0) -> None:
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
@@ -106,6 +120,10 @@ class ServeMetrics:
             self.spec_steps += 1
         self.draft_tokens += draft_tokens
         self.accepted_draft_tokens += accepted_draft_tokens
+        self.prefill_chunks += prefill_chunks
+        if prefill_chunks > 0:
+            self.chunk_steps += 1
+            self.chunk_tokens += prefill_tokens
         util = kv_blocks_used / max(kv_blocks_total, 1)
         self.peak_kv_utilization = max(self.peak_kv_utilization, util)
         self.peak_running = max(self.peak_running, running)
@@ -135,6 +153,11 @@ class ServeMetrics:
         self.ttfts.append(ttft_s)
         if adapter_id is not None:
             self._adapter(adapter_id)["ttfts"].append(ttft_s)
+
+    def record_itl(self, gap_s: float) -> None:
+        """One inter-token gap (seconds since the same request's
+        previous token)."""
+        self.itls.append(gap_s)
 
     def record_finish(self, latency_s: float,
                       adapter_id: Optional[str] = None) -> None:
@@ -189,6 +212,14 @@ class ServeMetrics:
         return (self.accepted_draft_tokens / self.draft_tokens
                 if self.draft_tokens else 0.0)
 
+    @property
+    def chunk_tokens_per_step(self) -> float:
+        """Mean prompt tokens pushed through chunk programs per
+        chunk-running engine step — bounded above by the engine's
+        ``prefill_chunk_budget`` (the Sarathi cap made observable)."""
+        return (self.chunk_tokens / self.chunk_steps
+                if self.chunk_steps else 0.0)
+
     def summary(self) -> Dict:
         """One JSON-able dict: throughput, TTFT/latency percentiles,
         peak pool pressure. tok/s counts GENERATED (decode + prefill-
@@ -214,11 +245,16 @@ class ServeMetrics:
             "draft_tokens": self.draft_tokens,
             "accepted_draft_tokens": self.accepted_draft_tokens,
             "draft_acceptance_rate": round(self.draft_acceptance_rate, 4),
+            "prefill_chunks": self.prefill_chunks,
+            "chunk_steps": self.chunk_steps,
+            "chunk_tokens": self.chunk_tokens,
+            "chunk_tokens_per_step": round(self.chunk_tokens_per_step, 4),
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0
             else 0.0,
             "ttft_s": _pcts(self.ttfts),
             "latency_s": _pcts(self.latencies),
+            "itl_s": _pcts(self.itls),
             "peak_kv_utilization": round(self.peak_kv_utilization, 4),
             "peak_running": self.peak_running,
             "adapters": {
@@ -260,9 +296,11 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
     gen_tokens = sum(m.gen_tokens for m in all_metrics)
     ttfts: List[float] = []
     latencies: List[float] = []
+    itls: List[float] = []
     for m in all_metrics:
         ttfts.extend(m.ttfts)
         latencies.extend(m.latencies)
+        itls.extend(m.itls)
     # per-adapter ledgers merge the same way the totals do: counters
     # summed across replicas, TTFT sources pooled before percentiles
     adapters: Dict[str, Dict] = {}
@@ -302,10 +340,17 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "accepted_draft_tokens": accepted,
         "draft_acceptance_rate": round(accepted / drafted, 4) if drafted
         else 0.0,
+        "prefill_chunks": sum(m.prefill_chunks for m in all_metrics),
+        "chunk_steps": sum(m.chunk_steps for m in all_metrics),
+        "chunk_tokens": sum(m.chunk_tokens for m in all_metrics),
+        "chunk_tokens_per_step": round(
+            sum(m.chunk_tokens for m in all_metrics)
+            / max(sum(m.chunk_steps for m in all_metrics), 1), 4),
         "wall_s": round(wall, 4),
         "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
         "ttft_s": _pcts(ttfts),
         "latency_s": _pcts(latencies),
+        "itl_s": _pcts(itls),
         "peak_kv_utilization": round(
             max((m.peak_kv_utilization for m in all_metrics), default=0.0),
             4),

@@ -84,6 +84,15 @@ class RequestProgress:
     exact payload), so the restoring engine re-anchors the budget on
     its own clock.
 
+    ``prefilled`` is the chunked-prefill high-water mark (positions
+    whose KV had landed when the snapshot was taken — serve/longctx.py).
+    It is INFORMATIONAL: a restoring engine re-prefills ``prompt +
+    generated`` from its own pool/prefix-cache state regardless (the
+    exporter's KV does not travel), but operators and the fleet's
+    journal reconstruction get to see how far a migrated prefill had
+    gotten. Zero for requests that never started prefilling and for
+    engines without chunked prefill.
+
     ``rid`` is the EXPORTING engine's request id (engine-local; the
     restoring engine assigns its own)."""
 
@@ -96,6 +105,7 @@ class RequestProgress:
     preemptions: int = 0
     adapter_id: Optional[str] = None
     deadline_s: Optional[float] = None
+    prefilled: int = 0
 
 
 @dataclass
@@ -129,8 +139,12 @@ class Request:
     admit_seq: int = -1                     # last admission stamp
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None  # inter-token-latency mark
     finish_time: Optional[float] = None
     preemptions: int = 0
+    # chunked-prefill high-water mark (serve/longctx.py): positions of
+    # prompt + generated whose KV is in the pool; engine-maintained
+    prefilled: int = 0
     # terminal error (DeadlineExceeded): state goes FINISHED but
     # result() raises this instead of returning output_ids()
     error: Optional[BaseException] = None
@@ -169,7 +183,7 @@ class Request:
                       else np.array(self.key_data, copy=True)),
             max_new_tokens=self.max_new_tokens, priority=self.priority,
             preemptions=self.preemptions, adapter_id=self.adapter_id,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, prefilled=self.prefilled)
 
 
 class Scheduler:

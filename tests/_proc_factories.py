@@ -17,13 +17,21 @@ import jax
 def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                     block_size: int = 4, num_blocks: int = 24,
                     max_seq_len: int = 24, temperature: float = 0.0,
-                    top_k: int = 0, eos_token_id=None):
+                    top_k: int = 0, eos_token_id=None,
+                    n_positions=None, prefill_len=None,
+                    chunked_prefill: bool = False,
+                    prefill_chunk_budget=None):
     from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
     from quintnet_tpu.serve import ServeEngine, gpt2_family
 
-    cfg = GPT2Config.tiny(n_layer=n_layer)
+    cfg = GPT2Config.tiny(n_layer=n_layer,
+                          **({} if n_positions is None
+                             else {"n_positions": n_positions}))
     params = gpt2_init(jax.random.key(seed), cfg)
     return ServeEngine(gpt2_family(cfg), params, max_slots=max_slots,
                        block_size=block_size, num_blocks=num_blocks,
-                       max_seq_len=max_seq_len, temperature=temperature,
+                       max_seq_len=max_seq_len, prefill_len=prefill_len,
+                       chunked_prefill=chunked_prefill,
+                       prefill_chunk_budget=prefill_chunk_budget,
+                       temperature=temperature,
                        top_k=top_k, eos_token_id=eos_token_id)

@@ -1,0 +1,496 @@
+"""Long-context serving goldens (serve/longctx.py + chunked engine).
+
+THE contract, in two halves:
+
+- **chunked prefill** — a prompt of ANY length the pool can hold is
+  admitted whole and streamed through the existing bucket programs
+  under a per-step token budget; the output is BIT-identical to the
+  same tokens forced through a widened single-window engine (greedy
+  AND sampled), including prefix-cache-on, preempt-resume mid-prefill,
+  and fleet kill-migration mid-prefill — while concurrent decode slots
+  keep emitting a token EVERY step (the Sarathi no-starvation
+  property) and the compile count stays at the pinned bucket ladder;
+- **sequence-parallel prefill** — the same programs over an ``sp``
+  mesh run the chunk's attention ring-sharded
+  (nn/attention.ring_paged_prefill) and produce the same tokens as the
+  single-device engine (the collective census golden lives in
+  tests/test_qtcheck.py).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.serve import (ServeEngine, check_admissible, generate,
+                                gpt2_family, plan_chunks)
+
+CFG = GPT2Config.tiny(n_layer=2, n_positions=256)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def family():
+    return gpt2_family(CFG)
+
+
+def _engine(family, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 40)
+    kw.setdefault("max_seq_len", 200)
+    return ServeEngine(family, params, **kw)
+
+
+def _prompt(rng, n):
+    return np.asarray(rng.integers(0, CFG.vocab_size, (n,)), np.int32)
+
+
+# ---------------------------------------------------------------------
+# planning units
+# ---------------------------------------------------------------------
+
+class TestPlanChunks:
+    def test_budget_and_bucket_cap(self):
+        chunks = plan_chunks(100, buckets=(16, 32), budget=24)
+        assert chunks == [(0, 24), (24, 24), (48, 24), (72, 24),
+                          (96, 4)]
+        # budget above the top bucket: the bucket caps the chunk
+        assert plan_chunks(70, buckets=(16, 32), budget=999) == \
+            [(0, 32), (32, 32), (64, 6)]
+        assert plan_chunks(0, buckets=(16,), budget=4) == []
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="budget"):
+            plan_chunks(10, buckets=(16,), budget=0)
+
+
+# ---------------------------------------------------------------------
+# admissibility: the escape hatch
+# ---------------------------------------------------------------------
+
+class TestAdmissibility:
+    def test_overlength_rejection_names_chunked_prefill(self):
+        with pytest.raises(ValueError) as ei:
+            check_admissible(100, 8, max_seq_len=200, prefill_len=32,
+                             usable_blocks=64, block_size=8)
+        msg = str(ei.value)
+        assert "chunked_prefill=True" in msg
+        assert "docs/serving.md" in msg
+
+    def test_chunked_lifts_only_the_prefill_window(self):
+        # same request is admissible with the flag...
+        check_admissible(100, 8, max_seq_len=200, prefill_len=32,
+                         usable_blocks=64, block_size=8,
+                         chunked_prefill=True)
+        # ...but max_seq_len and pool capacity still bound it
+        with pytest.raises(ValueError, match="max_seq_len"):
+            check_admissible(300, 8, max_seq_len=200, prefill_len=32,
+                             usable_blocks=64, block_size=8,
+                             chunked_prefill=True)
+        with pytest.raises(ValueError, match="KV pool too small"):
+            check_admissible(100, 8, max_seq_len=200, prefill_len=32,
+                             usable_blocks=4, block_size=8,
+                             chunked_prefill=True)
+
+    def test_engine_limits_carry_the_flag(self, family, params):
+        eng = _engine(family, params, chunked_prefill=True,
+                      prefill_len=32)
+        assert eng.limits()["chunked_prefill"] is True
+        # the limits dict splats straight into check_admissible — the
+        # process fleet's parent-side validation admits long prompts
+        # against a chunked replica's hello
+        check_admissible(150, 8, **eng.limits())
+
+    def test_frontdoor_maps_overlength_to_400_naming_the_hatch(self):
+        from quintnet_tpu.fleet.frontdoor import FrontDoor
+
+        try:
+            check_admissible(100, 8, max_seq_len=200, prefill_len=32,
+                             usable_blocks=64, block_size=8)
+        except ValueError as e:
+            status, body, _ = FrontDoor._error_response(
+                object.__new__(FrontDoor), e)
+        assert status == 400
+        assert body["error"] == "bad_request"
+        assert "chunked_prefill=True" in body["message"]
+
+
+# ---------------------------------------------------------------------
+# the golden contract: chunked == single-shot, bit for bit
+# ---------------------------------------------------------------------
+
+class TestChunkedParity:
+    @pytest.mark.parametrize("sampling", ["greedy", "sampled"])
+    def test_short_prompt_forced_into_chunks(self, family, params, rng,
+                                             sampling):
+        """Provable even on prompts that fit one bucket: a budget
+        smaller than the prompt forces multiple chunks through the
+        same programs — output must not move by a bit."""
+        kw = (dict(temperature=0.8, top_k=5) if sampling == "sampled"
+              else {})
+        prompt = _prompt(rng, 40)
+        key = jax.random.key(11)
+        plain = _engine(family, params, **kw)
+        want = generate(plain, [prompt], max_new_tokens=6, keys=[key])[0]
+        chunked = _engine(family, params, chunked_prefill=True,
+                          prefill_chunk_budget=12, **kw)
+        got = generate(chunked, [prompt], max_new_tokens=6, keys=[key],
+                       max_steps=100)[0]
+        np.testing.assert_array_equal(want, got)
+        assert chunked.metrics.prefill_chunks >= 4  # really chunked
+
+    @pytest.mark.parametrize("sampling", ["greedy", "sampled"])
+    def test_long_prompt_vs_widened_single_bucket_engine(
+            self, family, params, rng, sampling):
+        """THE acceptance golden: a prompt LONGER than the chunked
+        engine's top prefill bucket is served end to end, bit-identical
+        to the same tokens forced through an engine whose single
+        prefill window was widened to fit them."""
+        kw = (dict(temperature=0.8, top_k=5) if sampling == "sampled"
+              else {})
+        prompt = _prompt(rng, 150)
+        key = jax.random.key(7)
+        wide = _engine(family, params, prefill_len=200, **kw)
+        want = generate(wide, [prompt], max_new_tokens=8, keys=[key])[0]
+        chunked = _engine(family, params, prefill_len=32,
+                          chunked_prefill=True, prefill_chunk_budget=32,
+                          **kw)
+        assert len(prompt) > chunked.prefill_buckets[-1]
+        got = generate(chunked, [prompt], max_new_tokens=8, keys=[key],
+                       max_steps=100)[0]
+        np.testing.assert_array_equal(want, got)
+        # no per-length programs: the pinned bucket ladder bounds it
+        assert (chunked.compile_stats()["prefill"]
+                <= len(chunked.prefill_buckets))
+        chunked.assert_compile_count(prefill=1)
+
+    def test_prefix_cache_composes_with_chunks(self, family, params,
+                                               rng):
+        """Two requests sharing a long prompt: the second's chunks are
+        served from the published chain of the first (prefill work
+        collapses), output identical either way."""
+        prompt = _prompt(rng, 120)
+        key1, key2 = jax.random.key(21), jax.random.key(22)
+        wide = _engine(family, params, prefill_len=200)
+        want1 = generate(wide, [prompt], max_new_tokens=4,
+                         keys=[key1])[0]
+        wide2 = _engine(family, params, prefill_len=200)
+        want2 = generate(wide2, [prompt], max_new_tokens=4,
+                         keys=[key2])[0]
+
+        eng = _engine(family, params, prefill_len=32,
+                      chunked_prefill=True, prefill_chunk_budget=32)
+        got1 = generate(eng, [prompt], max_new_tokens=4, keys=[key1],
+                        max_steps=100)[0]
+        before = eng.metrics.prefill_tokens
+        got2 = generate(eng, [prompt], max_new_tokens=4, keys=[key2],
+                        max_steps=100)[0]
+        after = eng.metrics.prefill_tokens
+        np.testing.assert_array_equal(want1, got1)
+        np.testing.assert_array_equal(want2, got2)
+        assert eng.metrics.prefix_hit_tokens > 100  # chunks reused
+        # the second request's prefill barely computed anything
+        assert after - before < len(prompt) // 2
+
+    def test_cache_on_equals_cache_off(self, family, params, rng):
+        prompt = _prompt(rng, 100)
+        key = jax.random.key(33)
+        outs = []
+        for pc in (True, False):
+            eng = _engine(family, params, prefill_len=32,
+                          chunked_prefill=True, prefix_cache=pc,
+                          temperature=0.8, top_k=5)
+            outs.append(generate(eng, [prompt], max_new_tokens=6,
+                                 keys=[key], max_steps=100)[0])
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+
+# ---------------------------------------------------------------------
+# the Sarathi property: decode never starves behind a long prefill
+# ---------------------------------------------------------------------
+
+class TestDecodeStarvation:
+    def test_concurrent_decodes_emit_every_step(self, family, params,
+                                                rng):
+        """With a 150-token prefill in flight under a 16-token budget,
+        a generating request commits >= 1 token on EVERY engine step —
+        the monolithic engine's whole-prompt stall cannot happen by
+        construction — and the chunk ledger lands in ServeMetrics."""
+        eng = _engine(family, params, max_slots=3, prefill_len=32,
+                      chunked_prefill=True, prefill_chunk_budget=16)
+        short = _prompt(rng, 6)
+        longp = _prompt(rng, 150)
+        r1 = eng.submit(short, 40)
+        eng.step()  # short admitted + first token
+        r2 = eng.submit(longp, 4)
+        per_step = []
+        while eng.request(r2).state != "finished":
+            d0 = eng.metrics.decode_tokens
+            eng.step()
+            per_step.append(eng.metrics.decode_tokens - d0)
+            assert len(per_step) < 200
+        # every step with the long prefill in flight still decoded
+        assert min(per_step) >= 1
+        m = eng.metrics
+        assert m.prefill_chunks >= 150 // 16
+        assert 0 < m.chunk_tokens_per_step <= 16
+        s = m.summary()
+        for k in ("prefill_chunks", "chunk_steps", "chunk_tokens",
+                  "chunk_tokens_per_step", "itl_s"):
+            assert k in s, k
+        assert s["itl_s"]["p95"] >= 0.0
+        assert s["chunk_tokens_per_step"] <= 16
+
+    def test_budget_caps_chunk_tokens_per_step(self, family, params,
+                                               rng):
+        eng = _engine(family, params, prefill_len=32,
+                      chunked_prefill=True, prefill_chunk_budget=8)
+        eng.submit(_prompt(rng, 90), 2)
+        while eng.has_work:
+            before = eng.metrics.chunk_tokens
+            eng.step()
+            assert eng.metrics.chunk_tokens - before <= 8
+            assert eng.metrics.steps < 200
+
+
+# ---------------------------------------------------------------------
+# preemption / migration mid-prefill
+# ---------------------------------------------------------------------
+
+class TestMidPrefillLifecycle:
+    def test_preempt_mid_prefill_resumes_bit_identically(
+            self, family, params, rng):
+        """A pool sized so the older request's decode growth preempts
+        the long request MID-PREFILL: its completed chunks are
+        published (the resume re-prefills almost nothing) and both
+        outputs match undisturbed single-shot references exactly."""
+        p_old, p_long = _prompt(rng, 10), _prompt(rng, 80)
+        eng = _engine(family, params, block_size=8, num_blocks=14,
+                      max_seq_len=96, prefill_len=32,
+                      chunked_prefill=True, prefill_chunk_budget=4)
+        ra = eng.submit(p_old, 60)
+        rb = eng.submit(p_long, 4)
+        saw_mid_prefill_preempt = False
+        steps = 0
+        while eng.has_work and steps < 500:
+            pre = eng.metrics.preempted
+            mid = any(st is not None for st in eng._slot_chunk)
+            eng.step()
+            if eng.metrics.preempted > pre and mid:
+                saw_mid_prefill_preempt = True
+            steps += 1
+        assert not eng.has_work
+        assert saw_mid_prefill_preempt  # the scenario actually ran
+        ka = jax.random.fold_in(jax.random.key(0), ra)
+        kb = jax.random.fold_in(jax.random.key(0), rb)
+        wide = _engine(family, params, num_blocks=40, max_seq_len=96,
+                       prefill_len=96)
+        np.testing.assert_array_equal(
+            eng.result(ra),
+            generate(wide, [p_old], max_new_tokens=60, keys=[ka])[0])
+        wide2 = _engine(family, params, num_blocks=40, max_seq_len=96,
+                        prefill_len=96)
+        np.testing.assert_array_equal(
+            eng.result(rb),
+            generate(wide2, [p_long], max_new_tokens=4, keys=[kb])[0])
+        assert eng.metrics.prefix_hit_tokens > 0  # published chunks hit
+
+    def test_export_mid_prefill_carries_prefilled_and_restores(
+            self, family, params, rng):
+        """Kill-migration surface: a request exported MID-PREFILL has
+        generated=[] and the submit key (sampling happens once, on the
+        final chunk), carries its chunk high-water mark, survives the
+        wire, and the restoring engine re-chunks to a token-identical
+        stream."""
+        from quintnet_tpu.fleet.wire import (progress_from_wire,
+                                             progress_to_wire)
+
+        prompt = _prompt(rng, 80)
+        src = _engine(family, params, prefill_len=32,
+                      chunked_prefill=True, prefill_chunk_budget=8,
+                      temperature=0.8, top_k=5)
+        rid = src.submit(prompt, 4, key=jax.random.key(9))
+        src.step()
+        src.step()
+        progs = src.export_progress()
+        assert len(progs) == 1
+        p = progs[0]
+        assert p.generated == [] and 0 < p.prefilled < len(prompt)
+        p2 = progress_from_wire(progress_to_wire(p))
+        assert p2.prefilled == p.prefilled
+        np.testing.assert_array_equal(p2.key_data, p.key_data)
+
+        dst = _engine(family, params, prefill_len=32,
+                      chunked_prefill=True, prefill_chunk_budget=8,
+                      temperature=0.8, top_k=5)
+        rid2 = dst.restore_progress(p2)
+        dst.run(max_steps=100)
+        wide = _engine(family, params, prefill_len=200,
+                       temperature=0.8, top_k=5)
+        want = generate(wide, [prompt], max_new_tokens=4,
+                        keys=[jax.random.key(9)])[0]
+        np.testing.assert_array_equal(dst.result(rid2), want)
+
+    def test_fleet_kill_migration_mid_prefill(self, params, rng):
+        """A replica killed while a long prompt is MID-PREFILL: the
+        fleet resumes it elsewhere and the stream is token-identical
+        to an undisturbed engine (sampled params — the strictest
+        form)."""
+        from quintnet_tpu.fleet import ServeFleet
+        from quintnet_tpu.ft import ChaosMonkey
+
+        fam = gpt2_family(CFG)
+
+        def factory():
+            return ServeEngine(fam, params, max_slots=2, block_size=8,
+                               num_blocks=40, max_seq_len=200,
+                               prefill_len=32, chunked_prefill=True,
+                               prefill_chunk_budget=8,
+                               temperature=0.8, top_k=5)
+
+        longp = _prompt(rng, 100)
+        shorts = [_prompt(rng, n) for n in (5, 7)]
+        keys = [jax.random.key(800 + i) for i in range(3)]
+        # 100 tokens at 8/step needs ~13 chunk steps: a kill at step 4
+        # lands mid-prefill with certainty
+        monkey = ChaosMonkey(kill_at_step=4, mode="raise", target="r0")
+        fleet = ServeFleet(factory, n_replicas=2, policy="round_robin",
+                           chaos=monkey)
+        try:
+            fids = [fleet.submit(longp, 6, key=keys[0])]
+            fids += [fleet.submit(p, 6, key=k)
+                     for p, k in zip(shorts, keys[1:])]
+            outs = [fleet.result(f, timeout=300) for f in fids]
+            assert fleet.metrics.replica_deaths == 1
+            assert fleet.metrics.migrations >= 1
+            for p, k, o in zip([longp] + shorts, keys, outs):
+                wide = _engine(gpt2_family(CFG), params,
+                               prefill_len=200, temperature=0.8,
+                               top_k=5)
+                np.testing.assert_array_equal(
+                    o, generate(wide, [p], max_new_tokens=6,
+                                keys=[k])[0])
+        finally:
+            fleet.drain(timeout=120)
+
+
+# ---------------------------------------------------------------------
+# compile bound over a chunked trace
+# ---------------------------------------------------------------------
+
+class TestCompileBound:
+    def test_zero_backend_compiles_after_warmup(self, family, params,
+                                                rng):
+        """Mixed chunked traffic — long + short prompts, retires,
+        prefix hits — runs ZERO XLA compiles after warmup: prompt
+        length stopped being a compile-ladder input."""
+        eng = _engine(family, params, prefill_len=32,
+                      chunked_prefill=True, prefill_chunk_budget=16)
+        eng.warmup()
+        compiles = []
+        jax.monitoring.register_event_listener(
+            lambda ev, **kw: compiles.append(ev)
+            if ev == "/jax/backend_compile" else None)
+        base = len(compiles)
+        for n, mn in ((150, 4), (9, 3), (120, 2), (40, 5)):
+            eng.submit(_prompt(rng, n), mn)
+        eng.run(max_steps=300)
+        assert not eng.has_work
+        assert len(compiles) == base, "recompiled after warmup"
+        assert (eng.compile_stats()["prefill"]
+                <= len(eng.prefill_buckets))
+
+
+# ---------------------------------------------------------------------
+# sequence-parallel prefill (ring attention over the sp axis)
+# ---------------------------------------------------------------------
+
+class TestSpPrefill:
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_sp_engine_matches_single_device_tokens(self, family,
+                                                    params, rng, sp):
+        """The sp engine's generated tokens equal the single-device
+        engine's — ring attention is exact (online softmax), and
+        decode runs replicated so the whole stream matches."""
+        prompt = _prompt(rng, 40)
+        key = jax.random.key(5)
+        plain = _engine(family, params)
+        want = generate(plain, [prompt], max_new_tokens=6, keys=[key])[0]
+        mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+        eng = _engine(family, params, mesh=mesh, sp_axis="sp")
+        got = generate(eng, [prompt], max_new_tokens=6, keys=[key])[0]
+        np.testing.assert_array_equal(want, got)
+
+    def test_sp_composes_with_chunked_prefill(self, family, params,
+                                              rng):
+        """Long prompt, chunked, each chunk ring-sharded over sp=2:
+        still token-identical to the widened single-device engine."""
+        prompt = _prompt(rng, 150)
+        key = jax.random.key(6)
+        wide = _engine(family, params, prefill_len=200)
+        want = generate(wide, [prompt], max_new_tokens=6, keys=[key])[0]
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        eng = _engine(family, params, mesh=mesh, sp_axis="sp",
+                      prefill_len=32, chunked_prefill=True,
+                      prefill_chunk_budget=32)
+        got = generate(eng, [prompt], max_new_tokens=6, keys=[key],
+                       max_steps=100)[0]
+        np.testing.assert_array_equal(want, got)
+        assert eng.metrics.prefill_chunks >= 4
+
+    def test_sp_llama_matches_single_device(self, rng):
+        from quintnet_tpu.models.llama import LlamaConfig, llama_init
+        from quintnet_tpu.serve import llama_family
+
+        lcfg = LlamaConfig.tiny(n_layers=2, n_positions=256)
+        lp = llama_init(jax.random.key(1), lcfg)
+        fam = llama_family(lcfg)
+        prompt = np.asarray(rng.integers(0, lcfg.vocab_size, (40,)),
+                            np.int32)
+        key = jax.random.key(4)
+        plain = ServeEngine(fam, lp, max_slots=2, block_size=8,
+                            num_blocks=40, max_seq_len=200)
+        want = generate(plain, [prompt], max_new_tokens=6, keys=[key])[0]
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        eng = ServeEngine(fam, lp, max_slots=2, block_size=8,
+                          num_blocks=40, max_seq_len=200, mesh=mesh,
+                          sp_axis="sp")
+        got = generate(eng, [prompt], max_new_tokens=6, keys=[key])[0]
+        np.testing.assert_array_equal(want, got)
+
+    def test_sp_one_builds_the_plain_programs(self, family, params):
+        """engine(sp=1) must be byte-identical to today's programs —
+        the sp path is not even built."""
+        mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+        eng = _engine(family, params, mesh=mesh, sp_axis="sp")
+        assert eng.sp_axis is None
+
+    def test_indivisible_buckets_rejected_with_fix(self, family,
+                                                   params):
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        with pytest.raises(ValueError, match="divisible by sp=4"):
+            _engine(family, params, mesh=mesh, sp_axis="sp",
+                    prefill_bucket_sizes=(16, 18), prefill_len=18,
+                    max_seq_len=24)
+
+    def test_misconfigured_sp_axis_raises(self, family, params):
+        """An sp_axis the mesh does not carry is a misconfiguration —
+        silently running replicated would burn N devices for nothing
+        (size 1 falling back to the plain programs is the documented
+        degenerate case; a MISSING axis is not)."""
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        with pytest.raises(ValueError, match="not an axis of the mesh"):
+            _engine(family, params, mesh=mesh, sp_axis="spp")
+        with pytest.raises(ValueError, match="not an axis of the mesh"):
+            _engine(family, params, sp_axis="sp")  # no mesh at all
+
+    def test_zero_chunk_budget_rejected(self, family, params):
+        with pytest.raises(ValueError, match="prefill_chunk_budget"):
+            _engine(family, params, chunked_prefill=True,
+                    prefill_chunk_budget=0)

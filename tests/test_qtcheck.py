@@ -392,6 +392,38 @@ class TestServe:
             assert rep.undonated_aliasable == [], rep.summary()
             assert rep.donated_bytes > 0
 
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_sp_prefill_census_ppermutes_are_f_of_sp(self, gpt2, sp):
+        """The ring sp-prefill programs (long-context serving,
+        serve/longctx.py): per layer, the stacked chunk K/V pair and
+        its position vector rotate sp scan steps (2*sp ppermutes) plus
+        one all_gather reassembling the chunk for the pool scatter,
+        plus ONE program-wide psum extracting the last position's
+        hidden row — analysis/specs.expected_serve_sp_prefill, a pure
+        function of (n_layers, sp), identical for EVERY bucket width
+        (sp shards the bucket, it never changes the wire). An extra
+        collective from a refactor fails here with a named diff. The
+        decode program on the same mesh stays collective-FREE (it runs
+        replicated)."""
+        from quintnet_tpu.serve import ServeEngine, gpt2_family
+
+        cfg, params = gpt2
+        mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+        eng = ServeEngine(gpt2_family(cfg), params, mesh=mesh,
+                          sp_axis="sp", max_slots=3, block_size=4,
+                          num_blocks=24, max_seq_len=32)
+        assert eng.sp_axis == "sp"
+        spec = census_specs.expected_serve_sp_prefill(cfg.n_layer, sp)
+        for b in eng.prefill_buckets:
+            census = collective_census(
+                eng._prefills[b].fn, *self._prefill_args(eng, params, b))
+            assert census.diff(spec) == [], census.as_dict()
+            assert census.total() == 2 * sp * cfg.n_layer \
+                + cfg.n_layer + 1
+        dec = collective_census(eng._decode.fn,
+                                *self._decode_args(eng, params))
+        assert dec.total() == 0
+
 
 # ---------------------------------------------------------------------
 # recompile sentinel unit behaviour

@@ -29,6 +29,17 @@ Exp(rate)). Two trace shapes:
   rate and ``tokens_per_decode_step`` — the committed-tokens-per-
   program-invocation number that makes the speculation win legible
   without reading raw metrics;
+- ``--long-trace``: the short Poisson mix PLUS ``--long-prompts``
+  document-length prompts (``--long-prompt`` tokens — longer than the
+  chunked engine's whole prefill window) arriving mid-decode. Replays
+  the SAME trace through a chunked-prefill engine
+  (``chunked_prefill=True``, per-step ``--chunk-budget``) and the
+  stall-prone monolithic baseline (prefill window widened to swallow
+  the prompt in one program call). The headline comparison is decode
+  tok/s DURING the long-prefill window — how fast everyone else's
+  streams move while a document is read in — plus inter-token-latency
+  tails (a monolithic prefill appears as one giant gap in every
+  concurrent stream);
 - ``--lora-trace``: N tenants spread round-robin over ``--adapters``
   LoRA adapters (trained variants of one base model, saved through
   the real safetensors path) — the multi-tenant scenario
@@ -51,6 +62,7 @@ Modes:
   python tools/serve_bench.py --synthetic --prefix-share
   python tools/serve_bench.py --synthetic --prefix-cache off   # A/B
   python tools/serve_bench.py --synthetic --spec-trace         # A/B
+  python tools/serve_bench.py --synthetic --long-trace         # A/B
   python tools/serve_bench.py --synthetic --spec on    # default trace
   python tools/serve_bench.py --model gpt2             # 124M random init
   python tools/serve_bench.py --synthetic --steps 3    # smoke (CI runs
@@ -116,16 +128,21 @@ def build_model(args, params=None):
 
 
 def build_engine(args, *, prefix_cache: bool, spec: bool = False,
-                 params=None, adapters=None):
+                 params=None, adapters=None, max_seq=None,
+                 prefill_len=None, chunked_prefill: bool = False,
+                 prefill_chunk_budget=None):
     from quintnet_tpu.serve import ServeEngine, SpecConfig
 
     family, params = build_model(args, params=params)
     max_prompt = (args.shared_prefix + args.max_tail if args.prefix_share
                   else args.max_prompt)
-    max_seq = min(max_prompt + args.max_new, family.max_positions)
+    if max_seq is None:
+        max_seq = min(max_prompt + args.max_new, family.max_positions)
     return ServeEngine(
         family, params, max_slots=args.slots, block_size=args.block_size,
         num_blocks=args.num_blocks, max_seq_len=max_seq,
+        prefill_len=prefill_len, chunked_prefill=chunked_prefill,
+        prefill_chunk_budget=prefill_chunk_budget,
         eos_token_id=args.eos, temperature=args.temperature,
         policy=args.policy, prefix_cache=prefix_cache,
         spec=SpecConfig(max_draft=args.max_draft) if spec else None,
@@ -193,6 +210,87 @@ def prefix_share_trace(args, vocab_size: int):
         tail = rng.integers(0, vocab_size, (n,)).astype(np.int32)
         trace.append((t, np.concatenate([shared, tail]), args.max_new))
     return trace
+
+
+def long_trace(args, vocab_size: int):
+    """The decode-starvation workload: the default short Poisson mix
+    PLUS ``--long-prompts`` document-length prompts arriving while the
+    shorts are mid-decode. Entries are (t, prompt, max_new, is_long) —
+    the replayer uses the flag to carve out the window during which a
+    long prompt is being prefilled (that window is where a monolithic
+    prefill stalls every concurrent stream and a chunked one does
+    not)."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    trace = [(t, p, m, False)
+             for (t, p, m) in poisson_trace(args, vocab_size)]
+    for i in range(args.long_prompts):
+        t = 2 + i * args.long_spacing
+        p = rng.integers(0, vocab_size,
+                         (args.long_prompt,)).astype(np.int32)
+        trace.append((t, p, args.max_new, True))
+    return sorted(trace, key=lambda e: e[0])
+
+
+def replay_long(engine, trace, args) -> dict:
+    """Like :func:`replay`, but per-step instrumented: wall time and
+    decode tokens are additionally accumulated over the steps during
+    which some long prompt is submitted but has not yet produced its
+    first token — the long-prefill window. ``decode tokens / window
+    wall`` is the number the chunked-vs-monolithic A/B is about:
+    how fast everyone ELSE's streams move while a document is being
+    read in. Each step blocks on the pool before reading the clock so
+    the per-step wall measures device work, not dispatch."""
+    import time
+
+    import jax
+
+    engine.warmup()
+    engine.metrics = type(engine.metrics)(clock=engine.clock)
+
+    submitted = 0
+    step = 0
+    long_rids = []
+    win_wall = 0.0
+    win_decode = 0
+    t0 = time.perf_counter()
+    while submitted < len(trace) or engine.has_work:
+        if args.steps is not None and step >= args.steps:
+            break
+        while submitted < len(trace) and trace[submitted][0] <= step:
+            _, prompt, max_new, is_long = trace[submitted]
+            rid = engine.submit(prompt, max_new)
+            if is_long:
+                long_rids.append(rid)
+            submitted += 1
+        in_window = any(
+            engine.request(r).first_token_time is None
+            for r in long_rids)
+        d0 = engine.metrics.decode_tokens
+        s0 = time.perf_counter()
+        engine.step()
+        jax.block_until_ready(engine.pool.caches())
+        dt = time.perf_counter() - s0
+        if in_window:
+            win_wall += dt
+            win_decode += engine.metrics.decode_tokens - d0
+        step += 1
+    # every step blocked on the pool above; drain once more so the
+    # whole-replay wall measures device work, not dispatch (QT106)
+    jax.block_until_ready(engine.pool.caches())
+    wall = time.perf_counter() - t0
+
+    s = engine.metrics.summary()
+    s["wall_s"] = round(wall, 4)
+    s["tokens_per_sec"] = (round(s["gen_tokens"] / wall, 2) if wall > 0
+                           else 0.0)
+    s["submitted"] = submitted
+    s["long_window_wall_s"] = round(win_wall, 4)
+    s["long_window_decode_tokens"] = win_decode
+    s["decode_tps_during_long_prefill"] = (
+        round(win_decode / win_wall, 2) if win_wall > 0 else 0.0)
+    return s
 
 
 def lora_trace(args, vocab_size: int):
@@ -376,6 +474,72 @@ def run(args) -> dict:
             "extras": extras,
         }
 
+    if args.long_trace:
+        # A/B over the SAME long-document + short-decode-mix trace:
+        # chunked prefill (budgeted, Sarathi) vs the stall-prone
+        # monolithic baseline (prefill window widened to swallow the
+        # whole prompt in one program call). The headline number is
+        # decode tok/s DURING the long-prefill window — how fast
+        # everyone else's streams move while a document is read in.
+        max_seq = args.long_prompt + args.max_new
+        budget = args.chunk_budget or args.prefill_window
+        eng_ch = build_engine(args, prefix_cache=args.prefix_cache == "on",
+                              max_seq=max_seq,
+                              prefill_len=args.prefill_window,
+                              chunked_prefill=True,
+                              prefill_chunk_budget=budget)
+        trace = long_trace(args, eng_ch.family.cfg.vocab_size)
+        s_ch = replay_long(eng_ch, trace, args)
+        eng_mono = build_engine(args,
+                                prefix_cache=args.prefix_cache == "on",
+                                max_seq=max_seq, prefill_len=max_seq)
+        s_mono = replay_long(eng_mono, trace, args)
+        extras = _common_extras(args, s_ch)
+        ratio = (round(s_ch["decode_tps_during_long_prefill"]
+                       / s_mono["decode_tps_during_long_prefill"], 3)
+                 if s_mono["decode_tps_during_long_prefill"] else 0.0)
+        extras.update({
+            "long_trace": True,
+            "long_prompts": args.long_prompts,
+            "long_prompt": args.long_prompt,
+            "prefill_window": args.prefill_window,
+            "chunk_budget": budget,
+            "prefill_chunks": s_ch["prefill_chunks"],
+            "chunk_steps": s_ch["chunk_steps"],
+            "chunk_tokens_per_step": s_ch["chunk_tokens_per_step"],
+            "itl_p95_s": s_ch["itl_s"]["p95"],
+            "itl_p99_s": s_ch["itl_s"]["p99"],
+            "long_window_wall_s": s_ch["long_window_wall_s"],
+            "long_window_decode_tokens":
+                s_ch["long_window_decode_tokens"],
+            "decode_tps_during_long_prefill":
+                s_ch["decode_tps_during_long_prefill"],
+            "unchunked_tokens_per_sec": s_mono["tokens_per_sec"],
+            "unchunked_itl_p95_s": s_mono["itl_s"]["p95"],
+            "unchunked_itl_p99_s": s_mono["itl_s"]["p99"],
+            "unchunked_long_window_wall_s":
+                s_mono["long_window_wall_s"],
+            "unchunked_long_window_decode_tokens":
+                s_mono["long_window_decode_tokens"],
+            "unchunked_decode_tps_during_long_prefill":
+                s_mono["decode_tps_during_long_prefill"],
+            "unchunked_finished": s_mono["finished"],
+            # THE acceptance signal: concurrent decode throughput
+            # while a long prompt prefills, chunked / monolithic
+            "decode_tps_ratio_vs_unchunked": ratio,
+            "itl_p99_ratio_vs_unchunked": (
+                round(s_mono["itl_s"]["p99"] / s_ch["itl_s"]["p99"], 3)
+                if s_ch["itl_s"]["p99"] else 0.0),
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_long_tokens_per_sec",
+            "value": s_ch["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": ratio,
+            "rc": 0,
+            "extras": extras,
+        }
+
     if args.lora_trace:
         import tempfile
 
@@ -499,6 +663,22 @@ def main():
                          "spec-on vs spec-off over the same trace")
     ap.add_argument("--pattern", type=int, default=8,
                     help="repeated-pattern length (--spec-trace prompts)")
+    ap.add_argument("--long-trace", action="store_true",
+                    help="long-document + short-decode-mix trace, "
+                         "reported chunked-prefill vs monolithic "
+                         "(widened single-bucket) over the same trace")
+    ap.add_argument("--long-prompts", type=int, default=2,
+                    help="long prompts in the --long-trace")
+    ap.add_argument("--long-prompt", type=int, default=192,
+                    help="long-prompt length (--long-trace); must "
+                         "exceed --prefill-window to exercise chunking")
+    ap.add_argument("--long-spacing", type=int, default=24,
+                    help="engine steps between long arrivals")
+    ap.add_argument("--prefill-window", type=int, default=64,
+                    help="chunked engine's prefill_len (top bucket)")
+    ap.add_argument("--chunk-budget", type=int, default=None,
+                    help="prefill tokens per engine step (default: "
+                         "--prefill-window)")
     ap.add_argument("--lora-trace", action="store_true",
                     help="multi-tenant LoRA trace: requests spread over "
                          "--adapters adapters through ONE multi-LoRA "
@@ -536,6 +716,10 @@ def main():
     args = ap.parse_args()
     if args.shared_prefix is None:
         args.shared_prefix = 36 if args.synthetic else 96
+    if args.long_trace and args.synthetic and args.n_positions is None:
+        # the tiny config's default positions cannot hold a document;
+        # size it to the trace instead of failing admission
+        args.n_positions = args.long_prompt + args.max_new + 16
 
     out = run(args)
     line = json.dumps(out)
