@@ -281,6 +281,22 @@ def expected_serve_sp_prefill(n_layers: int, sp: int, *,
                       "all_reduce": 1}}
 
 
+def kv_layout_policies() -> Tuple[str, ...]:
+    """THE canonical KV-pool layout-policy ladder (serve/kv_quant.py):
+    ``f32``/``bf16`` passthrough, ``int8`` with per-block-per-head
+    absmax scales, and the ``fake_quant`` identity-scale proof policy.
+    Pinned here for the same reason the bucket ladders are: the policy
+    must NOT change the compiled-program census. Per policy the engine
+    compiles exactly the same sentinel set — ``len(prefill_buckets)``
+    prefill programs, 1 decode (or one per LoRA rank bucket), and
+    ``len(verify_buckets)`` verify programs — because a scaled policy
+    only widens the pool operand list (k, v -> k, v, k_scale, v_scale)
+    inside the SAME programs; it never adds a program, a collective,
+    or a recompile (tests/test_kv_quant.py pins the compile counts,
+    tests/test_qtcheck.py the collective + dtype censuses)."""
+    return ("f32", "bf16", "int8", "fake_quant")
+
+
 def lora_rank_buckets(max_rank: int, *, floor: int = 4) -> Tuple[int, ...]:
     """THE canonical adapter-rank ladder for multi-tenant LoRA serving
     (serve/adapters.py): powers of two from ``floor`` up to (and capped

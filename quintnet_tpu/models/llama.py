@@ -440,7 +440,8 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
                               tp_axis: Optional[str] = None,
                               block_tables=None,
                               block_size: Optional[int] = None,
-                              lora=None, lora_scale=None):
+                              lora=None, lora_scale=None,
+                              kv_scales=None, policy=None):
     """Chunked prefill over the paged pool (the serve engine's
     prefix-cached path): x [1, P, D] tail hidden states at absolute
     ``positions`` [P], caches are flat pool views
@@ -451,19 +452,45 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
     :func:`llama_block_decode`'s paged math, batched over the tail).
     ``cos``/``sin`` [P, hd] must be built from the SAME absolute
     positions. ``lora``/``lora_scale``: this layer's packed per-slot
-    adapters (serving multi-LoRA). Returns (x, (kc, vc))."""
-    from quintnet_tpu.nn.attention import paged_gather, paged_prefill_update
+    adapters (serving multi-LoRA). ``kv_scales``/``policy``: scaled KV
+    layout (serve/kv_quant.py) — dequantized gathered view, quantize on
+    scatter. Returns (x, (kc, vc[, k_scale, v_scale]))."""
+    from quintnet_tpu.nn.attention import (_quant_span, paged_gather,
+                                           paged_gather_dequant,
+                                           paged_prefill_update,
+                                           paged_quant_update)
 
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     attn_lora = lora.get("attn") if lora is not None else None
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
                         lora=attn_lora, lora_scale=lora_scale)
-    kc, vc = paged_prefill_update(kc, vc, k[0], v[0], positions, tail_len,
-                                  block_tables=block_tables,
+    if kv_scales is None:
+        kc, vc = paged_prefill_update(kc, vc, k[0], v[0], positions,
+                                      tail_len,
+                                      block_tables=block_tables,
+                                      block_size=block_size)
+        kg = paged_gather(kc, block_tables[None], block_size=block_size)
+        vg = paged_gather(vc, block_tables[None], block_size=block_size)
+        pools = (kc, vc)
+    else:
+        ks, vs = kv_scales
+        tables = block_tables[None]
+        kg = paged_gather_dequant(policy, kc, ks, tables,
                                   block_size=block_size)
-    kg = paged_gather(kc, block_tables[None], block_size=block_size)
-    vg = paged_gather(vc, block_tables[None], block_size=block_size)
+        vg = paged_gather_dequant(policy, vc, vs, tables,
+                                  block_size=block_size)
+        span = _quant_span(positions.shape[0], block_size,
+                           block_tables.shape[0])
+        pos2 = positions[None, :]
+        lens = jnp.reshape(tail_len, (1,))
+        kc, ks, kg = paged_quant_update(
+            policy, kc, ks, kg, k, pos2, lens, block_tables=tables,
+            block_size=block_size, max_blocks=span)
+        vc, vs, vg = paged_quant_update(
+            policy, vc, vs, vg, v, pos2, lens, block_tables=tables,
+            block_size=block_size, max_blocks=span)
+        pools = (kc, vc, ks, vs)
     rep = q.shape[1] // kg.shape[1]
     kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
     valid = (jnp.arange(kf.shape[2])[None, :]
@@ -479,7 +506,7 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
         p, x, cfg, tp_axis=tp_axis,
         lora=lora.get("mlp") if lora is not None else None,
         lora_scale=lora_scale)
-    return x, (kc, vc)
+    return x, pools
 
 
 def llama_block_prefill_paged_sp(p, x, kc, vc, start, t0,
@@ -487,7 +514,8 @@ def llama_block_prefill_paged_sp(p, x, kc, vc, start, t0,
                                  sp_axis: str,
                                  tp_axis: Optional[str] = None,
                                  block_tables=None,
-                                 block_size: Optional[int] = None):
+                                 block_size: Optional[int] = None,
+                                 kv_scales=None, policy=None):
     """Sequence-parallel chunked prefill block (the serve engine's
     long-context path): x [1, Pl, D] is this sp rank's slice of the
     chunk's hidden states; ``cos``/``sin`` [Pl, hd] must be built from
@@ -496,18 +524,19 @@ def llama_block_prefill_paged_sp(p, x, kc, vc, start, t0,
     Attention runs through nn/attention.ring_paged_prefill — K/V
     sharded over ``sp_axis`` during the score pass (GQA UNrepeated on
     the wire), reassembled by one all_gather for the sp-replicated pool
-    scatter. Returns (x, (kc, vc))."""
+    scatter. Returns (x, (kc, vc[, k_scale, v_scale]))."""
     from quintnet_tpu.nn.attention import ring_paged_prefill
 
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp)
-    o, kc, vc = ring_paged_prefill(
+    out = ring_paged_prefill(
         q, k, v, start, t0, kc, vc, sp_axis=sp_axis,
-        block_tables=block_tables, block_size=block_size)
-    x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis)
+        block_tables=block_tables, block_size=block_size,
+        kv_scales=kv_scales, policy=policy)
+    x = llama_attn_residual(p["attn"], x, out[0], tp_axis=tp_axis)
     x, _aux = llama_mlp_residual(p, x, cfg, tp_axis=tp_axis)
-    return x, (kc, vc)
+    return x, out[1:]
 
 
 def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
@@ -515,7 +544,8 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
                              tp_axis: Optional[str] = None,
                              block_tables=None,
                              block_size: Optional[int] = None,
-                             lora=None, lora_scale=None):
+                             lora=None, lora_scale=None,
+                             kv_scales=None, policy=None):
     """Batched draft-verify block step over the paged pool (the serve
     engine's speculative-decode scoring path, serve/spec.py): x
     [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
@@ -527,19 +557,42 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
     :func:`llama_block_decode`'s paged math widened from 1 to P tokens
     per row. ``cos``/``sin`` [S, 1, P, hd] must be built from the SAME
     absolute positions. ``lora``/``lora_scale``: this layer's packed
-    per-slot adapters. Returns (x, (kc, vc))."""
-    from quintnet_tpu.nn.attention import paged_gather, paged_verify_update
+    per-slot adapters. ``kv_scales``/``policy``: scaled KV layout
+    (serve/kv_quant.py). Returns (x, (kc, vc[, k_scale, v_scale]))."""
+    from quintnet_tpu.nn.attention import (_quant_span, paged_gather,
+                                           paged_gather_dequant,
+                                           paged_quant_update,
+                                           paged_verify_update)
 
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     attn_lora = lora.get("attn") if lora is not None else None
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
                         lora=attn_lora, lora_scale=lora_scale)
-    kc, vc = paged_verify_update(kc, vc, k, v, positions, tail_lens,
-                                 block_tables=block_tables,
-                                 block_size=block_size)
-    kg = paged_gather(kc, block_tables, block_size=block_size)
-    vg = paged_gather(vc, block_tables, block_size=block_size)
+    if kv_scales is None:
+        kc, vc = paged_verify_update(kc, vc, k, v, positions, tail_lens,
+                                     block_tables=block_tables,
+                                     block_size=block_size)
+        kg = paged_gather(kc, block_tables, block_size=block_size)
+        vg = paged_gather(vc, block_tables, block_size=block_size)
+        pools = (kc, vc)
+    else:
+        ks, vs = kv_scales
+        kg = paged_gather_dequant(policy, kc, ks, block_tables,
+                                  block_size=block_size)
+        vg = paged_gather_dequant(policy, vc, vs, block_tables,
+                                  block_size=block_size)
+        span = _quant_span(positions.shape[1], block_size,
+                           block_tables.shape[1])
+        kc, ks, kg = paged_quant_update(
+            policy, kc, ks, kg, k, positions, tail_lens,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=span)
+        vc, vs, vg = paged_quant_update(
+            policy, vc, vs, vg, v, positions, tail_lens,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=span)
+        pools = (kc, vc, ks, vs)
     rep = q.shape[1] // kg.shape[1]
     kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
     valid = (jnp.arange(kf.shape[2])[None, None, :]
@@ -555,13 +608,14 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
         p, x, cfg, tp_axis=tp_axis,
         lora=lora.get("mlp") if lora is not None else None,
         lora_scale=lora_scale)
-    return x, (kc, vc)
+    return x, pools
 
 
 def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
                        tp_axis: Optional[str] = None,
                        block_tables=None, block_size: Optional[int] = None,
-                       lora=None, lora_scale=None):
+                       lora=None, lora_scale=None,
+                       kv_scales=None, policy=None):
     """One cached token: x [B, 1, D], caches [B, Hkv(/tp), T, hd] ->
     (x, updated caches). Masked attention over cache[:pos].
 
@@ -571,13 +625,20 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
     supplies per-row rope tables (cos/sin [B, 1, 1, hd]). The cache
     stays UNrepeated either way — kv-head repeat happens on the
     gathered view. ``lora``/``lora_scale``: this layer's packed
-    per-slot adapters (multi-tenant LoRA serving)."""
+    per-slot adapters (multi-tenant LoRA serving). ``kv_scales``/
+    ``policy``: scaled KV layout (serve/kv_quant.py; paged path only) —
+    the update tuple grows to (kc, vc, k_scale, v_scale)."""
     tp = 1 if tp_axis is None else lax.axis_size(tp_axis)
     attn_lora = lora.get("attn") if lora is not None else None
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
                         lora=attn_lora, lora_scale=lora_scale)
+    pools = None
     if block_tables is None:
+        if kv_scales is not None:
+            raise ValueError(
+                "scaled KV layout policies exist only for the paged "
+                "pool (block_tables is required)")
         kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos,
                                              axis=2)
         vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos,
@@ -585,7 +646,7 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
         rep = q.shape[1] // kc.shape[1]
         kf, vf = repeat_kv(kc, rep), repeat_kv(vc, rep)
         valid = jnp.arange(kf.shape[2])[None, None, None, :] <= pos
-    else:
+    elif kv_scales is None:
         from quintnet_tpu.nn.attention import paged_cache_update, paged_gather
 
         kc, vc = paged_cache_update(
@@ -593,6 +654,29 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
             pos, block_tables=block_tables, block_size=block_size)
         kg = paged_gather(kc, block_tables, block_size=block_size)
         vg = paged_gather(vc, block_tables, block_size=block_size)
+        rep = q.shape[1] // kg.shape[1]
+        kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
+        valid = (jnp.arange(kf.shape[2])[None, :]
+                 <= pos[:, None])[:, None, None, :]
+    else:
+        from quintnet_tpu.nn.attention import (paged_gather_dequant,
+                                               paged_quant_update)
+
+        ks, vs = kv_scales
+        kg = paged_gather_dequant(policy, kc, ks, block_tables,
+                                  block_size=block_size)
+        vg = paged_gather_dequant(policy, vc, vs, block_tables,
+                                  block_size=block_size)
+        ones = jnp.ones(pos.shape, jnp.int32)
+        kc, ks, kg = paged_quant_update(
+            policy, kc, ks, kg, k, pos[:, None], ones,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=1)
+        vc, vs, vg = paged_quant_update(
+            policy, vc, vs, vg, v, pos[:, None], ones,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=1)
+        pools = (kc, vc, ks, vs)
         rep = q.shape[1] // kg.shape[1]
         kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
         valid = (jnp.arange(kf.shape[2])[None, :]
@@ -608,7 +692,7 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
         p, x, cfg, tp_axis=tp_axis,
         lora=lora.get("mlp") if lora is not None else None,
         lora_scale=lora_scale)
-    return x, (kc, vc)
+    return x, (pools if pools is not None else (kc, vc))
 
 
 def _positions(b, s, sp_axis: Optional[str]):

@@ -218,7 +218,8 @@ def paged_cache_update(k_cache, v_cache, k, v, pos, *, block_tables,
     blk = jnp.take_along_axis(block_tables,
                               (pos // block_size)[:, None], axis=1)[:, 0]
     idx = blk * block_size + pos % block_size            # [B] flat slots
-    return k_cache.at[idx].set(k), v_cache.at[idx].set(v)
+    return (k_cache.at[idx].set(k.astype(k_cache.dtype)),
+            v_cache.at[idx].set(v.astype(v_cache.dtype)))
 
 
 def paged_gather(cache, block_tables, *, block_size: int):
@@ -232,6 +233,131 @@ def paged_gather(cache, block_tables, *, block_size: int):
     # [B, M, bs, H, Dh] -> [B, H, M*bs, Dh]
     b, m, bs, h, dh = pages.shape
     return pages.transpose(0, 3, 1, 2, 4).reshape(b, h, m * bs, dh)
+
+
+def paged_gather_scales(scales, block_tables, *, block_size: int):
+    """Per-block-per-head scales [num_blocks, H] + tables [B, M] -> the
+    position-ordered broadcast view [B, H, M*block_size, 1] matching
+    :func:`paged_gather`'s output: every slot of a block shares its
+    block's per-head scale."""
+    sc = scales[block_tables]                       # [B, M, H]
+    b, m, h = sc.shape
+    sc = jnp.broadcast_to(sc.transpose(0, 2, 1)[:, :, :, None],
+                          (b, h, m, block_size))
+    return sc.reshape(b, h, m * block_size)[..., None]
+
+
+def paged_gather_dequant(policy, cache, scales, block_tables, *,
+                         block_size: int):
+    """The DEQUANT-INSIDE-THE-KERNEL read: gather a row's blocks into
+    the position-ordered view and dequantize with their block scales —
+    [B, H, M*bs, Dh] f32, ready for the existing f32-softmax math.
+    With ``scales=None`` (passthrough policies) this IS
+    :func:`paged_gather`."""
+    view = paged_gather(cache, block_tables, block_size=block_size)
+    if scales is None:
+        return view
+    return policy.dequant(
+        view, paged_gather_scales(scales, block_tables,
+                                  block_size=block_size))
+
+
+def paged_requant_scatter(policy, cache, scales, row_view, block_tables,
+                          first_blk, last_pos, *, block_size: int,
+                          max_blocks: int):
+    """Quantize-on-scatter: requantize each row's TOUCHED logical
+    blocks ``[first_blk[s], last_pos[s] // bs]`` from its f32 gathered
+    view ``row_view`` [S, H, M*bs, Dh] — fresh per-block-per-head
+    absmax scales — and write blocks + scales back into the pool.
+
+    ``last_pos`` [S] is each row's last WRITTEN token position: block
+    slots beyond it are zeroed before the absmax, so recycled blocks'
+    stale bytes (a previous owner's values, dequantized under a
+    leftover scale the allocator never resets) can neither inflate the
+    scale — which would coarsen the new tokens' quantization — nor
+    survive in storage. Those slots are unreadable until rewritten
+    (the attention mask stops at each row's position), so zeroing them
+    is inert.
+
+    ``max_blocks`` is the STATIC window width (the most blocks one
+    row's write run can span); window slots past a row's dynamic last
+    block (and rows with ``last_pos < first_blk * bs``, i.e. nothing
+    written) scatter into the null block — memory nobody reads, the
+    same convention as every paged update. Touched blocks are private
+    to their row by the COW discipline, so no two rows' REAL writes
+    ever collide; a published (shared) chain's bytes are never
+    rewritten, which is what keeps requantization drift out of
+    blocks other requests read."""
+    S, H, T, Dh = row_view.shape
+    bs = block_size
+    M = block_tables.shape[1]
+    rowb = row_view.reshape(S, H, M, bs, Dh)
+    j = first_blk[:, None] + jnp.arange(max_blocks)[None, :]   # [S, K]
+    touched = (j <= last_pos[:, None] // bs) & (j < M)
+    j_c = jnp.clip(j, 0, M - 1)
+    blk = jnp.take_along_axis(
+        rowb, j_c[:, None, :, None, None], axis=2)     # [S, H, K, bs, Dh]
+    live = (j_c[:, :, None] * bs + jnp.arange(bs)[None, None, :]
+            <= last_pos[:, None, None])                # [S, K, bs]
+    blk = jnp.where(live[:, None, :, :, None], blk, 0.0)
+    sc = policy.compute_scale(blk, axes=(3, 4))        # [S, H, K]
+    q = policy.quant(blk, sc[..., None, None])
+    tgt = jnp.where(touched,
+                    jnp.take_along_axis(block_tables, j_c, axis=1), 0)
+    flat = tgt.reshape(-1)
+    nb = cache.shape[0] // bs
+    K = max_blocks
+    q = q.transpose(0, 2, 3, 1, 4).reshape(S * K, bs, H, Dh)
+    cache = cache.reshape(nb, bs, H, Dh).at[flat].set(q)
+    cache = cache.reshape(nb * bs, H, Dh)
+    scales = scales.at[flat].set(sc.transpose(0, 2, 1).reshape(S * K, H))
+    return cache, scales
+
+
+def paged_quant_update(policy, cache, scales, row_view, vals, positions,
+                       lens, *, block_tables, block_size: int,
+                       max_blocks: int):
+    """The quantized pool WRITE all three paged kernels share: insert
+    each row's fresh values into its dequantized f32 gathered view,
+    then requantize + scatter back exactly the touched blocks
+    (:func:`paged_requant_scatter`).
+
+    ``row_view`` [S, H, T, Dh]: the row's dequantized view BEFORE this
+    write; ``vals`` [S, H, P, Dh]: the fresh k or v run; ``positions``
+    [S, P] absolute CONTIGUOUS write positions (``start_s +
+    arange(P)``); ``lens`` [S]: columns at or beyond a row's len are
+    pad. Returns (cache, scales, the post-insert f32 view — what the
+    attention scores read, so the math on it matches the passthrough
+    scatter-then-gather path exactly).
+
+    The insert is one dynamic slice per row (the run is contiguous by
+    contract), into a view padded by P slots so a run whose pad tail
+    crosses the end of the table can never clamp-shift onto valid
+    slots. Pad columns DO land in the view — at positions past the
+    row's ``lens``, which no causal mask ever exposes to a real query
+    and which the scatter below zeroes past ``last_pos`` — so they are
+    inert in both the scores and the pool."""
+    S, H, T, Dh = row_view.shape
+    P = positions.shape[1]
+    padded = jnp.concatenate(
+        [row_view, jnp.zeros((S, H, P, Dh), row_view.dtype)], axis=2)
+    padded = jax.vmap(
+        lambda row, val, st: lax.dynamic_update_slice_in_dim(
+            row, val, st, axis=1)
+    )(padded, vals.astype(jnp.float32), positions[:, 0])
+    row_view = padded[:, :, :T]
+    first = positions[:, 0] // block_size
+    last_pos = positions[:, 0] + lens - 1           # < first*bs if len 0
+    cache, scales = paged_requant_scatter(
+        policy, cache, scales, row_view, block_tables, first, last_pos,
+        block_size=block_size, max_blocks=max_blocks)
+    return cache, scales, row_view
+
+
+def _quant_span(p_tokens: int, block_size: int, table_width: int) -> int:
+    """Static window width for :func:`paged_requant_scatter`: the most
+    blocks a ``p_tokens``-long write run can touch."""
+    return min(-(-p_tokens // block_size) + 1, table_width)
 
 
 def paged_prefill_update(k_cache, v_cache, k, v, positions, tail_len, *,
@@ -257,7 +383,8 @@ def paged_prefill_update(k_cache, v_cache, k, v, positions, tail_len, *,
 def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                       num_heads: int, tp_axis: Optional[str] = None,
                       block_tables=None, block_size: Optional[int] = None,
-                      lora=None, lora_scale=None):
+                      lora=None, lora_scale=None,
+                      kv_scales=None, policy=None):
     """Chunked prefill over the paged pool: attention for ONE request's
     uncached tail, reading the cached prefix from pool blocks.
 
@@ -278,7 +405,13 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
 
     ``lora``/``lora_scale``: per-slot packed adapters (serving
     multi-LoRA; nn/layers.lora_delta) — qkv's delta lands before the
-    head split, proj's before the psum."""
+    head split, proj's before the psum.
+
+    ``kv_scales``/``policy`` (serve/kv_quant.py): a scaled layout
+    policy reads the row via gather + DEQUANT, inserts the tail into
+    the f32 view, runs the identical score math, and quantizes the
+    touched blocks back on scatter; the return grows to
+    (y, k_cache, v_cache, k_scale, v_scale)."""
     qkv = linear_apply(p["qkv"], x)  # [1, P, 3*D_local]
     if lora is not None and "qkv" in lora:
         qkv = qkv + lora_delta(x, lora["qkv"], lora_scale)
@@ -286,13 +419,31 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
-    k_cache, v_cache = paged_prefill_update(
-        k_cache, v_cache, k[0], v[0], positions, tail_len,
-        block_tables=block_tables, block_size=block_size)
-    k_all = paged_gather(k_cache, block_tables[None],
-                         block_size=block_size)   # [1, H, M*bs, Dh]
-    v_all = paged_gather(v_cache, block_tables[None],
-                         block_size=block_size)
+    if kv_scales is None:
+        k_cache, v_cache = paged_prefill_update(
+            k_cache, v_cache, k[0], v[0], positions, tail_len,
+            block_tables=block_tables, block_size=block_size)
+        k_all = paged_gather(k_cache, block_tables[None],
+                             block_size=block_size)   # [1, H, M*bs, Dh]
+        v_all = paged_gather(v_cache, block_tables[None],
+                             block_size=block_size)
+    else:
+        ks, vs = kv_scales
+        tables = block_tables[None]
+        k_all = paged_gather_dequant(policy, k_cache, ks, tables,
+                                     block_size=block_size)
+        v_all = paged_gather_dequant(policy, v_cache, vs, tables,
+                                     block_size=block_size)
+        span = _quant_span(positions.shape[0], block_size,
+                           block_tables.shape[0])
+        pos2 = positions[None, :]
+        lens = jnp.reshape(tail_len, (1,))
+        k_cache, ks, k_all = paged_quant_update(
+            policy, k_cache, ks, k_all, k, pos2, lens,
+            block_tables=tables, block_size=block_size, max_blocks=span)
+        v_cache, vs, v_all = paged_quant_update(
+            policy, v_cache, vs, v_all, v, pos2, lens,
+            block_tables=tables, block_size=block_size, max_blocks=span)
     valid = (jnp.arange(k_all.shape[2])[None, :]
              <= positions[:, None])               # [P, M*bs]
 
@@ -312,6 +463,8 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
+    if kv_scales is not None:
+        return y, k_cache, v_cache, ks, vs
     return y, k_cache, v_cache
 
 
@@ -333,7 +486,8 @@ def _online_merge(m, l, acc, m_new, l_new, o_new):
 
 
 def ring_paged_prefill(q, k, v, start, t0, k_cache, v_cache, *,
-                       sp_axis: str, block_tables, block_size: int):
+                       sp_axis: str, block_tables, block_size: int,
+                       kv_scales=None, policy=None):
     """Sequence-parallel chunk attention over the paged pool: ring
     attention (Liu et al., RingAttention — PAPERS.md) across mesh axis
     ``sp_axis`` for the chunk's own K/V, merged online with each local
@@ -384,11 +538,17 @@ def ring_paged_prefill(q, k, v, start, t0, k_cache, v_cache, *,
 
     # resident-prefix contribution: the pool BEFORE this chunk's
     # scatter holds exactly positions [0, start) of this request —
-    # every local query sees all of them (they precede the chunk)
-    k_pool = paged_gather(k_cache, block_tables[None],
-                          block_size=block_size)
-    v_pool = paged_gather(v_cache, block_tables[None],
-                          block_size=block_size)
+    # every local query sees all of them (they precede the chunk).
+    # Scaled layout policies (serve/kv_quant.py) dequantize the
+    # gathered prefix here — the sp pool is replicated, so every rank
+    # dequantizes (and later requantizes) identically.
+    ks = vs = None
+    if kv_scales is not None:
+        ks, vs = kv_scales
+    k_pool = paged_gather_dequant(policy, k_cache, ks, block_tables[None],
+                                  block_size=block_size)
+    v_pool = paged_gather_dequant(policy, v_cache, vs, block_tables[None],
+                                  block_size=block_size)
     pool_mask = jnp.broadcast_to(
         jnp.arange(k_pool.shape[2])[None, :] < start,
         (pl, k_pool.shape[2]))
@@ -417,10 +577,26 @@ def ring_paged_prefill(q, k, v, start, t0, k_cache, v_cache, *,
     kv_full = lax.all_gather(jnp.stack([k[0], v[0]]), sp_axis, axis=2,
                              tiled=True)               # [2, Hkv, P, Dh]
     positions = start + jnp.arange(pl * sp, dtype=jnp.int32)
-    k_cache, v_cache = paged_prefill_update(
-        k_cache, v_cache, kv_full[0], kv_full[1], positions, t0 - start,
-        block_tables=block_tables, block_size=block_size)
-    return o, k_cache, v_cache
+    if kv_scales is None:
+        k_cache, v_cache = paged_prefill_update(
+            k_cache, v_cache, kv_full[0], kv_full[1], positions,
+            t0 - start, block_tables=block_tables, block_size=block_size)
+        return o, k_cache, v_cache
+    # quantize-on-scatter (no extra collectives: the gathered prefix
+    # views already hold the row, the chunk inserts into them and only
+    # the touched private blocks requantize — every rank identically)
+    span = _quant_span(pl * sp, block_size, block_tables.shape[0])
+    pos2 = positions[None, :]
+    lens = jnp.reshape(t0 - start, (1,))
+    k_cache, ks, _ = paged_quant_update(
+        policy, k_cache, ks, k_pool, kv_full[0][None], pos2, lens,
+        block_tables=block_tables[None], block_size=block_size,
+        max_blocks=span)
+    v_cache, vs, _ = paged_quant_update(
+        policy, v_cache, vs, v_pool, kv_full[1][None], pos2, lens,
+        block_tables=block_tables[None], block_size=block_size,
+        max_blocks=span)
+    return o, k_cache, v_cache, ks, vs
 
 
 def sp_last_hidden(h, start, t0, *, sp_axis: str):
@@ -445,7 +621,8 @@ def mha_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
                          num_heads: int, sp_axis: str,
                          tp_axis: Optional[str] = None,
                          block_tables=None,
-                         block_size: Optional[int] = None):
+                         block_size: Optional[int] = None,
+                         kv_scales=None, policy=None):
     """:func:`mha_prefill_paged`'s sequence-parallel sibling: ``x``
     [1, Pl, D] is this sp rank's slice of the chunk's hidden states;
     the attention runs through :func:`ring_paged_prefill` (K/V sharded
@@ -458,16 +635,18 @@ def mha_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
-    o, k_cache, v_cache = ring_paged_prefill(
+    out = ring_paged_prefill(
         q, k, v, start, t0, k_cache, v_cache, sp_axis=sp_axis,
-        block_tables=block_tables, block_size=block_size)
+        block_tables=block_tables, block_size=block_size,
+        kv_scales=kv_scales, policy=policy)
+    o, pools = out[0], out[1:]
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
-    return y, k_cache, v_cache
+    return (y, *pools)
 
 
 def paged_verify_update(k_cache, v_cache, k, v, positions, tail_lens, *,
@@ -496,7 +675,8 @@ def paged_verify_update(k_cache, v_cache, k, v, positions, tail_lens, *,
 def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
                      num_heads: int, tp_axis: Optional[str] = None,
                      block_tables=None, block_size: Optional[int] = None,
-                     lora=None, lora_scale=None):
+                     lora=None, lora_scale=None,
+                     kv_scales=None, policy=None):
     """Batched draft-verify attention over the paged pool: EVERY slot
     scores a short run of tokens (its last sampled token + up to k
     drafted continuations) against its own cached row in ONE forward —
@@ -525,11 +705,28 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
-    k_cache, v_cache = paged_verify_update(
-        k_cache, v_cache, k, v, positions, tail_lens,
-        block_tables=block_tables, block_size=block_size)
-    k_all = paged_gather(k_cache, block_tables, block_size=block_size)
-    v_all = paged_gather(v_cache, block_tables, block_size=block_size)
+    if kv_scales is None:
+        k_cache, v_cache = paged_verify_update(
+            k_cache, v_cache, k, v, positions, tail_lens,
+            block_tables=block_tables, block_size=block_size)
+        k_all = paged_gather(k_cache, block_tables, block_size=block_size)
+        v_all = paged_gather(v_cache, block_tables, block_size=block_size)
+    else:
+        ks, vs = kv_scales
+        k_all = paged_gather_dequant(policy, k_cache, ks, block_tables,
+                                     block_size=block_size)
+        v_all = paged_gather_dequant(policy, v_cache, vs, block_tables,
+                                     block_size=block_size)
+        span = _quant_span(positions.shape[1], block_size,
+                           block_tables.shape[1])
+        k_cache, ks, k_all = paged_quant_update(
+            policy, k_cache, ks, k_all, k, positions, tail_lens,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=span)
+        v_cache, vs, v_all = paged_quant_update(
+            policy, v_cache, vs, v_all, v, positions, tail_lens,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=span)
     valid = (jnp.arange(k_all.shape[2])[None, None, :]
              <= positions[:, :, None])                # [S, P, T]
 
@@ -548,13 +745,16 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
+    if kv_scales is not None:
+        return y, k_cache, v_cache, ks, vs
     return y, k_cache, v_cache
 
 
 def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                tp_axis: Optional[str] = None,
                block_tables=None, block_size: Optional[int] = None,
-               lora=None, lora_scale=None):
+               lora=None, lora_scale=None,
+               kv_scales=None, policy=None):
     """Single-token cached attention. Returns (y, k_cache, v_cache).
 
     Dense (single-request fast path, ``block_tables=None``): x [B, 1, D],
@@ -595,17 +795,41 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
     if block_tables is None:
+        if kv_scales is not None:
+            raise ValueError(
+                "scaled KV layout policies exist only for the paged "
+                "pool (block_tables is required)")
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
         k_all, v_all = k_cache, v_cache
         valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, :]  # [1, T]
-    else:
+    elif kv_scales is None:
         # pool layout is [slot, H, Dh]: k here is [B, H, 1, Dh]
         k_cache, v_cache = paged_cache_update(
             k_cache, v_cache, k[:, :, 0], v[:, :, 0], pos,
             block_tables=block_tables, block_size=block_size)
         k_all = paged_gather(k_cache, block_tables, block_size=block_size)
         v_all = paged_gather(v_cache, block_tables, block_size=block_size)
+        valid = jnp.arange(k_all.shape[2])[None, :] <= pos[:, None]
+    else:
+        # scaled layout (serve/kv_quant.py): dequantized gathered view,
+        # token inserted in f32, ONE touched block per row requantized
+        # back — inactive rows (pos 0, null table) round-trip the null
+        # block, which nobody reads
+        ks, vs = kv_scales
+        k_all = paged_gather_dequant(policy, k_cache, ks, block_tables,
+                                     block_size=block_size)
+        v_all = paged_gather_dequant(policy, v_cache, vs, block_tables,
+                                     block_size=block_size)
+        ones = jnp.ones(pos.shape, jnp.int32)
+        k_cache, ks, k_all = paged_quant_update(
+            policy, k_cache, ks, k_all, k, pos[:, None], ones,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=1)
+        v_cache, vs, v_all = paged_quant_update(
+            policy, v_cache, vs, v_all, v, pos[:, None], ones,
+            block_tables=block_tables, block_size=block_size,
+            max_blocks=1)
         valid = jnp.arange(k_all.shape[2])[None, :] <= pos[:, None]
 
     dh = q.shape[-1]
@@ -624,4 +848,6 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
         y = y + p["proj"]["b"]
+    if kv_scales is not None:
+        return y, k_cache, v_cache, ks, vs
     return y, k_cache, v_cache

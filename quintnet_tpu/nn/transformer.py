@@ -264,24 +264,28 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                         tp_axis: Optional[str] = None,
                         block_tables=None,
                         block_size: Optional[int] = None,
-                        lora=None, lora_scale=None):
+                        lora=None, lora_scale=None,
+                        kv_scales=None, policy=None):
     """Chunked-prefill block step over the paged pool (nn/attention.py
     mha_prefill_paged): x [1, P, D] tail hidden states at absolute
     ``positions``, caches are flat pool views — the serve engine's
     prefix-cached prefill path. ``lora``/``lora_scale``: this layer's
     packed per-slot adapters (serving multi-LoRA; serve/adapters.py).
-    Returns (x, k_cache, v_cache)."""
+    ``kv_scales``/``policy``: scaled KV layout (serve/kv_quant.py) —
+    this layer's (k_scale, v_scale) ride along and come back. Returns
+    (x, k_cache, v_cache[, k_scale, v_scale])."""
     attn_lora = lora.get("attn") if lora is not None else None
-    a, k_cache, v_cache = mha_prefill_paged(
+    out = mha_prefill_paged(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
         positions, tail_len, num_heads=num_heads, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size,
-        lora=attn_lora, lora_scale=lora_scale)
-    x = x + a
-    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis,
-                      lora=lora.get("mlp") if lora is not None else None,
-                      lora_scale=lora_scale), k_cache, v_cache
+        lora=attn_lora, lora_scale=lora_scale,
+        kv_scales=kv_scales, policy=policy)
+    x = x + out[0]
+    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                       tp_axis=tp_axis,
+                       lora=lora.get("mlp") if lora is not None else None,
+                       lora_scale=lora_scale), *out[1:])
 
 
 def block_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
@@ -290,21 +294,23 @@ def block_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
                            moe_args: Optional[MoEArgs] = None,
                            tp_axis: Optional[str] = None,
                            block_tables=None,
-                           block_size: Optional[int] = None):
+                           block_size: Optional[int] = None,
+                           kv_scales=None, policy=None):
     """Sequence-parallel chunked-prefill block step (nn/attention.py
     mha_prefill_paged_sp): x [1, Pl, D] is this sp rank's slice of the
     chunk's hidden states at positions ``start + rank*Pl + arange(Pl)``;
     the attention rides ring_paged_prefill over ``sp_axis`` while the
     LN/MLP halves are position-wise and stay local. Returns
-    (x, k_cache, v_cache) with the whole chunk's K/V scattered into the
-    (sp-replicated) pool."""
-    a, k_cache, v_cache = mha_prefill_paged_sp(
+    (x, k_cache, v_cache[, k_scale, v_scale]) with the whole chunk's
+    K/V scattered into the (sp-replicated) pool."""
+    out = mha_prefill_paged_sp(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
         start, t0, num_heads=num_heads, sp_axis=sp_axis, tp_axis=tp_axis,
-        block_tables=block_tables, block_size=block_size)
-    x = x + a
-    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis), k_cache, v_cache
+        block_tables=block_tables, block_size=block_size,
+        kv_scales=kv_scales, policy=policy)
+    x = x + out[0]
+    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                       tp_axis=tp_axis), *out[1:])
 
 
 def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
@@ -313,23 +319,27 @@ def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
                        tp_axis: Optional[str] = None,
                        block_tables=None,
                        block_size: Optional[int] = None,
-                       lora=None, lora_scale=None):
+                       lora=None, lora_scale=None,
+                       kv_scales=None, policy=None):
     """Batched draft-verify block step (nn/attention.mha_verify_paged):
     x [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
     caches are flat pool views — the serve engine's speculative-decode
     scoring path (serve/spec.py). ``lora``/``lora_scale``: this layer's
-    packed per-slot adapters. Returns (x, k_cache, v_cache)."""
+    packed per-slot adapters. ``kv_scales``/``policy``: scaled KV
+    layout (serve/kv_quant.py). Returns
+    (x, k_cache, v_cache[, k_scale, v_scale])."""
     attn_lora = lora.get("attn") if lora is not None else None
-    a, k_cache, v_cache = mha_verify_paged(
+    out = mha_verify_paged(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
         positions, tail_lens, num_heads=num_heads, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size,
-        lora=attn_lora, lora_scale=lora_scale)
-    x = x + a
-    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis,
-                      lora=lora.get("mlp") if lora is not None else None,
-                      lora_scale=lora_scale), k_cache, v_cache
+        lora=attn_lora, lora_scale=lora_scale,
+        kv_scales=kv_scales, policy=policy)
+    x = x + out[0]
+    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                       tp_axis=tp_axis,
+                       lora=lora.get("mlp") if lora is not None else None,
+                       lora_scale=lora_scale), *out[1:])
 
 
 def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
@@ -337,22 +347,26 @@ def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                  moe_args: Optional[MoEArgs] = None,
                  tp_axis: Optional[str] = None,
                  block_tables=None, block_size: Optional[int] = None,
-                 lora=None, lora_scale=None):
+                 lora=None, lora_scale=None,
+                 kv_scales=None, policy=None):
     """Single-token cached block step (nn/attention.py mha_decode).
 
     With ``block_tables``/``block_size`` the caches are paged-pool flat
     views and ``pos`` is per-row — the continuous-batching decode path
     (quintnet_tpu/serve/); default is the dense single-request cache.
     ``lora``/``lora_scale``: this layer's packed per-slot adapters
-    (multi-tenant LoRA serving)."""
+    (multi-tenant LoRA serving). ``kv_scales``/``policy``: scaled KV
+    layout (serve/kv_quant.py; paged path only) — returns
+    (x, k_cache, v_cache[, k_scale, v_scale])."""
     attn_lora = lora.get("attn") if lora is not None else None
-    a, k_cache, v_cache = mha_decode(
+    out = mha_decode(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache, pos,
         num_heads=num_heads, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size,
-        lora=attn_lora, lora_scale=lora_scale)
-    x = x + a
-    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis,
-                      lora=lora.get("mlp") if lora is not None else None,
-                      lora_scale=lora_scale), k_cache, v_cache
+        lora=attn_lora, lora_scale=lora_scale,
+        kv_scales=kv_scales, policy=policy)
+    x = x + out[0]
+    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                       tp_axis=tp_axis,
+                       lora=lora.get("mlp") if lora is not None else None,
+                       lora_scale=lora_scale), *out[1:])

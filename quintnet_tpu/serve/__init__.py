@@ -15,6 +15,12 @@ scheduling; vLLM-style paged KV blocks):
   retention of refcount-zero blocks and copy-on-write sharing, so
   requests with a common prompt prefix (and preemption-resumes /
   migrations) reuse resident KV instead of recomputing it;
+- :mod:`kv_quant` — KV-pool LAYOUT POLICIES: f32/bf16 passthrough,
+  int8 blocks with per-block-per-head absmax scales (dequantized
+  inside the gathered-view attention kernels, quantized on scatter —
+  the same pool bytes hold ~4x the blocks), and the fake-quant
+  identity policy whose engine is bit-identical to f32 (the proof the
+  scaled code path is numerically inert);
 - :mod:`scheduler` — waiting queue, admission by UNCACHED-block budget,
   FCFS + optional priority, preemption-by-eviction of the youngest
   request when the pool is exhausted;
@@ -49,6 +55,7 @@ from quintnet_tpu.serve.api import generate, generate_stream
 from quintnet_tpu.serve.engine import (ServeEngine, check_admissible)
 from quintnet_tpu.serve.families import gpt2_family, llama_family
 from quintnet_tpu.serve.kv_pool import AdmitPlan, KVPool
+from quintnet_tpu.serve.kv_quant import KVLayoutPolicy, make_policy
 from quintnet_tpu.serve.longctx import ChunkState, plan_chunks
 from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
 from quintnet_tpu.serve.scheduler import (DeadlineExceeded, Request,
@@ -61,6 +68,7 @@ __all__ = [
     "AdmitPlan",
     "ChunkState",
     "DeadlineExceeded",
+    "KVLayoutPolicy",
     "KVPool",
     "NgramDrafter",
     "Request",
@@ -75,5 +83,6 @@ __all__ = [
     "generate_stream",
     "gpt2_family",
     "llama_family",
+    "make_policy",
     "plan_chunks",
 ]

@@ -113,6 +113,7 @@ from quintnet_tpu.serve.adapters import (AdapterRegistry, adapter_paths,
                                          nest, tree_at)
 from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
+from quintnet_tpu.serve.kv_quant import make_policy
 from quintnet_tpu.serve.metrics import ServeMetrics
 from quintnet_tpu.serve.scheduler import (FINISHED, DeadlineExceeded,
                                           Request, RequestProgress,
@@ -382,18 +383,28 @@ class ServeEngine:
                 f"prefill_chunk_budget must be >= 1; got "
                 f"{self.prefill_chunk_budget}")
 
-        sharding = None
+        # KV layout policy (serve/kv_quant.py): kv_dtype is "f32" /
+        # "bf16" / "int8" / "fake_quant", a raw dtype (the pre-policy
+        # surface), or a KVLayoutPolicy. Scaled policies add the
+        # per-block-per-head scale arrays to the pool state — the SAME
+        # program ladder compiles either way (compile counts per
+        # policy are pinned unchanged, analysis/specs.py).
+        self.kv_policy = make_policy(
+            kv_dtype if kv_dtype is not None else family.kv_dtype)
+        sharding = scale_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             sharding = NamedSharding(mesh,
                                      P(None, None, self.tp_axis, None))
+            scale_sharding = NamedSharding(mesh,
+                                           P(None, None, self.tp_axis))
         self.pool = KVPool(
             n_layers=family.n_layers, n_kv_heads=family.n_kv_heads,
             head_dim=family.head_dim, block_size=block_size,
-            num_blocks=num_blocks,
-            dtype=kv_dtype if kv_dtype is not None else family.kv_dtype,
-            sharding=sharding, prefix_cache=self.prefix_cache)
+            num_blocks=num_blocks, policy=self.kv_policy,
+            sharding=sharding, scale_sharding=scale_sharding,
+            prefix_cache=self.prefix_cache)
         self.table_width = self.pool.blocks_for(self.max_seq_len)
         self.scheduler = Scheduler(self.pool, policy=policy)
         self.metrics = ServeMetrics(clock=clock)
@@ -428,8 +439,13 @@ class ServeEngine:
         # key_data its evolved key; decode's tok row aliases the next-
         # token row. (ids/tables/pos/start/cow scalars cannot alias an
         # output slot that is not already covered — donating them would
-        # only earn XLA's "not usable" warning.)
-        prefill_fn = self._build_prefill(donate=(1, 2, 5, 9))
+        # only earn XLA's "not usable" warning.) Indices shift with the
+        # pool-arg count: scaled KV policies carry 4 pool buffers
+        # (k, v, k_scale, v_scale), passthrough ones 2.
+        n_pool = len(self.pool.caches())
+        pool_idx = tuple(range(1, n_pool + 1))
+        prefill_fn = self._build_prefill(
+            donate=pool_idx + (n_pool + 3, n_pool + 7))
         self._prefills: Dict[int, RecompileSentinel] = {
             b: RecompileSentinel(f"serve.prefill[{b}]", prefill_fn,
                                  max_compiles=1)
@@ -439,7 +455,8 @@ class ServeEngine:
         # dim is the only signature difference — all buckets share one
         # jitted callable), chosen per step by the largest bound
         # adapter. Keyed by bucket; None = the adapter-blind program.
-        decode_fn = self._build_decode(donate=(1, 2, 3, 6))
+        decode_fn = self._build_decode(
+            donate=pool_idx + (n_pool + 1, n_pool + 4))
         if self.adapters is None:
             self._decode = RecompileSentinel("serve.decode", decode_fn,
                                              max_compiles=1)
@@ -457,7 +474,7 @@ class ServeEngine:
         # NOT alias anything (the chain output is [S, P, keysize]).
         self._verifies: Dict[int, RecompileSentinel] = {}
         if self.spec is not None:
-            verify_fn = self._build_verify(donate=(1, 2, 3))
+            verify_fn = self._build_verify(donate=pool_idx + (n_pool + 1,))
             self._verifies = {
                 k: RecompileSentinel(f"serve.verify[{k}]", verify_fn,
                                      max_compiles=1)
@@ -483,9 +500,16 @@ class ServeEngine:
         tp_axis = self.tp_axis
         sp_axis = self.sp_axis
         use_lora = self.adapters is not None
+        policy = self.kv_policy
+        scaled = policy.scaled
 
-        def body(params, k_pool, v_pool, ids, start, t0, table_row,
-                 cow_src, cow_len, key_data, *rest):
+        def body(params, k_pool, v_pool, *rest):
+            if scaled:
+                k_scale, v_scale, *rest = rest
+            else:
+                k_scale = v_scale = None
+            ids, start, t0, table_row, cow_src, cow_len, key_data, \
+                *rest = rest
             lora, lora_scale = rest if use_lora else (None, None)
             # copy-on-write: when the reusable prefix chain ends inside
             # a partially-filled cached block, its first cow_len slots
@@ -494,7 +518,10 @@ class ServeEngine:
             # immutable while the index references it. cow_len == 0
             # degenerates to masked writes into the null block. (Under
             # sp the pool is replicated — every rank does the identical
-            # copy.)
+            # copy.) Scaled policies copy the source block's per-head
+            # scales too: the copied slots are raw stored bytes, so
+            # they dequantize correctly only under their own scale
+            # (cow_len == 0 rewrites dst's scale with itself — inert).
             sl = jnp.arange(bs)
             M = table_row.shape[0]
             dst = table_row[jnp.clip(start // bs, 0, M - 1)]
@@ -502,48 +529,65 @@ class ServeEngine:
             src_idx = cow_src * bs + sl
             k_pool = k_pool.at[:, dst_idx].set(k_pool[:, src_idx])
             v_pool = v_pool.at[:, dst_idx].set(v_pool[:, src_idx])
+            if scaled:
+                ksd = jnp.where(cow_len > 0, k_scale[:, cow_src],
+                                k_scale[:, dst])
+                vsd = jnp.where(cow_len > 0, v_scale[:, cow_src],
+                                v_scale[:, dst])
+                k_scale = k_scale.at[:, dst].set(ksd)
+                v_scale = v_scale.at[:, dst].set(vsd)
 
+            kv_scales = (k_scale, v_scale) if scaled else None
             if sp_axis is None:
-                logits, k_pool, v_pool = family.prefill_from(
+                out = family.prefill_from(
                     params, k_pool, v_pool, ids, start, t0, table_row,
                     bs, tp_axis=tp_axis, lora=lora,
-                    lora_scale=lora_scale)
+                    lora_scale=lora_scale, kv_scales=kv_scales,
+                    policy=policy)
             else:
                 # sequence-parallel chunk: ids arrives as this rank's
                 # [1, P/sp] slice (the shard_map below splits dim 1);
                 # ring attention inside (nn/attention.ring_paged_prefill)
-                logits, k_pool, v_pool = family.prefill_from_sp(
+                out = family.prefill_from_sp(
                     params, k_pool, v_pool, ids, start, t0, table_row,
-                    bs, sp_axis=sp_axis, tp_axis=tp_axis)
+                    bs, sp_axis=sp_axis, tp_axis=tp_axis,
+                    kv_scales=kv_scales, policy=policy)
+            logits, pools = out[0], out[1:]
 
             key = jax.random.wrap_key_data(key_data)
             key2, sub = jax.random.split(key)
             tok = sample_logits(logits, sub, temperature=self.temperature,
                                 top_k=self.top_k, top_p=self.top_p)[0]
-            return (k_pool, v_pool, tok.astype(jnp.int32),
+            return (*pools, tok.astype(jnp.int32),
                     jax.random.key_data(key2))
 
-        return self._wrap(body, n_pool_args=2, n_rest=7, donate=donate,
+        return self._wrap(body, n_rest=7, donate=donate,
                           ids_sharded=True)
 
     def _build_decode(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
         use_lora = self.adapters is not None
+        policy = self.kv_policy
+        scaled = policy.scaled
 
-        def body(params, k_pool, v_pool, tok, pos, tables, key_data,
-                 *rest):
+        def body(params, k_pool, v_pool, *rest):
+            if scaled:
+                k_scale, v_scale, *rest = rest
+            tok, pos, tables, key_data, *rest = rest
             lora, lora_scale = rest if use_lora else (None, None)
-            logits, k_pool, v_pool = family.decode(
+            out = family.decode(
                 params, k_pool, v_pool, tok, pos, tables, bs,
-                tp_axis=tp_axis, lora=lora, lora_scale=lora_scale)
+                tp_axis=tp_axis, lora=lora, lora_scale=lora_scale,
+                kv_scales=(k_scale, v_scale) if scaled else None,
+                policy=policy)
+            logits, pools = out[0], out[1:]
             keys = jax.random.wrap_key_data(key_data)
             pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             nxt = self._sample_rows(logits, pairs[:, 1])
-            return (k_pool, v_pool, nxt,
-                    jax.random.key_data(pairs[:, 0]))
+            return (*pools, nxt, jax.random.key_data(pairs[:, 0]))
 
-        return self._wrap(body, n_pool_args=2, n_rest=4, donate=donate)
+        return self._wrap(body, n_rest=4, donate=donate)
 
     def _build_verify(self, *, donate):
         """The speculative verify step (serve/spec.py): ONE forward
@@ -561,14 +605,21 @@ class ServeEngine:
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
         use_lora = self.adapters is not None
+        policy = self.kv_policy
+        scaled = policy.scaled
 
-        def body(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-                 key_data, *rest):
+        def body(params, k_pool, v_pool, *rest):
+            if scaled:
+                k_scale, v_scale, *rest = rest
+            ids, starts, tail_lens, tables, key_data, *rest = rest
             lora, lora_scale = rest if use_lora else (None, None)
-            logits, k_pool, v_pool = family.verify(
+            out = family.verify(
                 params, k_pool, v_pool, ids, starts, tail_lens, tables,
                 bs, tp_axis=tp_axis, lora=lora,
-                lora_scale=lora_scale)                     # [S, P, V]
+                lora_scale=lora_scale,
+                kv_scales=(k_scale, v_scale) if scaled else None,
+                policy=policy)
+            logits, pools = out[0], out[1:]               # [S, P, V]
             P = ids.shape[1]
 
             def chain_step(kd, _):
@@ -589,11 +640,11 @@ class ServeEngine:
                         lg[None], jax.random.wrap_key_data(kd1),
                         temperature=self.temperature, top_k=self.top_k,
                         top_p=self.top_p)[0]))(logits, subs)
-            return k_pool, v_pool, toks.astype(jnp.int32), chain
+            return (*pools, toks.astype(jnp.int32), chain)
 
-        return self._wrap(body, n_pool_args=2, n_rest=5, donate=donate)
+        return self._wrap(body, n_rest=5, donate=donate)
 
-    def _wrap(self, body, *, n_pool_args: int, n_rest: int, donate,
+    def _wrap(self, body, *, n_rest: int, donate,
               ids_sharded: bool = False):
         """jit, donating the aliasable arguments: the pool buffers
         (decode-state updates are in-place on device) plus the per-step
@@ -613,39 +664,48 @@ class ServeEngine:
         K/V all_gather), not in the data layout. Decode/verify run
         fully replicated: every rank computes the identical step, so
         engine semantics (and outputs) match the single-device program
-        exactly."""
+        exactly.
+
+        Scaled KV layout policies (serve/kv_quant.py) carry 4 pool
+        buffers — the k/v int8 (or fake-f32) pools plus their
+        [L, nb, H] scale arrays, head-sharded over tp exactly like the
+        pools — so the pool-spec prefix widens from 2 to 4; everything
+        downstream of it is unchanged."""
         if self.mesh is None:
             return jax.jit(body, donate_argnums=donate)
         from jax.sharding import PartitionSpec as P
 
         from quintnet_tpu.core import collectives as cc
 
+        n_pool = len(self.pool.caches())
         if self.sp_axis is not None:
             rest = [P()] * n_rest
             if ids_sharded:
                 rest[0] = P(None, self.sp_axis)
             smapped = cc.shard_map_fn(
                 body, self.mesh,
-                in_specs=(P(),) * (1 + n_pool_args) + tuple(rest),
-                out_specs=(P(),) * n_pool_args + (P(), P()))
+                in_specs=(P(),) * (1 + n_pool) + tuple(rest),
+                out_specs=(P(),) * n_pool + (P(), P()))
             return jax.jit(smapped, donate_argnums=donate)
 
-        pool_spec = P(None, None, self.tp_axis, None)
+        pool_specs = (P(None, None, self.tp_axis, None),) * 2
+        if self.kv_policy.scaled:
+            pool_specs = pool_specs + (P(None, None, self.tp_axis),) * 2
         pspecs = self.family.partition_specs(self.tp_axis)
 
-        # prefill body: (params, kp, vp, ids, start, t0, row, cow_src,
-        #                cow_len, key[, lora, scale]) -> 4 outs
-        # decode  body: (params, kp, vp, tok, pos, tables, key
-        #                [, lora, scale]) -> 4 outs
-        # verify  body: (params, kp, vp, ids, starts, tail_lens, tables,
-        #                key[, lora, scale]) -> 4 outs
+        # prefill body: (params, *pools, ids, start, t0, row, cow_src,
+        #                cow_len, key[, lora, scale]) -> pools + 2 outs
+        # decode  body: (params, *pools, tok, pos, tables, key
+        #                [, lora, scale]) -> pools + 2 outs
+        # verify  body: (params, *pools, ids, starts, tail_lens, tables,
+        #                key[, lora, scale]) -> pools + 2 outs
         lora_specs = ((self._lora_specs, P())
                       if self.adapters is not None else ())
         smapped = cc.shard_map_fn(
             body, self.mesh,
-            in_specs=((pspecs,) + (pool_spec,) * n_pool_args
+            in_specs=((pspecs,) + pool_specs
                       + (P(),) * n_rest + lora_specs),
-            out_specs=(pool_spec,) * n_pool_args + (P(), P()))
+            out_specs=pool_specs + (P(), P()))
         return jax.jit(smapped, donate_argnums=donate)
 
     # ------------------------------------------------------------------
@@ -1160,12 +1220,12 @@ class ServeEngine:
             if req.adapter_id is not None:
                 self._bind_slot_adapter(slot, req.adapter_id)
             extra = self._lora_args("prefill", slot=slot)
-        kp, vp, tok0, key2 = self._prefills[bucket](
+        *pools, tok0, key2 = self._prefills[bucket](
             self.params, *self.pool.caches(), jnp.asarray(ids),
             jnp.int32(start), jnp.int32(t0), jnp.asarray(row),
             jnp.int32(plan.cow_src if plan.cow_src is not None else 0),
             jnp.int32(plan.cow_len), jnp.asarray(req.key_data), *extra)
-        self.pool.update(kp, vp)
+        self.pool.update(*pools)
         if plan.cow_src is not None:
             # the COW source was pinned only for the copy above
             self.pool.release([plan.cow_src])
@@ -1224,14 +1284,14 @@ class ServeEngine:
         cow = st.cow_pinned
         extra = (self._lora_args("prefill", slot=slot)
                  if self.adapters is not None else ())
-        kp, vp, tok0, key2 = self._prefills[bucket](
+        *pools, tok0, key2 = self._prefills[bucket](
             self.params, *self.pool.caches(), jnp.asarray(ids),
             jnp.int32(st.next), jnp.int32(st.next + n),
             jnp.asarray(self._tables[slot]),
             jnp.int32(st.cow_src if cow else 0),
             jnp.int32(st.cow_len if cow else 0),
             jnp.asarray(self._key_data[slot]), *extra)
-        self.pool.update(kp, vp)
+        self.pool.update(*pools)
         if cow:
             # the COW source was pinned only for the copy above
             self.pool.release([st.cow_src])
@@ -1384,12 +1444,12 @@ class ServeEngine:
 
         extra = (self._lora_args("verify")
                  if self.adapters is not None else ())
-        kp, vp, toks, chain = self._verifies[k_bucket](
+        *pools, toks, chain = self._verifies[k_bucket](
             self.params, *self.pool.caches(), jnp.asarray(ids),
             jnp.asarray(starts), jnp.asarray(tail_lens),
             jnp.asarray(self._tables), jnp.asarray(self._key_data),
             *extra)
-        self.pool.update(kp, vp)
+        self.pool.update(*pools)
         toks = np.asarray(toks)
         chain = np.asarray(chain)
 
@@ -1520,12 +1580,12 @@ class ServeEngine:
                         tok[s] = 0
                         pos[s] = 0
                         tables[s] = 0
-                kp, vp, nxt, key2 = sentinel(
+                *pools, nxt, key2 = sentinel(
                     self.params, *self.pool.caches(),
                     jnp.asarray(tok), jnp.asarray(pos),
                     jnp.asarray(tables),
                     jnp.asarray(self._key_data), *extra)
-                self.pool.update(kp, vp)
+                self.pool.update(*pools)
                 nxt = np.asarray(nxt)
                 key2 = np.array(key2)
                 for s in prefilling:
@@ -1547,6 +1607,8 @@ class ServeEngine:
             waiting=len(self.scheduler.waiting),
             kv_blocks_used=self.pool.num_used,
             kv_blocks_total=self.pool.usable_blocks,
+            kv_pool_bytes=self.pool.pool_bytes,
+            kv_bytes_per_token=self.pool.bytes_per_token,
             prefill_tokens=prefill_tokens,
             decode_tokens=decode_tokens,
             prefix_hit_tokens=prefix_hit_tokens,
@@ -1578,32 +1640,32 @@ class ServeEngine:
             self._apply_pack_update(0, self._zero_slot_update())
         p_extra = self._lora_args("prefill", slot=0) if lora_on else ()
         for b, sentinel in self._prefills.items():
-            kp, vp, _tok, _k = sentinel(
+            *pools, _tok, _k = sentinel(
                 self.params, *self.pool.caches(),
                 jnp.zeros((1, b), jnp.int32), jnp.int32(0), jnp.int32(1),
                 zrow, jnp.int32(0), jnp.int32(0), key, *p_extra)
-            self.pool.update(kp, vp)
+            self.pool.update(*pools)
             key = jnp.asarray(np.asarray(_k))
         for R, sentinel in self._decodes.items():
             extra = (self._lora_args("decode", rank_bucket=R)
                      if lora_on else ())
-            kp, vp, _nxt, _keys = sentinel(
+            *pools, _nxt, _keys = sentinel(
                 self.params, *self.pool.caches(), jnp.asarray(self._tok),
                 jnp.asarray(self._pos), jnp.asarray(self._tables),
                 jnp.asarray(self._key_data), *extra)
-            self.pool.update(kp, vp)
+            self.pool.update(*pools)
         v_extra = self._lora_args("verify") if lora_on else ()
         for k, sentinel in self._verifies.items():
             # all-zero tables + zero tail_lens: every write lands in
             # the null block, candidate tokens and chains are discarded
-            kp, vp, _t, _c = sentinel(
+            *pools, _t, _c = sentinel(
                 self.params, *self.pool.caches(),
                 jnp.zeros((self.max_slots, k + 1), jnp.int32),
                 jnp.zeros((self.max_slots,), jnp.int32),
                 jnp.zeros((self.max_slots,), jnp.int32),
                 jnp.zeros((self.max_slots, self.table_width), jnp.int32),
                 jnp.asarray(self._key_data), *v_extra)
-            self.pool.update(kp, vp)
+            self.pool.update(*pools)
 
     def run(self, *, max_steps: Optional[int] = None) -> None:
         """Step until all submitted work is finished (or ``max_steps``)."""

@@ -40,6 +40,16 @@ bonus token. The attention math is the gathered-view decode math
 exactly (nn/attention.mha_verify_paged), which is what makes
 verify-committed tokens bit-equal to plain decoded ones.
 
+Quantized KV (serve/kv_quant.py): every contract additionally takes
+``kv_scales=None, policy=None`` — under a SCALED layout policy (int8,
+fake_quant) ``kv_scales`` is the ``(k_scale, v_scale)`` pair of
+``[L, num_blocks, H_kv]`` per-block-per-head scale arrays that ride
+the layer scan beside the pools, and the return tuple widens
+symmetrically to ``(logits, k_pool, v_pool, k_scale, v_scale)``. The
+block bodies dequantize inside the gathered view and quantize on
+scatter; ``kv_scales=None`` (the passthrough policies) is
+byte-identical to the pre-policy programs.
+
 Multi-tenant LoRA (serve/adapters.py): every contract additionally
 takes ``lora=None, lora_scale=None`` — a nested pytree of PACKED
 per-slot adapter factors, one ``{"a": [L, S_or_1, in, r], "b": [L,
@@ -104,20 +114,26 @@ class Family:
 # GPT-2
 # --------------------------------------------------------------------
 
-def _scan_xs(blocks, k_pool, v_pool, lora):
-    """The layer-scan xs: block params + pool views (+ the packed lora
-    tree when adapters ride — every leaf has leading L)."""
-    return ((blocks, k_pool, v_pool) if lora is None
-            else (blocks, k_pool, v_pool, lora))
+def _scan_xs(blocks, k_pool, v_pool, lora, kv_scales=None):
+    """The layer-scan xs: block params + pool views (+ the per-layer
+    (k_scale, v_scale) pair for scaled KV layout policies, + the packed
+    lora tree when adapters ride — every leaf has leading L)."""
+    xs = (blocks, k_pool, v_pool)
+    if kv_scales is not None:
+        xs = xs + tuple(kv_scales)
+    if lora is not None:
+        xs = xs + (lora,)
+    return xs
 
 
-def _scan_layer(layer, lora):
-    """(blk, kc, vc, per-layer-lora-or-None) from one scan slice."""
-    if lora is None:
-        blk, kc, vc = layer
-        return blk, kc, vc, None
-    blk, kc, vc, lr = layer
-    return blk, kc, vc, lr
+def _scan_layer(layer, lora, scaled: bool = False):
+    """(blk, kc, vc, (ks, vs)-or-None, per-layer-lora-or-None) from one
+    scan slice, mirroring :func:`_scan_xs`'s packing order."""
+    it = iter(layer)
+    blk, kc, vc = next(it), next(it), next(it)
+    sc = (next(it), next(it)) if scaled else None
+    lr = next(it) if lora is not None else None
+    return blk, kc, vc, sc, lr
 
 
 def gpt2_family(cfg) -> Family:
@@ -133,7 +149,8 @@ def gpt2_family(cfg) -> Family:
                                              block_verify_paged)
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
-                     block_size, tp_axis=None, lora=None, lora_scale=None):
+                     block_size, tp_axis=None, lora=None, lora_scale=None,
+                     kv_scales=None, policy=None):
         B, P = ids.shape
         emb = params["embedding"]
         positions = start + jnp.arange(P, dtype=jnp.int32)
@@ -143,44 +160,51 @@ def gpt2_family(cfg) -> Family:
              + jnp.take(emb["wpe"], safe_pos, axis=0)[None])
         heads = _local_heads(cfg, tp_axis)
         tail_len = t0 - start
+        scaled = kv_scales is not None
 
         def body(x, layer):
-            blk, kc, vc, lr = _scan_layer(layer, lora)
-            x, kc, vc = block_prefill_paged(
+            blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
+            out = block_prefill_paged(
                 blk, x, kc, vc, positions, tail_len, num_heads=heads,
                 act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
                 block_tables=table_row, block_size=block_size,
-                lora=lr, lora_scale=lora_scale)
-            return x, (kc, vc)
+                lora=lr, lora_scale=lora_scale,
+                kv_scales=sc, policy=policy)
+            return out[0], out[1:]
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
+        h, pools = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
+                              kv_scales))
         h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
-        return (_logits(params, h_last, cfg, tp_axis)[:, 0, :],
-                k_pool, v_pool)
+        return (_logits(params, h_last, cfg, tp_axis)[:, 0, :], *pools)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
-               tp_axis=None, lora=None, lora_scale=None):
+               tp_axis=None, lora=None, lora_scale=None,
+               kv_scales=None, policy=None):
         emb = params["embedding"]
         x = (_embed_tok(emb, tok[:, None], cfg, tp_axis)
              + jnp.take(emb["wpe"], pos, axis=0)[:, None, :])
         heads = _local_heads(cfg, tp_axis)
+        scaled = kv_scales is not None
 
         def body(h, layer):
-            blk, kc, vc, lr = _scan_layer(layer, lora)
-            h, kc, vc = block_decode(blk, h, kc, vc, pos, num_heads=heads,
-                                     act=gelu, moe_args=cfg.moe_args,
-                                     tp_axis=tp_axis, block_tables=tables,
-                                     block_size=block_size,
-                                     lora=lr, lora_scale=lora_scale)
-            return h, (kc, vc)
+            blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
+            out = block_decode(blk, h, kc, vc, pos, num_heads=heads,
+                               act=gelu, moe_args=cfg.moe_args,
+                               tp_axis=tp_axis, block_tables=tables,
+                               block_size=block_size,
+                               lora=lr, lora_scale=lora_scale,
+                               kv_scales=sc, policy=policy)
+            return out[0], out[1:]
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora))
-        return _logits(params, h, cfg, tp_axis)[:, 0, :], k_pool, v_pool
+        h, pools = lax.scan(
+            body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora,
+                              kv_scales))
+        return (_logits(params, h, cfg, tp_axis)[:, 0, :], *pools)
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-               block_size, tp_axis=None, lora=None, lora_scale=None):
+               block_size, tp_axis=None, lora=None, lora_scale=None,
+               kv_scales=None, policy=None):
         S, P = ids.shape
         emb = params["embedding"]
         positions = (starts[:, None]
@@ -189,23 +213,26 @@ def gpt2_family(cfg) -> Family:
         h = (_embed_tok(emb, ids, cfg, tp_axis)
              + jnp.take(emb["wpe"], safe_pos, axis=0))
         heads = _local_heads(cfg, tp_axis)
+        scaled = kv_scales is not None
 
         def body(x, layer):
-            blk, kc, vc, lr = _scan_layer(layer, lora)
-            x, kc, vc = block_verify_paged(
+            blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
+            out = block_verify_paged(
                 blk, x, kc, vc, positions, tail_lens, num_heads=heads,
                 act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
                 block_tables=tables, block_size=block_size,
-                lora=lr, lora_scale=lora_scale)
-            return x, (kc, vc)
+                lora=lr, lora_scale=lora_scale,
+                kv_scales=sc, policy=policy)
+            return out[0], out[1:]
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
-        return _logits(params, h, cfg, tp_axis), k_pool, v_pool
+        h, pools = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
+                              kv_scales))
+        return (_logits(params, h, cfg, tp_axis), *pools)
 
     def prefill_from_sp(params, k_pool, v_pool, ids, start, t0,
                         table_row, block_size, *, sp_axis: str,
-                        tp_axis=None):
+                        tp_axis=None, kv_scales=None, policy=None):
         # ids: [1, P/sp] — THIS sp rank's slice of the padded chunk
         # (the engine shard_maps the bucket over sp); positions are the
         # rank's absolute offsets, so embedding/rope/masking all land
@@ -219,21 +246,22 @@ def gpt2_family(cfg) -> Family:
         h = (_embed_tok(emb, ids, cfg, tp_axis)
              + jnp.take(emb["wpe"], safe_pos, axis=0)[None])
         heads = _local_heads(cfg, tp_axis)
+        scaled = kv_scales is not None
 
         def body(x, layer):
-            blk, kc, vc, _ = _scan_layer(layer, None)
-            x, kc, vc = block_prefill_paged_sp(
+            blk, kc, vc, sc, _ = _scan_layer(layer, None, scaled)
+            out = block_prefill_paged_sp(
                 blk, x, kc, vc, start, t0, num_heads=heads,
                 sp_axis=sp_axis, act=gelu, moe_args=cfg.moe_args,
                 tp_axis=tp_axis, block_tables=table_row,
-                block_size=block_size)
-            return x, (kc, vc)
+                block_size=block_size, kv_scales=sc, policy=policy)
+            return out[0], out[1:]
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, h, _scan_xs(params["blocks"], k_pool, v_pool, None))
+        h, pools = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, None,
+                              kv_scales))
         h_last = sp_last_hidden(h, start, t0, sp_axis=sp_axis)
-        return (_logits(params, h_last, cfg, tp_axis)[:, 0, :],
-                k_pool, v_pool)
+        return (_logits(params, h_last, cfg, tp_axis)[:, 0, :], *pools)
 
     def lora_layout(path, b, tp):
         # fused qkv columns are tp-BLOCKED in the serving layout
@@ -272,70 +300,81 @@ def llama_family(cfg) -> Family:
     from quintnet_tpu.nn.attention import sp_last_hidden
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
-                     block_size, tp_axis=None, lora=None, lora_scale=None):
+                     block_size, tp_axis=None, lora=None, lora_scale=None,
+                     kv_scales=None, policy=None):
         B, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)
         positions = start + jnp.arange(P, dtype=jnp.int32)
         cos, sin = llama_rope_tables(positions, cfg)      # [P, hd]
         tail_len = t0 - start
+        scaled = kv_scales is not None
 
         def body(x, layer):
-            blk, kc, vc, lr = _scan_layer(layer, lora)
-            x, (kc, vc) = llama_block_prefill_paged(
+            blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
+            x, pools = llama_block_prefill_paged(
                 blk, x, kc, vc, positions, tail_len, cfg, cos, sin,
                 tp_axis=tp_axis, block_tables=table_row,
-                block_size=block_size, lora=lr, lora_scale=lora_scale)
-            return x, (kc, vc)
+                block_size=block_size, lora=lr, lora_scale=lora_scale,
+                kv_scales=sc, policy=policy)
+            return x, pools
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
+        h, pools = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
+                              kv_scales))
         h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
         return (_full_logits(params, h_last, cfg, tp_axis)[:, 0, :],
-                k_pool, v_pool)
+                *pools)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
-               tp_axis=None, lora=None, lora_scale=None):
+               tp_axis=None, lora=None, lora_scale=None,
+               kv_scales=None, policy=None):
         x = _embed(params, tok[:, None], cfg, tp_axis)        # [S, 1, D]
         cos, sin = llama_rope_tables(pos, cfg)                # [S, hd]
         cos, sin = cos[:, None, None, :], sin[:, None, None, :]
+        scaled = kv_scales is not None
 
         def body(h, layer):
-            blk, kc, vc, lr = _scan_layer(layer, lora)
-            h, (kc, vc) = llama_block_decode(
+            blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
+            h, pools = llama_block_decode(
                 blk, h, kc, vc, pos, cfg, cos, sin, tp_axis=tp_axis,
                 block_tables=tables, block_size=block_size,
-                lora=lr, lora_scale=lora_scale)
-            return h, (kc, vc)
+                lora=lr, lora_scale=lora_scale,
+                kv_scales=sc, policy=policy)
+            return h, pools
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora))
-        return _full_logits(params, h, cfg, tp_axis)[:, 0, :], \
-            k_pool, v_pool
+        h, pools = lax.scan(
+            body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora,
+                              kv_scales))
+        return (_full_logits(params, h, cfg, tp_axis)[:, 0, :], *pools)
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-               block_size, tp_axis=None, lora=None, lora_scale=None):
+               block_size, tp_axis=None, lora=None, lora_scale=None,
+               kv_scales=None, policy=None):
         S, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)                 # [S, P, D]
         positions = (starts[:, None]
                      + jnp.arange(P, dtype=jnp.int32)[None, :])
         cos, sin = llama_rope_tables(positions, cfg)          # [S, P, hd]
         cos, sin = cos[:, None], sin[:, None]                 # [S,1,P,hd]
+        scaled = kv_scales is not None
 
         def body(x, layer):
-            blk, kc, vc, lr = _scan_layer(layer, lora)
-            x, (kc, vc) = llama_block_verify_paged(
+            blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
+            x, pools = llama_block_verify_paged(
                 blk, x, kc, vc, positions, tail_lens, cfg, cos, sin,
                 tp_axis=tp_axis, block_tables=tables,
-                block_size=block_size, lora=lr, lora_scale=lora_scale)
-            return x, (kc, vc)
+                block_size=block_size, lora=lr, lora_scale=lora_scale,
+                kv_scales=sc, policy=policy)
+            return x, pools
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora))
-        return _full_logits(params, h, cfg, tp_axis), k_pool, v_pool
+        h, pools = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
+                              kv_scales))
+        return (_full_logits(params, h, cfg, tp_axis), *pools)
 
     def prefill_from_sp(params, k_pool, v_pool, ids, start, t0,
                         table_row, block_size, *, sp_axis: str,
-                        tp_axis=None):
+                        tp_axis=None, kv_scales=None, policy=None):
         # ids: [1, P/sp] — this sp rank's chunk slice; rope tables come
         # from the rank's LOCAL absolute positions
         B, Pl = ids.shape
@@ -344,20 +383,23 @@ def llama_family(cfg) -> Family:
         positions = (start + idx * Pl
                      + jnp.arange(Pl, dtype=jnp.int32))
         cos, sin = llama_rope_tables(positions, cfg)      # [Pl, hd]
+        scaled = kv_scales is not None
 
         def body(x, layer):
-            blk, kc, vc, _ = _scan_layer(layer, None)
-            x, (kc, vc) = llama_block_prefill_paged_sp(
+            blk, kc, vc, sc, _ = _scan_layer(layer, None, scaled)
+            x, pools = llama_block_prefill_paged_sp(
                 blk, x, kc, vc, start, t0, cfg, cos, sin,
                 sp_axis=sp_axis, tp_axis=tp_axis,
-                block_tables=table_row, block_size=block_size)
-            return x, (kc, vc)
+                block_tables=table_row, block_size=block_size,
+                kv_scales=sc, policy=policy)
+            return x, pools
 
-        h, (k_pool, v_pool) = lax.scan(
-            body, h, _scan_xs(params["blocks"], k_pool, v_pool, None))
+        h, pools = lax.scan(
+            body, h, _scan_xs(params["blocks"], k_pool, v_pool, None,
+                              kv_scales))
         h_last = sp_last_hidden(h, start, t0, sp_axis=sp_axis)
         return (_full_logits(params, h_last, cfg, tp_axis)[:, 0, :],
-                k_pool, v_pool)
+                *pools)
 
     return Family(
         name="llama", cfg=cfg, n_layers=cfg.n_layers,

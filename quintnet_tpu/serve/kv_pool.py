@@ -12,7 +12,11 @@ Device layout (per k and v): ``[L, num_blocks * block_size, H_kv, Dh]``
 into and paged_gather pages out of; keeping L leading lets the decode
 step lax.scan over layers exactly like the dense path. Under TP the
 H_kv dim is head-sharded over the mesh (each rank holds its local
-heads' pool, same invariant as the dense TP cache).
+heads' pool, same invariant as the dense TP cache). WHAT a slot stores
+is a :class:`~quintnet_tpu.serve.kv_quant.KVLayoutPolicy`: f32/bf16
+passthrough, or int8 with per-block-per-head absmax scales carried in
+``[L, num_blocks, H_kv]`` f32 arrays beside the pools (head-sharded
+the same way) — same pool bytes, ~4x the blocks.
 
 Block 0 is permanently reserved as the NULL block: inactive engine
 slots point their table rows (and positions) at it, so masked rows'
@@ -60,6 +64,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from quintnet_tpu.serve.kv_quant import KVLayoutPolicy, make_policy
+
 NULL_BLOCK = 0
 
 
@@ -102,7 +108,9 @@ class KVPool:
 
     def __init__(self, *, n_layers: int, n_kv_heads: int, head_dim: int,
                  block_size: int, num_blocks: int, dtype=jnp.float32,
-                 sharding=None, prefix_cache: bool = True):
+                 policy: "KVLayoutPolicy | str | None" = None,
+                 sharding=None, scale_sharding=None,
+                 prefix_cache: bool = True):
         if block_size < 1 or num_blocks < 2:
             raise ValueError(
                 f"need block_size >= 1 and num_blocks >= 2 (block 0 is "
@@ -113,16 +121,34 @@ class KVPool:
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.prefix_cache = bool(prefix_cache)
+        # layout policy (serve/kv_quant.py): ``policy`` wins; the plain
+        # ``dtype`` arg (the pre-policy surface) maps to its
+        # passthrough policy. Scaled policies additionally allocate one
+        # f32 per-block-per-head scale array per pool — under tp the
+        # head dim shards exactly like the pool's (``scale_sharding``).
+        self.policy: KVLayoutPolicy = make_policy(
+            policy if policy is not None else dtype)
         shape = (n_layers, num_blocks * block_size, n_kv_heads, head_dim)
-        k = jnp.zeros(shape, dtype)
-        v = jnp.zeros(shape, dtype)
+        k = jnp.zeros(shape, self.policy.store_dtype)
+        v = jnp.zeros(shape, self.policy.store_dtype)
+        k_scale = v_scale = None
+        if self.policy.scaled:
+            k_scale = jnp.ones((n_layers, num_blocks, n_kv_heads),
+                               jnp.float32)
+            v_scale = jnp.ones((n_layers, num_blocks, n_kv_heads),
+                               jnp.float32)
         if sharding is not None:
             import jax
 
             k = jax.device_put(k, sharding)
             v = jax.device_put(v, sharding)
+            if k_scale is not None and scale_sharding is not None:
+                k_scale = jax.device_put(k_scale, scale_sharding)
+                v_scale = jax.device_put(v_scale, scale_sharding)
         self.k = k
         self.v = v
+        self.k_scale = k_scale
+        self.v_scale = v_scale
         # LIFO free list: reuse recently-freed blocks first (warm pages).
         # The membership set keeps release's double-free check O(1)
         # instead of an O(free-list) scan per block.
@@ -148,6 +174,28 @@ class KVPool:
         self._tentative: Set[int] = set()
 
     # ---- accounting -------------------------------------------------
+    @property
+    def bytes_per_block(self) -> int:
+        """Device bytes one block costs under this pool's layout
+        policy (k + v slot data across layers + the per-block scale
+        rows when scaled). Policy-aware: int8 blocks cost ~1/4 of f32
+        ones, so the same pool bytes hold ~4x the blocks — THE
+        capacity-is-concurrency equation tools/serve_bench.py's
+        --kv-capacity A/B solves for equal bytes."""
+        return self.policy.bytes_per_block(
+            n_layers=self.n_layers, n_kv_heads=self.n_kv_heads,
+            head_dim=self.head_dim, block_size=self.block_size)
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total device bytes of the pool's KV storage (+ scales)."""
+        return self.num_blocks * self.bytes_per_block
+
+    @property
+    def bytes_per_token(self) -> float:
+        """Device bytes one resident token position costs."""
+        return self.bytes_per_block / self.block_size
+
     @property
     def usable_blocks(self) -> int:
         """Blocks available to requests (null block excluded)."""
@@ -471,10 +519,20 @@ class KVPool:
 
     # ---- device views ----------------------------------------------
     def caches(self):
-        """The (k, v) device arrays, as carried through the jitted step
+        """The pool's device arrays, as carried through the jitted step
         functions (the engine writes the returned/donated results back
-        via :meth:`update`)."""
+        via :meth:`update`): ``(k, v)`` for passthrough policies,
+        ``(k, v, k_scale, v_scale)`` for scaled ones — call sites splat
+        the tuple, so the policy never changes their shape."""
+        if self.policy.scaled:
+            return self.k, self.v, self.k_scale, self.v_scale
         return self.k, self.v
 
-    def update(self, k, v) -> None:
+    def update(self, k, v, k_scale=None, v_scale=None) -> None:
         self.k, self.v = k, v
+        if self.policy.scaled:
+            if k_scale is None or v_scale is None:
+                raise ValueError(
+                    f"policy {self.policy.name!r} carries scale arrays; "
+                    f"update() needs all four pool buffers")
+            self.k_scale, self.v_scale = k_scale, v_scale

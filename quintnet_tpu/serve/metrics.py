@@ -39,6 +39,12 @@ class ServeMetrics:
     waiting: int = 0
     kv_blocks_used: int = 0
     kv_blocks_total: int = 0
+    # KV capacity gauges (policy-aware, serve/kv_quant.py): the pool's
+    # total device bytes and per-resident-token bytes — what makes an
+    # equal-bytes capacity A/B legible next to peak_kv_utilization
+    # (an int8 pool shows ~4x the blocks at the same kv_pool_bytes)
+    kv_pool_bytes: int = 0
+    kv_bytes_per_token: float = 0.0
 
     # monotone counters ----------------------------------------------
     steps: int = 0
@@ -101,7 +107,9 @@ class ServeMetrics:
                     spec_step: bool = False,
                     draft_tokens: int = 0,
                     accepted_draft_tokens: int = 0,
-                    prefill_chunks: int = 0) -> None:
+                    prefill_chunks: int = 0,
+                    kv_pool_bytes: int = 0,
+                    kv_bytes_per_token: float = 0.0) -> None:
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
@@ -111,6 +119,8 @@ class ServeMetrics:
         self.waiting = waiting
         self.kv_blocks_used = kv_blocks_used
         self.kv_blocks_total = kv_blocks_total
+        self.kv_pool_bytes = kv_pool_bytes
+        self.kv_bytes_per_token = kv_bytes_per_token
         self.prefill_tokens += prefill_tokens
         self.decode_tokens += decode_tokens
         self.prefix_hit_tokens += prefix_hit_tokens
@@ -256,6 +266,8 @@ class ServeMetrics:
             "latency_s": _pcts(self.latencies),
             "itl_s": _pcts(self.itls),
             "peak_kv_utilization": round(self.peak_kv_utilization, 4),
+            "kv_pool_bytes": self.kv_pool_bytes,
+            "kv_bytes_per_token": round(self.kv_bytes_per_token, 4),
             "peak_running": self.peak_running,
             "adapters": {
                 aid: {"requests": d["requests"],
@@ -353,6 +365,13 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "itl_s": _pcts(itls),
         "peak_kv_utilization": round(
             max((m.peak_kv_utilization for m in all_metrics), default=0.0),
+            4),
+        # fleet KV memory is the SUM of the replicas' pools; bytes per
+        # token is a per-replica layout property — report the worst
+        # (largest) so a mixed-policy fleet surfaces its heaviest pool
+        "kv_pool_bytes": sum(m.kv_pool_bytes for m in all_metrics),
+        "kv_bytes_per_token": round(
+            max((m.kv_bytes_per_token for m in all_metrics), default=0.0),
             4),
         "peak_running": max((m.peak_running for m in all_metrics),
                             default=0),

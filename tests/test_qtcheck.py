@@ -426,6 +426,108 @@ class TestServe:
 
 
 # ---------------------------------------------------------------------
+# serve programs: dtype-promotion census per KV layout policy
+# ---------------------------------------------------------------------
+
+class TestServeDtypeCensus:
+    """The dtype_report goldens for the serving programs, pinned PER
+    KV-POOL LAYOUT POLICY (serve/kv_quant.py): the f32/bf16 passthrough
+    programs carry no silent f64 upcasts and no 16-bit accumulation
+    (softmax and scores stay f32 — the engine's mixed-precision
+    contract), and the scaled int8 / fake_quant programs — whose
+    kernels now dequantize inside the gathered view and quantize on
+    scatter — introduce NONE either: quant math accumulates in f32,
+    int8 is storage only. A half-accum dot or accidental x64 in any
+    policy's prefill/decode/verify fails here with the primitive
+    named. The collective census is policy-invariant too (the scaled
+    paths are local gather/scatter arithmetic)."""
+
+    @pytest.fixture(scope="class")
+    def gpt2(self):
+        from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+        cfg = GPT2Config.tiny(n_layer=2)
+        return cfg, gpt2_init(jax.random.key(0), cfg)
+
+    def _engine(self, cfg, params, kv_dtype, mesh=None, **kw):
+        from quintnet_tpu.serve import ServeEngine, SpecConfig, gpt2_family
+
+        kw.setdefault("max_slots", 3)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("num_blocks", 24)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("spec", SpecConfig())
+        return ServeEngine(gpt2_family(cfg), params, mesh=mesh,
+                           kv_dtype=kv_dtype, **kw)
+
+    def _cases(self, eng, params):
+        """(fn, args) for one bucket of each program family."""
+        b = eng.prefill_buckets[0]
+        k = eng.spec.buckets[0]
+        S = eng.max_slots
+        pools = eng.pool.caches()
+        prefill = (params, *pools, jnp.zeros((1, b), jnp.int32),
+                   jnp.int32(1), jnp.int32(3),
+                   jnp.zeros((eng.table_width,), jnp.int32),
+                   jnp.int32(0), jnp.int32(0),
+                   jnp.asarray(eng._key_data[0]))
+        decode = (params, *pools, jnp.asarray(eng._tok),
+                  jnp.asarray(eng._pos), jnp.asarray(eng._tables),
+                  jnp.asarray(eng._key_data))
+        verify = (params, *pools,
+                  jnp.zeros((S, k + 1), jnp.int32),
+                  jnp.zeros((S,), jnp.int32), jnp.ones((S,), jnp.int32),
+                  jnp.asarray(eng._tables), jnp.asarray(eng._key_data))
+        return [(eng._prefills[b].fn, prefill),
+                (next(iter(eng._decodes.values())).fn, decode),
+                (eng._verifies[k].fn, verify)]
+
+    @pytest.mark.parametrize("kv_dtype",
+                             ["f32", "bf16", "int8", "fake_quant"])
+    def test_dtype_census_clean_every_policy(self, gpt2, kv_dtype):
+        cfg, params = gpt2
+        eng = self._engine(cfg, params, kv_dtype)
+        assert eng.kv_policy.name == kv_dtype
+        for fn, args in self._cases(eng, params):
+            issues = dtype_report(fn, *args)
+            assert issues == [], (kv_dtype, [i.detail for i in issues])
+
+    def test_int8_tp_collective_census_unchanged(self, gpt2):
+        """Quantization adds NO collectives: the int8 programs under
+        tp=2 carry exactly the f32 census — 2 row-parallel psums per
+        block, nothing for the scales (they shard with the heads and
+        dequant/requant is rank-local)."""
+        cfg, params = gpt2
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        eng = self._engine(cfg, params, "int8", mesh=mesh)
+        specs = [census_specs.expected_serve_prefill(cfg.n_layer,
+                                                     tp_axis="tp"),
+                 census_specs.expected_serve_decode(cfg.n_layer,
+                                                    tp_axis="tp"),
+                 census_specs.expected_serve_verify(cfg.n_layer,
+                                                    tp_axis="tp")]
+        for (fn, args), spec in zip(self._cases(eng, params), specs):
+            census = collective_census(fn, *args)
+            assert census.diff(spec) == [], census.as_dict()
+
+    def test_int8_single_device_collective_free(self, gpt2):
+        cfg, params = gpt2
+        eng = self._engine(cfg, params, "int8")
+        for fn, args in self._cases(eng, params):
+            assert collective_census(fn, *args).total() == 0
+
+    def test_scaled_programs_donate_scales(self, gpt2):
+        """The scale arrays update in place every step — they must be
+        donated like the pools (no aliasable misses in any scaled
+        program)."""
+        cfg, params = gpt2
+        eng = self._engine(cfg, params, "int8")
+        for fn, args in self._cases(eng, params):
+            rep = donation_report(fn, *args)
+            assert rep.undonated_aliasable == [], rep.summary()
+
+
+# ---------------------------------------------------------------------
 # recompile sentinel unit behaviour
 # ---------------------------------------------------------------------
 

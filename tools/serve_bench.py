@@ -40,6 +40,15 @@ Exp(rate)). Two trace shapes:
   streams move while a document is read in — plus inter-token-latency
   tails (a monolithic prefill appears as one giant gap in every
   concurrent stream);
+- ``--kv-capacity``: the EQUAL-POOL-BYTES capacity A/B (quantized KV,
+  serve/kv_quant.py) over the ``--prefix-share`` trace shape: side A
+  is an f32 pool at ``--num-blocks``; side B is the ``--kv-dtype``
+  (int8 unless set otherwise) pool given exactly the SAME byte
+  budget — which buys it ~4x the blocks. Capacity is concurrency:
+  the record's value is the quantized side's tok/s, ``vs_baseline``
+  the usable-blocks ratio at equal bytes, and extras carry the
+  structural evidence (preemptions, cache evictions, hit rates, peak
+  utilization, both pools' bytes);
 - ``--lora-trace``: N tenants spread round-robin over ``--adapters``
   LoRA adapters (trained variants of one base model, saved through
   the real safetensors path) — the multi-tenant scenario
@@ -130,21 +139,26 @@ def build_model(args, params=None):
 def build_engine(args, *, prefix_cache: bool, spec: bool = False,
                  params=None, adapters=None, max_seq=None,
                  prefill_len=None, chunked_prefill: bool = False,
-                 prefill_chunk_budget=None):
+                 prefill_chunk_budget=None, kv_dtype=None,
+                 num_blocks=None):
     from quintnet_tpu.serve import ServeEngine, SpecConfig
 
     family, params = build_model(args, params=params)
-    max_prompt = (args.shared_prefix + args.max_tail if args.prefix_share
+    max_prompt = (args.shared_prefix + args.max_tail
+                  if args.prefix_share or args.kv_capacity
                   else args.max_prompt)
     if max_seq is None:
         max_seq = min(max_prompt + args.max_new, family.max_positions)
     return ServeEngine(
         family, params, max_slots=args.slots, block_size=args.block_size,
-        num_blocks=args.num_blocks, max_seq_len=max_seq,
+        num_blocks=(num_blocks if num_blocks is not None
+                    else args.num_blocks),
+        max_seq_len=max_seq,
         prefill_len=prefill_len, chunked_prefill=chunked_prefill,
         prefill_chunk_budget=prefill_chunk_budget,
         eos_token_id=args.eos, temperature=args.temperature,
         policy=args.policy, prefix_cache=prefix_cache,
+        kv_dtype=kv_dtype if kv_dtype is not None else args.kv_dtype,
         spec=SpecConfig(max_draft=args.max_draft) if spec else None,
         adapters=adapters, lora_max_rank=args.lora_rank)
 
@@ -377,6 +391,8 @@ def _common_extras(args, s: dict) -> dict:
         "latency_p50_s": s["latency_s"]["p50"],
         "latency_p95_s": s["latency_s"]["p95"],
         "peak_kv_utilization": s["peak_kv_utilization"],
+        "kv_pool_bytes": s["kv_pool_bytes"],
+        "kv_bytes_per_token": s["kv_bytes_per_token"],
         "peak_running": s["peak_running"],
         "steps": s["steps"],
         "requests": args.requests,
@@ -403,6 +419,88 @@ def _common_extras(args, s: dict) -> dict:
 
 def run(args) -> dict:
     tag = "tiny" if args.synthetic else "full"
+
+    if args.kv_capacity:
+        # equal-pool-BYTES capacity A/B over the shared-prefix trace
+        # (quantized KV, serve/kv_quant.py): the f32 reference keeps
+        # --num-blocks; the --kv-dtype side gets every block the SAME
+        # byte budget buys (int8 blocks cost ~1/4, so ~4x blocks).
+        # Capacity is concurrency: at equal bytes the quantized pool
+        # should admit without preempting and retain the shared-prefix
+        # chain (higher hit rate) where the f32 pool thrashes.
+        from quintnet_tpu.serve.kv_quant import make_policy
+
+        family, params = build_model(args)
+        dims = dict(n_layers=family.n_layers,
+                    n_kv_heads=family.n_kv_heads,
+                    head_dim=family.head_dim, block_size=args.block_size)
+        q_name = args.kv_dtype if args.kv_dtype != "f32" else "int8"
+        byte_budget = args.num_blocks * make_policy(
+            "f32").bytes_per_block(**dims)
+        q_blocks = byte_budget // make_policy(q_name).bytes_per_block(
+            **dims)
+        eng_f = build_engine(args, prefix_cache=True, params=params,
+                             kv_dtype="f32")
+        trace = prefix_share_trace(args, eng_f.family.cfg.vocab_size)
+        s_f = replay(eng_f, trace, args)
+        eng_q = build_engine(args, prefix_cache=True, params=params,
+                             kv_dtype=q_name, num_blocks=int(q_blocks))
+        s_q = replay(eng_q, trace, args)
+        extras = _common_extras(args, s_q)
+        ratio = round((q_blocks - 1) / max(args.num_blocks - 1, 1), 3)
+        extras.update({
+            "kv_capacity": True,
+            "kv_dtype": q_name,
+            "shared_prefix": args.shared_prefix,
+            "pool_bytes_budget": int(byte_budget),
+            "f32_num_blocks": args.num_blocks,
+            "q_num_blocks": int(q_blocks),
+            "f32_usable_blocks": args.num_blocks - 1,
+            "q_usable_blocks": int(q_blocks) - 1,
+            # THE equal-bytes capacity signal (usable = minus the
+            # reserved null block)
+            "usable_blocks_ratio": ratio,
+            "f32_pool_bytes": s_f["kv_pool_bytes"],
+            "q_pool_bytes": s_q["kv_pool_bytes"],
+            "q_kv_bytes_per_token": s_q["kv_bytes_per_token"],
+            "f32_kv_bytes_per_token": s_f["kv_bytes_per_token"],
+            "f32_tokens_per_sec": s_f["tokens_per_sec"],
+            "f32_wall_s": s_f["wall_s"],
+            # the structural win at equal bytes: fewer preemptions,
+            # fewer cache evictions, higher hit rate, lower peak
+            # pressure — concurrency the f32 pool could not hold
+            "f32_preempted": s_f["preempted"],
+            "q_preempted": s_q["preempted"],
+            # NOTE hit-rate/prefill comparisons are confounded under
+            # pressure, in BOTH directions: an f32 preemption-resume
+            # re-prefills through its own published chain (extra
+            # booked hits), and the starved f32 queue serializes
+            # admissions until retired requests have PUBLISHED the
+            # shared chain (late admission sees a warmer cache, while
+            # the quantized side's higher concurrency admits before
+            # the first publish). The unconfounded cache-retention
+            # signal is the EVICTION count: evicted chains are future
+            # hits destroyed, and only the starved pool evicts.
+            "f32_prefix_hit_rate": s_f["prefix_hit_rate"],
+            "q_prefix_hit_rate": s_q["prefix_hit_rate"],
+            "f32_prefill_tokens": s_f["prefill_tokens"],
+            "f32_prefix_hit_tokens": s_f["prefix_hit_tokens"],
+            "f32_cache_evictions": eng_f.pool.cache_evictions,
+            "q_cache_evictions": eng_q.pool.cache_evictions,
+            "f32_peak_kv_utilization": s_f["peak_kv_utilization"],
+            "q_peak_kv_utilization": s_q["peak_kv_utilization"],
+            "f32_peak_running": s_f["peak_running"],
+            "q_peak_running": s_q["peak_running"],
+            "f32_finished": s_f["finished"],
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_kvcap_tokens_per_sec",
+            "value": s_q["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": ratio,
+            "rc": 0,
+            "extras": extras,
+        }
 
     if args.prefix_share:
         # A/B over the SAME shared-prefix trace: cache-on vs cache-off
@@ -614,6 +712,7 @@ def run(args) -> dict:
     extras = _common_extras(args, s)
     extras["prefix_cache"] = prefix_cache
     extras["spec"] = spec
+    extras["kv_dtype"] = args.kv_dtype
     if spec:
         extras.update({
             "spec_steps": s["spec_steps"],
@@ -652,6 +751,17 @@ def main():
     ap.add_argument("--policy", default="fcfs", choices=("fcfs", "priority"))
     ap.add_argument("--prefix-cache", default="on", choices=("on", "off"),
                     help="prefix-cache A/B switch for the default trace")
+    ap.add_argument("--kv-dtype", default="f32",
+                    choices=("f32", "bf16", "int8", "fake_quant"),
+                    help="KV-pool layout policy (serve/kv_quant.py): "
+                         "int8 stores blocks quantized with per-block-"
+                         "per-head scales, dequantized inside the "
+                         "gathered-view attention kernels")
+    ap.add_argument("--kv-capacity", action="store_true",
+                    help="equal-pool-BYTES capacity A/B over the "
+                         "shared-prefix trace: f32 at --num-blocks vs "
+                         "--kv-dtype (int8 unless set otherwise) at "
+                         "however many blocks the same bytes buy")
     ap.add_argument("--prefix-share", action="store_true",
                     help="shared-system-prompt trace, reported cache-on "
                          "vs cache-off over the same trace")
