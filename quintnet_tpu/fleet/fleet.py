@@ -56,8 +56,13 @@ class FleetRequest:
 
     def __init__(self, fid: int, prompt, max_new_tokens: int, *, key,
                  priority: int, deadline: Optional[float], on_token,
-                 submit_time: float, clock, adapter_id=None):
+                 submit_time: float, clock, adapter_id=None,
+                 trace_id=None):
         self.fid = fid
+        # observability identity (quintnet_tpu/obs/): one id per
+        # request across the whole fleet — every engine that serves
+        # (or resumes) it records spans under this id. Inert metadata.
+        self.trace_id = trace_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.key = key
@@ -145,8 +150,14 @@ class FleetMetrics:
     replica_deaths: int = 0
     stalls: int = 0                     # missed-heartbeat detections
     restarts: int = 0
-    ttfts: List[float] = field(default_factory=list)
-    latencies: List[float] = field(default_factory=list)
+    # percentile sources, reservoir-bounded like the engine's
+    # (serve/metrics.Reservoir): exact below the cap, uniform sampling
+    # above — a long-lived front door stops leaking one float per
+    # request; summary() surfaces the true count as "n"
+    ttfts: "serve_metrics.Reservoir" = field(
+        default_factory=serve_metrics.Reservoir)
+    latencies: "serve_metrics.Reservoir" = field(
+        default_factory=serve_metrics.Reservoir)
 
     @property
     def shed(self) -> int:
@@ -192,12 +203,38 @@ class ServeFleet:
                  max_dispatch: Optional[int] = None,
                  trip_after: int = 3, breaker_reset_s: float = 30.0,
                  chaos=None, clock: Callable[[], float] = time.monotonic,
-                 name_prefix: str = "r", poll_s: float = 0.02):
+                 name_prefix: str = "r", poll_s: float = 0.02,
+                 obs: bool = False, crash_dir: Optional[str] = None,
+                 ring_capacity: int = 512):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self._factory = engine_factory
         self.clock = clock
         self.metrics = FleetMetrics()
+        # observability (quintnet_tpu/obs/): ``obs=True`` arms ONE
+        # fleet-wide Tracer (engines share the address space, so every
+        # replica engine records into it directly — one merged
+        # timeline per trace id), a per-engine StepRecorder ring, and
+        # the typed EventLog. On a replica death the affected ring +
+        # spans become an in-memory post-mortem (``last_crash``) and,
+        # with ``crash_dir`` set, a crash-dump file. All of it is
+        # inert: tracing on is token-bit-identical to tracing off.
+        self._obs = bool(obs)
+        self.crash_dir = crash_dir
+        self._ring_capacity = int(ring_capacity)
+        self.tracer = None
+        self.events = None
+        if self._obs:
+            from quintnet_tpu.obs import EventLog, Tracer
+
+            self.tracer = Tracer(clock=clock)
+            self.events = EventLog(clock=clock)
+        self.crash_dumps: List[str] = []     # paths written (crash_dir)
+        self.last_crash: Optional[Dict] = None
+        self._pending_dumps: List[Dict] = []  # snapshotted under the
+        #   lock at death; WRITTEN by the dispatcher outside it — a
+        #   disk write must never stall token delivery
+        self._breaker_seen: Dict[str, str] = {}
         self._router = Router(policy)
         self._cv = threading.Condition()
         self._queue = AdmissionQueue(max_pending, clock=clock)
@@ -233,10 +270,35 @@ class ServeFleet:
         self._dispatcher.start()
 
     def _spawn(self, name: str, chaos) -> Replica:
-        return Replica(name, self._factory, chaos=chaos,
-                       max_dispatch=self._max_dispatch,
-                       on_finish=self._on_finish, on_death=self._on_death,
-                       on_reject=self._on_reject, poll_s=self._poll_s)
+        rep = Replica(name, self._factory, chaos=chaos,
+                      max_dispatch=self._max_dispatch,
+                      on_finish=self._on_finish, on_death=self._on_death,
+                      on_reject=self._on_reject, poll_s=self._poll_s)
+        if self._obs:
+            from quintnet_tpu.obs import StepRecorder
+
+            # shared tracer (one address space, one merged timeline);
+            # per-engine flight-recorder ring (the replica's black box)
+            rep.engine.tracer = self.tracer
+            rep.engine.recorder = StepRecorder(
+                capacity=self._ring_capacity, clock=rep.engine.clock)
+        return rep
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(kind, **fields)
+
+    def _note_breaker(self, name: str) -> None:
+        """Emit a typed event when a breaker's state CHANGED since the
+        fleet last looked — transitions are driven from several sites
+        (failure, success, restart gating), so the edge detection
+        lives here instead of inside the breaker."""
+        if self.events is None:
+            return
+        st = self._breakers[name].state
+        if self._breaker_seen.get(name, "closed") != st:
+            self._breaker_seen[name] = st
+            self.events.emit("breaker", replica=name, state=st)
 
     # ------------------------------------------------------------------
     # submission / results
@@ -308,7 +370,12 @@ class ServeFleet:
                 deadline=(None if deadline_s is None
                           else now + float(deadline_s)),
                 on_token=on_token, submit_time=now, clock=self.clock,
-                adapter_id=adapter_id)
+                adapter_id=adapter_id, trace_id=f"f{fid}")
+            if self.tracer is not None:
+                self.tracer.event(freq.trace_id, "fleet_submit",
+                                  fid=fid, prompt_len=int(prompt.size),
+                                  max_new_tokens=int(max_new_tokens),
+                                  adapter_id=adapter_id)
             try:
                 self._queue.push(freq)
             except Overloaded:
@@ -364,6 +431,7 @@ class ServeFleet:
             rep.in_flight -= 1
             rep.outstanding_tokens -= freq.cost
             self._breakers[rep.name].record_success()
+            self._note_breaker(rep.name)
             freq.output = output
             freq.finish_time = self.clock()
             self.metrics.finished += 1
@@ -390,6 +458,9 @@ class ServeFleet:
             rep.outstanding_tokens -= freq.cost
             if isinstance(error, DeadlineExceeded):
                 self.metrics.deadline_exceeded += 1
+                self._emit("deadline_exceeded", fid=freq.fid,
+                           trace_id=freq.trace_id, replica=rep.name,
+                           generated=error.generated)
             elif (isinstance(error, Overloaded)
                     and error.reason == "deadline"):
                 self.metrics.shed_deadline += 1
@@ -403,6 +474,7 @@ class ServeFleet:
         with self._cv:
             self.metrics.replica_deaths += 1
             self._breakers[rep.name].record_failure()
+            self._note_breaker(rep.name)
             self._retired_metrics.append(rep.engine.metrics)
             rep.in_flight = 0
             rep.outstanding_tokens = 0
@@ -410,6 +482,11 @@ class ServeFleet:
             # racing the death can have landed one more inbox item
             # since — re-drain under the lock enqueues are made under
             exports = list(exports) + rep.drain_inbox()
+            self._emit("replica_death", replica=rep.name,
+                       error=f"{type(error).__name__}: {error}",
+                       in_flight=len(exports))
+            self._record_crash(rep, reason="death", error=error,
+                               affected=[f for f, _p in exports])
             migrated = []
             for freq, prog in sorted(exports, key=lambda e: e[0].fid):
                 if prog is not None:
@@ -421,9 +498,57 @@ class ServeFleet:
                     continue
                 freq.migrations += 1
                 self.metrics.migrations += 1
+                self._emit("migration", fid=freq.fid,
+                           trace_id=freq.trace_id,
+                           from_replica=rep.name,
+                           committed=len(freq.committed))
+                if self.tracer is not None:
+                    self.tracer.event(freq.trace_id, "migration",
+                                      from_replica=rep.name,
+                                      committed=len(freq.committed))
                 migrated.append(freq)
             self._queue.push_front(migrated)
             self._cv.notify_all()
+
+    def _record_crash(self, rep, *, reason: str, error, affected) -> None:
+        """The black box, thread-fleet flavor: the dead engine's ring
+        and the affected requests' spans survive in THIS address
+        space — freeze them into ``last_crash`` before migration
+        rewrites anything. With ``crash_dir`` set the payload is
+        QUEUED here (lock held) and written by the dispatcher OUTSIDE
+        the lock (:meth:`_write_dumps`): file IO must never stall
+        token delivery."""
+        if not self._obs:
+            return
+        recorder = getattr(rep.engine, "recorder", None)
+        ring = recorder.snapshot() if recorder is not None else []
+        tids = [f.trace_id for f in affected if f.trace_id]
+        traces = (self.tracer.snapshot(tids)
+                  if self.tracer is not None else {})
+        requests = [{"fid": f.fid, "trace_id": f.trace_id,
+                     "committed": len(f.committed),
+                     "migrations": f.migrations,
+                     "adapter_id": f.adapter_id} for f in affected]
+        self.last_crash = {
+            "replica": rep.name, "reason": reason,
+            "error": f"{type(error).__name__}: {error}",
+            "ring": ring, "traces": traces, "requests": requests,
+        }
+        if self.crash_dir is not None:
+            self._pending_dumps.append(dict(
+                self.last_crash,
+                events=(self.events.snapshot(last=64)
+                        if self.events is not None else [])))
+
+    def _write_dumps(self, pending: List[Dict]) -> None:
+        """Write queued crash dumps (called WITHOUT the fleet lock)."""
+        from quintnet_tpu.obs import write_crash_dump
+
+        for spec in pending:
+            path = write_crash_dump(self.crash_dir, **spec)
+            self.crash_dumps.append(path)
+            self._emit("crash_dump", replica=spec["replica"],
+                       path=path)
 
     # ------------------------------------------------------------------
     # dispatcher
@@ -434,6 +559,8 @@ class ServeFleet:
             self.metrics.shed_deadline += 1
         else:
             self.metrics.shed_shutdown += 1
+        self._emit("shed", fid=freq.fid, trace_id=freq.trace_id,
+                   reason=reason)
         freq.error = Overloaded(reason, message)
         self._open -= 1
         freq.event.set()
@@ -443,13 +570,16 @@ class ServeFleet:
         for i, rep in enumerate(self._replicas):
             if rep.state != DEAD:
                 continue
-            if not self._breakers[rep.name].allow_restart():
+            allowed = self._breakers[rep.name].allow_restart()
+            self._note_breaker(rep.name)
+            if not allowed:
                 continue
             chaos = rep.chaos
             if chaos is not None and getattr(chaos, "rearm", False):
                 chaos.rearm_now()
             self._replicas[i] = self._spawn(rep.name, chaos)
             self.metrics.restarts += 1
+            self._emit("replica_restart", replica=rep.name)
 
     def _dispatch_locked(self) -> None:
         for freq in self._queue.shed_expired():
@@ -471,6 +601,12 @@ class ServeFleet:
             freq.replica_name = rep.name
             rep.in_flight += 1
             rep.outstanding_tokens += freq.cost
+            if self.tracer is not None:
+                self.tracer.add(freq.trace_id, "fleet_queue",
+                                t0=freq.submit_time, t1=self.clock(),
+                                migrations=freq.migrations)
+                self.tracer.event(freq.trace_id, "dispatch",
+                                  replica=rep.name)
             rep.enqueue(freq, freq.progress)
 
     def _dispatch_loop(self) -> None:
@@ -480,7 +616,11 @@ class ServeFleet:
                     return
                 self._tend_replicas_locked()
                 self._dispatch_locked()
-                self._cv.wait(self._poll_s)
+                pending, self._pending_dumps = self._pending_dumps, []
+                if not pending:
+                    self._cv.wait(self._poll_s)
+            if pending:
+                self._write_dumps(pending)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -516,6 +656,7 @@ class ServeFleet:
         deadline = None if timeout is None else self.clock() + timeout
         with self._cv:
             self._draining = True
+            self._emit("drain", open_requests=self._open)
             self._cv.notify_all()
             while self._open > 0:
                 if deadline is not None and self.clock() >= deadline:
@@ -534,6 +675,7 @@ class ServeFleet:
                 return
             self._draining = True
             self._closed = True
+            self._emit("close", open_requests=self._open)
             for freq in self._queue.drain_all():
                 self._shed_locked(freq, "shutdown",
                                   "fleet closed before dispatch")
@@ -548,6 +690,8 @@ class ServeFleet:
                         self._shed_locked(
                             freq, "shutdown",
                             "fleet closed with the request in flight")
+            pending, self._pending_dumps = self._pending_dumps, []
+        self._write_dumps(pending)   # dumps a closing race queued
 
     # ------------------------------------------------------------------
     # introspection
@@ -588,6 +732,14 @@ class ServeFleet:
                 rep.steps = 0
                 rep.engine.metrics = type(rep.engine.metrics)(
                     clock=rep.engine.clock)
+
+    def engine_summaries(self) -> Dict[str, Dict]:
+        """Per-replica ``ServeMetrics.summary()`` dicts (the front
+        door's /metrics and /v1/metrics surface — shape-compatible
+        with :meth:`ProcessFleet.engine_summaries`)."""
+        with self._cv:
+            return {rep.name: rep.engine.metrics.summary()
+                    for rep in self._replicas}
 
     def engine_summary(self) -> Dict:
         """serve.metrics.aggregate over every engine that served this

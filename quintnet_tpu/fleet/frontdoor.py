@@ -40,8 +40,18 @@ Endpoints:
   rejection; tokens already streamed stand).
 - ``GET /healthz`` — cheap liveness snapshot (``fleet.health()``);
   200 while any replica serves, 503 when none can.
-- ``GET /v1/metrics`` — the fleet's front-door counters
-  (``FleetMetrics.summary()``).
+- ``GET /v1/metrics`` — JSON (explicit ``application/json``): the
+  fleet's front-door counters (``FleetMetrics.summary()``) under
+  ``"frontdoor"`` plus each replica engine's
+  ``ServeMetrics.summary()`` under ``"engine_summary"`` (shipped over
+  the process fleet's existing stats frame — no second accounting
+  path).
+- ``GET /metrics`` — the SAME ledgers in Prometheus text exposition
+  format (``text/plain; version=0.0.4``; quintnet_tpu/obs/prom.py):
+  ``quintnet_fleet_*`` counters, ``quintnet_engine_*{replica="..."}``
+  per-replica series, ``quintnet_replica_up`` liveness — every
+  existing counter scrapeable as a time series. Kept separate from
+  ``/v1/metrics``: one path per format, both read-only.
 
 Works identically over a thread :class:`ServeFleet` and a process
 :class:`ProcessFleet` — both expose submit/result/health with the
@@ -165,8 +175,9 @@ class FrontDoor:
             if path == "/healthz" and method == "GET":
                 await self._healthz(writer)
             elif path == "/v1/metrics" and method == "GET":
-                await self._respond(writer, 200,
-                                    self.fleet.metrics.summary())
+                await self._v1_metrics(writer)
+            elif path == "/metrics" and method == "GET":
+                await self._prometheus(writer)
             elif path == "/v1/generate":
                 if method != "POST":
                     await self._respond(
@@ -239,6 +250,42 @@ class FrontDoor:
 
     def _retry_after(self) -> str:
         return str(int(math.ceil(self.retry_after_s)))
+
+    def _engine_summaries(self) -> Dict:
+        """Per-replica engine summaries. For the process fleet this is
+        an RPC fan-out over the stats frames, so callers run it in an
+        executor — the event loop must keep streaming tokens while a
+        slow replica answers (or times out)."""
+        getter = getattr(self.fleet, "engine_summaries", None)
+        return getter() if getter is not None else {}
+
+    async def _v1_metrics(self, writer) -> None:
+        loop = asyncio.get_running_loop()
+        engines = await loop.run_in_executor(None,
+                                             self._engine_summaries)
+        await self._respond(writer, 200,
+                            {"frontdoor": self.fleet.metrics.summary(),
+                             "engine_summary": engines})
+
+    async def _prometheus(self, writer) -> None:
+        """Prometheus text exposition over the existing ledgers
+        (obs/prom.py renders; nothing new is counted here)."""
+        from quintnet_tpu.obs.prom import render_exposition
+
+        loop = asyncio.get_running_loop()
+        engines = await loop.run_in_executor(None,
+                                             self._engine_summaries)
+        text = render_exposition(self.fleet.metrics.summary(), engines,
+                                 health=self.fleet.health())
+        data = text.encode("utf-8")
+        head = ["HTTP/1.1 200 OK",
+                "Content-Type: text/plain; version=0.0.4; "
+                "charset=utf-8",
+                f"Content-Length: {len(data)}",
+                "Connection: close"]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+                     + data)
+        await writer.drain()
 
     def _error_response(self, e: BaseException) -> Tuple[int, Dict,
                                                          Dict]:

@@ -100,7 +100,8 @@ def replica_main(name: str, host: str, port: int, token: str,
                  engine_spec: Dict, *, heartbeat_s: float = 0.1,
                  chaos_spec: Optional[Dict] = None,
                  platform: Optional[str] = None,
-                 poll_s: float = 0.005) -> None:
+                 poll_s: float = 0.005, obs: bool = False,
+                 ring_capacity: int = 512) -> None:
     """Entry point of a replica process (multiprocessing 'spawn'
     target). Builds the engine from ``engine_spec``, connects back to
     the dispatcher at ``(host, port)``, identifies itself with
@@ -117,6 +118,19 @@ def replica_main(name: str, host: str, port: int, token: str,
     from quintnet_tpu.ft.chaos import ChaosMonkey
 
     engine = _load_builder(engine_spec)(**engine_spec.get("kwargs", {}))
+    if obs:
+        # flight recorder + tracer attached AFTER the builder ran (the
+        # spec is user code that predates obs); both are inert — the
+        # ring's fresh records piggyback on heartbeat frames so the
+        # dispatcher's mirror is this replica's black box when a
+        # SIGKILL leaves no one to ask (quintnet_tpu/obs/)
+        from quintnet_tpu.obs import StepRecorder, Tracer
+
+        if engine.recorder is None:
+            engine.recorder = StepRecorder(capacity=ring_capacity,
+                                           clock=engine.clock)
+        if engine.tracer is None:
+            engine.tracer = Tracer(clock=engine.clock)
     chaos = ChaosMonkey(**chaos_spec) if chaos_spec else None
 
     sock = socket.create_connection((host, port), timeout=30.0)
@@ -144,12 +158,20 @@ def replica_main(name: str, host: str, port: int, token: str,
     def heartbeat() -> None:
         # a dedicated thread so heartbeats keep flowing through long
         # engine.step() calls (first-touch XLA compiles take seconds);
-        # only a genuine wedge — or the stall injector — silences them
+        # only a genuine wedge — or the stall injector — silences them.
+        # Fresh flight-recorder records ride along: the dispatcher's
+        # ring mirror stays as current as the last beat, which is what
+        # "last-known" means when this process is later SIGKILLed.
         while not stop_ev.wait(heartbeat_s):
             if chaos is not None and chaos.stalled:
                 continue
+            frame = {"t": "hb", "steps": steps[0]}
+            if engine.recorder is not None:
+                recs = engine.recorder.drain_new()
+                if recs:
+                    frame["rec"] = recs
             try:
-                send({"t": "hb", "steps": steps[0]})
+                send(frame)
             except OSError:
                 return
 
@@ -192,6 +214,17 @@ def replica_main(name: str, host: str, port: int, token: str,
                   "compile": engine.compile_counts(),
                   "metrics": engine.metrics.summary(),
                   "admitted": engine.metrics.admitted})
+        elif t == "trace":
+            # the replica's span log (obs/trace.py), optionally
+            # restricted to specific trace ids — how the dispatcher
+            # shows a migrated request's spans CONTINUING on the
+            # destination replica under the same id
+            ids = cmd.get("trace_ids")
+            send({"t": "trace", "id": cmd["id"],
+                  "traces": (engine.tracer.snapshot(ids)
+                             if engine.tracer is not None else {}),
+                  "ring": (engine.recorder.snapshot()
+                           if engine.recorder is not None else [])})
         elif t == "warmup":
             engine.warmup()
             send({"t": "ack", "id": cmd["id"]})
@@ -295,6 +328,18 @@ class ProcReplica:
         self.restart_at: Optional[float] = None   # set on death/stall
         self.migrated = False     # this incarnation's work already moved
         self.error: Optional[BaseException] = None
+        # the dispatcher-side flight-recorder MIRROR: step records the
+        # child piggybacked on its heartbeats (obs/recorder.py). When
+        # the child is SIGKILLed this is its last-known ring — the
+        # crash dump's black box, no cooperation from the corpse.
+        # Its own lock, NOT the fleet lock: the reader thread appends
+        # on every heartbeat while the dispatcher snapshots at death —
+        # iterating a deque another thread is appending to raises
+        # RuntimeError, so both sides go through the lock below.
+        from collections import deque
+
+        self.ring = deque(maxlen=fleet._ring_capacity)
+        self._ring_lock = threading.Lock()
         self._fid2freq: Dict[int, FleetRequest] = {}
         # adapters this incarnation has been sent (affinity heuristic:
         # the child's registry loaded them on first use; its own LRU
@@ -312,7 +357,9 @@ class ProcReplica:
             args=(name, *fleet._address, self.token, fleet.engine_spec),
             kwargs={"heartbeat_s": fleet.heartbeat_s,
                     "chaos_spec": chaos_spec,
-                    "platform": fleet.platform},
+                    "platform": fleet.platform,
+                    "obs": fleet._obs,
+                    "ring_capacity": fleet._ring_capacity},
             name=f"fleet-{name}", daemon=True)
         self.proc.start()
 
@@ -357,6 +404,14 @@ class ProcReplica:
             pending, self._pending = self._pending, {}
         for ev, _slot in pending.values():
             ev.set()
+
+    def ring_extend(self, recs) -> None:
+        with self._ring_lock:
+            self.ring.extend(recs)
+
+    def ring_snapshot(self) -> List[Dict]:
+        with self._ring_lock:
+            return list(self.ring)
 
     def adapter_resident(self, adapter_id: str) -> bool:
         return adapter_id in self._adapters_seen
@@ -455,12 +510,37 @@ class ProcessFleet:
                  platform: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
                  name_prefix: str = "p", poll_s: float = 0.02,
-                 spawn_timeout_s: float = 300.0):
+                 spawn_timeout_s: float = 300.0,
+                 obs: bool = False, crash_dir: Optional[str] = None,
+                 ring_capacity: int = 512):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.engine_spec = dict(engine_spec)
         self.platform = platform
         self.clock = clock
+        # observability (quintnet_tpu/obs/): ``obs=True`` arms a
+        # PARENT-side tracer (queue/dispatch/migration spans — child
+        # engines keep their own, fetched via the ``trace`` RPC or
+        # merged into crash dumps), the typed EventLog, and the
+        # heartbeat-mirrored per-replica flight-recorder ring that
+        # makes a SIGKILL'd child's last-known steps dumpable with
+        # zero cooperation from the corpse.
+        self._obs = bool(obs)
+        self.crash_dir = crash_dir
+        self._ring_capacity = int(ring_capacity)
+        self.tracer = None
+        self.events = None
+        if self._obs:
+            from quintnet_tpu.obs import EventLog, Tracer
+
+            self.tracer = Tracer(clock=clock)
+            self.events = EventLog(clock=clock)
+        self.crash_dumps: List[str] = []
+        self.last_crash: Optional[Dict] = None
+        self._pending_dumps: List[Dict] = []  # snapshotted under the
+        #   lock at death; WRITTEN by the dispatcher outside it — a
+        #   disk write must never stall token delivery
+        self._breaker_seen: Dict[str, str] = {}
         self.heartbeat_s = float(heartbeat_s)
         # default budget: generous vs the beat period (the beat thread
         # is immune to compiles, so 10 periods of silence means wedged,
@@ -594,6 +674,20 @@ class ProcessFleet:
                         f"{self.engine_spec.get('file') or self.engine_spec.get('module')}")
                 self._cv.wait(0.05)
 
+    def _emit(self, kind: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(kind, **fields)
+
+    def _note_breaker(self, name: str) -> None:
+        """Typed event on a breaker state CHANGE (edge-detected here —
+        transitions are driven from failure/success/restart sites)."""
+        if self.events is None:
+            return
+        st = self._breakers[name].state
+        if self._breaker_seen.get(name, "closed") != st:
+            self._breaker_seen[name] = st
+            self.events.emit("breaker", replica=name, state=st)
+
     @property
     def limits(self) -> Dict:
         """The shared engine limits (all replicas are built from one
@@ -646,7 +740,12 @@ class ProcessFleet:
                 deadline=(None if deadline_s is None
                           else now + float(deadline_s)),
                 on_token=on_token, submit_time=now, clock=self.clock,
-                adapter_id=adapter_id)
+                adapter_id=adapter_id, trace_id=f"f{fid}")
+            if self.tracer is not None:
+                self.tracer.event(freq.trace_id, "fleet_submit",
+                                  fid=fid, prompt_len=int(prompt.size),
+                                  max_new_tokens=int(max_new_tokens),
+                                  adapter_id=adapter_id)
             # the journal's key anchor: the submit key as raw data —
             # advancing it one split per journaled token reconstructs
             # any later chain state host-side (no device in the child
@@ -727,7 +826,8 @@ class ProcessFleet:
             max_new_tokens=freq.max_new_tokens,
             priority=freq.priority,
             preemptions=0, adapter_id=freq.adapter_id,
-            deadline_s=freq.remaining_deadline())
+            deadline_s=freq.remaining_deadline(),
+            trace_id=freq.trace_id)
 
     # ------------------------------------------------------------------
     # frame handling (replica reader threads)
@@ -753,10 +853,14 @@ class ProcessFleet:
                 if freq is None:
                     return
                 self._tokens_delivered += 1
+                first = freq.first_token_time is None
                 # deliver() is THE journal-then-forward discipline
                 # (fleet/fleet.py), client-callback faults isolated
                 # there — one implementation for both fleets
                 freq.deliver(tok, last)
+                if first and self.tracer is not None:
+                    self.tracer.event(freq.trace_id, "first_token",
+                                      replica=rep.name)
         elif t == "fin":
             self._finish(rep, frame["fid"])
         elif t in ("failed", "reject"):
@@ -765,6 +869,12 @@ class ProcessFleet:
         elif t == "hb":
             rep.hb.beat()
             rep.steps = int(frame.get("steps", rep.steps))
+            # flight-recorder mirror: the child's fresh step records
+            # ride its heartbeats (ring-lock-guarded — the dump path
+            # snapshots from the dispatcher thread concurrently)
+            recs = frame.get("rec")
+            if recs:
+                rep.ring_extend(recs)
         elif t == "death":
             # cooperative death (an in-child raise): same handling as
             # a connection loss; the export rides along but the
@@ -789,6 +899,7 @@ class ProcessFleet:
             rep.in_flight -= 1
             rep.outstanding_tokens -= freq.cost
             self._breakers[rep.name].record_success()
+            self._note_breaker(rep.name)
         # the journal IS the output: prompt + every streamed token
         freq.output = np.concatenate(
             [freq.prompt, np.asarray(freq.committed, np.int32)])
@@ -815,6 +926,9 @@ class ProcessFleet:
             rep.outstanding_tokens -= freq.cost
             if isinstance(error, DeadlineExceeded):
                 self.metrics.deadline_exceeded += 1
+                self._emit("deadline_exceeded", fid=freq.fid,
+                           trace_id=freq.trace_id, replica=rep.name,
+                           generated=error.generated)
             elif (isinstance(error, Overloaded)
                     and error.reason == "deadline"):
                 self.metrics.shed_deadline += 1
@@ -857,13 +971,68 @@ class ProcessFleet:
             self.metrics.stalls += 1
         else:
             self.metrics.replica_deaths += 1
+        self._emit("replica_stall" if stalled else "replica_death",
+                   replica=rep.name, pid=rep.pid,
+                   steps=rep.steps, in_flight=len(rep._fid2freq),
+                   error=(None if rep.error is None
+                          else f"{type(rep.error).__name__}: "
+                               f"{rep.error}"))
+        self._record_crash_locked(rep,
+                                  reason="stall" if stalled
+                                  else "death")
         breaker = self._breakers[rep.name]
         breaker.record_failure()
+        self._note_breaker(rep.name)
         rep.restart_at = (self.clock()
                           + self.backoff.delay_s(
                               breaker.consecutive_failures))
         self._migrate_locked(rep)
         self._cv.notify_all()
+
+    def _record_crash_locked(self, rep: ProcReplica, *,
+                             reason: str) -> None:
+        """The black box, process-fleet flavor (fleet lock held, rep's
+        ``_fid2freq`` not yet cleared): everything here is
+        DISPATCHER-side state — the heartbeat-mirrored ring, the
+        parent tracer's spans for the in-flight requests, the
+        journal's per-request account — because the corpse cannot be
+        asked for anything. The payload is QUEUED under the lock and
+        written by the dispatch loop OUTSIDE it
+        (:meth:`_write_dumps`): file IO must never stall token
+        delivery."""
+        if not self._obs:
+            return
+        affected = sorted(rep._fid2freq.values(), key=lambda f: f.fid)
+        ring = rep.ring_snapshot()
+        tids = [f.trace_id for f in affected if f.trace_id]
+        traces = (self.tracer.snapshot(tids)
+                  if self.tracer is not None else {})
+        requests = [{"fid": f.fid, "trace_id": f.trace_id,
+                     "committed": len(f.committed),
+                     "migrations": f.migrations,
+                     "adapter_id": f.adapter_id} for f in affected]
+        err = (None if rep.error is None
+               else f"{type(rep.error).__name__}: {rep.error}")
+        self.last_crash = {
+            "replica": rep.name, "reason": reason, "error": err,
+            "ring": ring, "traces": traces, "requests": requests,
+        }
+        if self.crash_dir is not None:
+            self._pending_dumps.append(dict(
+                self.last_crash,
+                events=(self.events.snapshot(last=64)
+                        if self.events is not None else []),
+                extra={"pid": rep.pid, "steps": rep.steps}))
+
+    def _write_dumps(self, pending: List[Dict]) -> None:
+        """Write queued crash dumps (called WITHOUT the fleet lock)."""
+        from quintnet_tpu.obs import write_crash_dump
+
+        for spec in pending:
+            path = write_crash_dump(self.crash_dir, **spec)
+            self.crash_dumps.append(path)
+            self._emit("crash_dump", replica=spec["replica"],
+                       path=path)
 
     def _migrate_locked(self, rep: ProcReplica) -> None:
         exports = sorted(rep._fid2freq.items())
@@ -884,6 +1053,14 @@ class ProcessFleet:
                 continue
             freq.migrations += 1
             self.metrics.migrations += 1
+            self._emit("migration", fid=freq.fid,
+                       trace_id=freq.trace_id,
+                       from_replica=rep.name,
+                       committed=len(freq.committed))
+            if self.tracer is not None:
+                self.tracer.event(freq.trace_id, "migration",
+                                  from_replica=rep.name,
+                                  committed=len(freq.committed))
             migrated.append(freq)
         self._queue.push_front(migrated)
 
@@ -912,13 +1089,16 @@ class ProcessFleet:
                 continue
             if rep.restart_at is not None and now < rep.restart_at:
                 continue
-            if not self._breakers[rep.name].allow_restart():
+            allowed = self._breakers[rep.name].allow_restart()
+            self._note_breaker(rep.name)
+            if not allowed:
                 continue
             chaos_spec = rep.chaos_spec
             if not (chaos_spec or {}).get("rearm", False):
                 chaos_spec = None   # one-shot faults do not respawn
             self._replicas[i] = ProcReplica(rep.name, self, chaos_spec)
             self.metrics.restarts += 1
+            self._emit("replica_restart", replica=rep.name)
 
     # ------------------------------------------------------------------
     # dispatcher
@@ -934,6 +1114,8 @@ class ProcessFleet:
             self.metrics.shed_deadline += 1
         else:
             self.metrics.shed_shutdown += 1
+        self._emit("shed", fid=freq.fid, trace_id=freq.trace_id,
+                   reason=reason)
         freq.error = Overloaded(reason, message)
         self._open -= 1
         freq.event.set()
@@ -964,6 +1146,12 @@ class ProcessFleet:
         rep.outstanding_tokens += freq.cost
         if freq.adapter_id is not None:
             rep._adapters_seen.add(freq.adapter_id)
+        if self.tracer is not None:
+            self.tracer.add(freq.trace_id, "fleet_queue",
+                            t0=freq.submit_time, t1=self.clock(),
+                            migrations=freq.migrations)
+            self.tracer.event(freq.trace_id, "dispatch",
+                              replica=rep.name)
         return rep, freq
 
     def _dispatch_loop(self) -> None:
@@ -972,10 +1160,15 @@ class ProcessFleet:
                 if self._closed:
                     return
                 self._tend_locked()
+                pending, self._pending_dumps = self._pending_dumps, []
                 job = self._reserve_dispatch_locked()
-                if job is None:
+                if job is None and not pending:
                     self._cv.wait(self._poll_s)
                     continue
+            if pending:
+                self._write_dumps(pending)
+            if job is None:
+                continue
             rep, freq = job
             # payload construction OUTSIDE the lock: the key replay is
             # one jax split per journaled token — a long-lived
@@ -1069,6 +1262,33 @@ class ProcessFleet:
         frames = self.replica(name).rpc({"t": "export"}, timeout=60.0)
         return [wire.progress_from_wire(p) for p in frames["progress"]]
 
+    def replica_traces(self, name: str, trace_ids=None) -> Dict:
+        """A LIVE replica's span log over the wire (obs/trace.py
+        snapshot, optionally restricted to ``trace_ids``) — how the
+        dispatcher verifies a migrated request's spans CONTINUE on the
+        destination under the trace id the journal carried. Dead
+        replicas' engine-side spans died with their process — their
+        black box is the heartbeat-mirrored ring in the crash dump."""
+        f = self.replica(name).rpc(
+            {"t": "trace",
+             "trace_ids": (None if trace_ids is None
+                           else list(trace_ids))}, timeout=60.0)
+        return f["traces"]
+
+    def replica_ring(self, name: str) -> List[Dict]:
+        """A LIVE replica's own flight-recorder ring over the wire
+        (the authoritative copy; the parent mirror lags one beat)."""
+        f = self.replica(name).rpc({"t": "trace", "trace_ids": []},
+                                   timeout=60.0)
+        return f["ring"]
+
+    def engine_summaries(self) -> Dict[str, Dict]:
+        """Per-LIVE-replica ``ServeMetrics.summary()`` dicts — the
+        front door's /metrics and /v1/metrics surface
+        (frontdoor.py); the same stats frame replica_stats reads."""
+        return {name: s["metrics"]
+                for name, s in self.replica_stats().items()}
+
     def drain(self, *, timeout: Optional[float] = None) -> None:
         """Graceful shutdown, the last rungs of the degradation ladder:
         refuse new work (shed typed), let everything accepted finish —
@@ -1076,6 +1296,7 @@ class ProcessFleet:
         deadline = None if timeout is None else self.clock() + timeout
         with self._cv:
             self._draining = True
+            self._emit("drain", open_requests=self._open)
             self._cv.notify_all()
             while self._open > 0:
                 if deadline is not None and self.clock() >= deadline:
@@ -1091,6 +1312,7 @@ class ProcessFleet:
                 return
             self._draining = True
             self._closed = True
+            self._emit("close", open_requests=self._open)
             for freq in self._queue.drain_all():
                 self._shed_locked(freq, "shutdown",
                                   "fleet closed before dispatch")
@@ -1120,6 +1342,8 @@ class ProcessFleet:
                 # emptied so a trailing EOF handler sees nothing left
                 # to migrate or re-shed
                 rep._fid2freq = {}
+            pending, self._pending_dumps = self._pending_dumps, []
+        self._write_dumps(pending)   # dumps a closing race queued
 
     # ------------------------------------------------------------------
     # introspection

@@ -156,7 +156,8 @@ class Replica:
             rid = self.engine.submit(
                 freq.prompt, freq.max_new_tokens, key=freq.key,
                 priority=freq.priority, on_token=deliver,
-                adapter_id=freq.adapter_id, deadline_s=deadline_s)
+                adapter_id=freq.adapter_id, deadline_s=deadline_s,
+                trace_id=getattr(freq, "trace_id", None))
         else:
             # progress carries the adapter binding; restore re-pins it
             # from THIS replica's registry (loading on a cold replica)

@@ -136,6 +136,11 @@ def progress_to_wire(p) -> Dict:
         "deadline_s": (None if p.deadline_s is None
                        else float(p.deadline_s)),
         "prefilled": int(p.prefilled),
+        # observability identity (quintnet_tpu/obs/): carried so the
+        # destination replica's spans continue the source's timeline.
+        # Optional and inert — absent on pre-obs payloads, never
+        # touches the resume math — so WIRE_VERSION stays unchanged.
+        "trace_id": p.trace_id,
     }
 
 
@@ -157,7 +162,8 @@ def progress_from_wire(payload: Dict):
         deadline_s=payload.get("deadline_s"),
         # chunked-prefill high-water mark (serve/longctx.py) —
         # informational; absent on pre-longctx payloads
-        prefilled=int(payload.get("prefilled", 0)))
+        prefilled=int(payload.get("prefilled", 0)),
+        trace_id=payload.get("trace_id"))
 
 
 # ---------------------------------------------------------------------------

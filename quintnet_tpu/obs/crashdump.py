@@ -1,0 +1,89 @@
+"""Crash-dump forensics: the fleet's black box file.
+
+When a replica dies or stalls, the dispatcher already knows three
+things the corpse can no longer tell anyone: the last step records it
+shipped (the heartbeat-mirrored ring, fleet/proc.py — or the engine's
+own ring for thread replicas, whose address space survives), the spans
+of every request that was in flight there, and the fleet lifecycle
+events leading up to the death. :func:`write_crash_dump` freezes all
+three into one JSON post-mortem file at the moment of death — BEFORE
+migration rewrites the routing state — so "why did p1 die at step 847
+and what was it doing" has an artifact, not a shrug.
+
+The file is one JSON object (versioned, like every wire payload in
+this codebase) so ``tools/trace_view.py`` can render the embedded ring
++ spans straight into Perfetto, and tests can assert on structure
+instead of scraping logs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+DUMP_VERSION = 1
+
+# process-wide monotone dump counter: two deaths in the same second
+# (chaos tests do this on purpose) must not clobber each other's file
+_seq = itertools.count()
+_seq_lock = threading.Lock()
+
+
+def write_crash_dump(dir_path: str, *, replica: str, reason: str,
+                     error: Optional[str] = None,
+                     ring: Optional[List[Dict]] = None,
+                     traces: Optional[Dict[str, List[Dict]]] = None,
+                     events: Optional[List[Dict]] = None,
+                     requests: Optional[List[Dict]] = None,
+                     extra: Optional[Dict] = None) -> str:
+    """Write one post-mortem file; returns its path.
+
+    ``reason`` is ``"death"`` or ``"stall"``; ``ring`` the replica's
+    last-known step records (oldest first); ``traces`` the affected
+    requests' span snapshot (``Tracer.snapshot``); ``events`` the
+    recent fleet lifecycle events; ``requests`` per-request summaries
+    (fid, trace id, tokens committed, migrations) the dispatcher's
+    journal knows without any cooperation from the corpse."""
+    os.makedirs(dir_path, exist_ok=True)
+    with _seq_lock:
+        n = next(_seq)
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    path = os.path.join(dir_path,
+                        f"crash_{replica}_{stamp}_{n:04d}.json")
+    payload = {
+        "kind": "crash_dump",
+        "v": DUMP_VERSION,
+        "replica": replica,
+        "reason": reason,
+        "error": error,
+        "written_at": time.time(),
+        "ring": list(ring or []),
+        "traces": {k: list(v) for k, v in (traces or {}).items()},
+        "events": list(events or []),
+        "requests": list(requests or []),
+        "extra": dict(extra or {}),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)      # atomic: a reader never sees half a dump
+    return path
+
+
+def load_crash_dump(path: str) -> Dict:
+    """Read + validate one dump (version-checked, like the wire)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("kind") != "crash_dump":
+        raise ValueError(
+            f"{path} is not a crash dump (kind="
+            f"{payload.get('kind')!r})")
+    if payload.get("v") != DUMP_VERSION:
+        raise ValueError(
+            f"{path} is crash-dump version {payload.get('v')!r}; this "
+            f"build reads {DUMP_VERSION}")
+    return payload

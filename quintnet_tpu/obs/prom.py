@@ -1,0 +1,216 @@
+"""Prometheus text exposition over the serving ledgers.
+
+The fleet already keeps every number an operator wants —
+``FleetMetrics.summary()`` at the front door, ``ServeMetrics.summary()``
+per replica engine (shipped over the process fleet's stats frame) —
+as nested JSON-able dicts. This module renders those dicts in the
+Prometheus text exposition format (version 0.0.4: ``# HELP`` /
+``# TYPE`` comments, ``name{label="v"} value`` samples) so
+``GET /metrics`` on the front door turns every existing ledger into a
+scrapeable time series without inventing a second accounting path.
+
+Flattening rules (mechanical, so new ledger fields become metrics with
+zero code changes here):
+
+- numeric scalars at the top level -> one sample,
+  ``quintnet_fleet_<key>`` (front door) or
+  ``quintnet_engine_<key>{replica="<name>"}`` (per-replica engines);
+- percentile dicts (``{"p50": .., "p95": .., "p99": .., "n": ..}``) ->
+  one sample per quantile with a ``quantile`` label, plus a
+  ``<key>_count`` sample from ``n`` when present;
+- the per-adapter ledger -> per-adapter-labeled samples of its numeric
+  fields;
+- non-numeric leaves (state strings, nested config) are skipped —
+  exposition carries numbers; states ride /healthz and /v1/metrics.
+
+Counters vs gauges follow the source ledger's own semantics: monotone
+totals (``finished``, ``*_tokens``, ``steps``…) are counters,
+instantaneous readings (queue depth, utilization, percentiles) gauges.
+Unknown fields default to gauge — wrong-but-scrapeable beats dropped.
+
+:func:`parse_exposition` is the round-trip validator: a small strict
+parser of the same format, used by the tests (and usable against any
+exposition text) so "parses as Prometheus text exposition" is checked
+by actual parsing, not a regex squint.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# source-ledger fields that are monotone totals (everything else is
+# exposed as a gauge)
+_COUNTER_KEYS = frozenset({
+    "steps", "gen_tokens", "admitted", "finished", "preempted",
+    "deadline_exceeded", "prefill_tokens", "decode_tokens",
+    "prefix_hit_tokens", "prefill_tokens_saved", "decode_steps",
+    "spec_steps", "draft_tokens", "accepted_draft_tokens",
+    "prefill_chunks", "chunk_steps", "chunk_tokens", "submitted",
+    "accepted", "shed", "shed_queue_full", "shed_deadline",
+    "shed_shutdown", "migrations", "replica_deaths", "stalls",
+    "restarts", "requests", "tokens_delivered",
+})
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(prefix: str, key: str) -> str:
+    return _NAME_RE.sub("_", f"{prefix}_{key}")
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _fmt_labels(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _is_pct_dict(v) -> bool:
+    return (isinstance(v, dict) and v
+            and all(k in ("p50", "p95", "p99", "n") for k in v))
+
+
+class _Builder:
+    """Accumulates samples grouped by metric name so each name gets
+    exactly one HELP/TYPE header no matter how many label sets sample
+    it (one header per name is what the format requires)."""
+
+    def __init__(self):
+        self._order: List[str] = []
+        self._meta: Dict[str, Tuple[str, str]] = {}   # name -> (type, help)
+        self._samples: Dict[str, List[str]] = {}
+
+    def add(self, name: str, value, *, labels=None,
+            mtype: str = "gauge", help_: str = "") -> None:
+        if name not in self._meta:
+            self._order.append(name)
+            self._meta[name] = (mtype, help_)
+            self._samples[name] = []
+        self._samples[name].append(
+            f"{name}{_fmt_labels(labels)} {float(value):g}")
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in self._order:
+            mtype, help_ = self._meta[name]
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {mtype}")
+            lines.extend(self._samples[name])
+        return "\n".join(lines) + "\n"
+
+
+def _add_summary(b: _Builder, prefix: str, summary: Dict,
+                 labels: Optional[Dict[str, str]] = None) -> None:
+    for key, v in summary.items():
+        if key == "adapters" and isinstance(v, dict):
+            for aid, d in sorted(v.items()):
+                al = dict(labels or {}, adapter=aid)
+                _add_summary(b, f"{prefix}_adapter", d, labels=al)
+            continue
+        if _is_pct_dict(v):
+            name = _metric_name(prefix, key)
+            for q in ("p50", "p95", "p99"):
+                if q in v:
+                    b.add(name, v[q],
+                          labels=dict(labels or {}, quantile=q))
+            if "n" in v:
+                b.add(name + "_count", v["n"], labels=labels,
+                      mtype="counter",
+                      help_="observations behind the quantiles "
+                            "(reservoir-capped source)")
+            continue
+        if isinstance(v, bool):
+            b.add(_metric_name(prefix, key), int(v), labels=labels)
+            continue
+        if isinstance(v, (int, float)):
+            mtype = "counter" if key in _COUNTER_KEYS else "gauge"
+            b.add(_metric_name(prefix, key), v, labels=labels,
+                  mtype=mtype)
+        # strings / nested non-percentile dicts: not exposition material
+
+
+def render_exposition(frontdoor_summary: Dict,
+                      engine_summaries: Optional[Dict[str, Dict]] = None,
+                      *, health: Optional[Dict] = None) -> str:
+    """The front door's ``GET /metrics`` body: fleet counters as
+    ``quintnet_fleet_*``, each replica engine's summary as
+    ``quintnet_engine_*{replica="<name>"}``, and (when ``health`` is
+    given) per-replica liveness as ``quintnet_replica_up`` plus queue
+    depth gauges."""
+    b = _Builder()
+    _add_summary(b, "quintnet_fleet", frontdoor_summary)
+    for name, summary in sorted((engine_summaries or {}).items()):
+        _add_summary(b, "quintnet_engine", summary,
+                     labels={"replica": name})
+    if health:
+        for name, r in sorted(health.get("replicas", {}).items()):
+            b.add("quintnet_replica_up",
+                  1 if r.get("state") == "healthy" else 0,
+                  labels={"replica": name},
+                  help_="1 while the replica is a dispatch candidate")
+        for key in ("queue_depth", "open_requests"):
+            if key in health:
+                b.add(_metric_name("quintnet_fleet", key), health[key])
+    return b.render()
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|\d*\.\d+"
+    r"(?:[eE][-+]?\d+)?|[Nn]a[Nn]|[-+]?[Ii]nf))\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str) -> Dict[Tuple[str, Tuple], float]:
+    """Strict parser of the text exposition format. Returns
+    ``{(name, ((label, value), ...)): float}``; raises ValueError on
+    any line that is neither a comment, blank, nor a well-formed
+    sample — the test-side proof that what /metrics serves IS the
+    format, not something shaped like it."""
+    out: Dict[Tuple[str, Tuple], float] = {}
+    typed: set = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                if parts[2] in typed:
+                    raise ValueError(
+                        f"line {lineno}: duplicate TYPE for "
+                        f"{parts[2]!r}")
+                typed.add(parts[2])
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(
+                f"line {lineno} is not a valid exposition sample: "
+                f"{line!r}")
+        labels: Tuple = ()
+        if m.group("labels"):
+            labels = tuple(sorted(_LABEL_RE.findall(m.group("labels"))))
+        out[(m.group("name"), labels)] = float(m.group("value"))
+    return out
+
+
+def sample(parsed: Dict, name: str, **labels) -> float:
+    """Test helper: look up one sample by name + exact label set."""
+    key = (name, tuple(sorted(labels.items())))
+    if key not in parsed:
+        have = sorted(k for k in parsed if k[0] == name)
+        raise KeyError(f"no sample {key}; have {have}")
+    return parsed[key]
+
+
+def iter_samples(parsed: Dict, name: str) -> Iterable[Tuple[Tuple, float]]:
+    for (n, labels), v in parsed.items():
+        if n == name:
+            yield labels, v

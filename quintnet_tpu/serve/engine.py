@@ -195,7 +195,8 @@ class ServeEngine:
                  prefill_chunk_budget: Optional[int] = None,
                  kv_dtype=None,
                  logger=None, log_every: int = 0,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 tracer=None, recorder=None):
         self.family = family
         self.params = params
         self.max_slots = int(max_slots)
@@ -245,6 +246,18 @@ class ServeEngine:
         self.logger = logger
         self.log_every = int(log_every)
         self.clock = clock
+        # observability (quintnet_tpu/obs/): an obs.Tracer records
+        # per-request spans, an obs.StepRecorder the per-step flight-
+        # recorder ring. Both default OFF and both are INERT when on:
+        # every hook reads host-side state the step already computed —
+        # no device traffic, no host syncs, no key/sampling influence —
+        # so tracing on is token-BIT-identical to tracing off and the
+        # compiled-program census is unchanged (tests/test_obs.py).
+        # Plain assignable attributes, not construction-only config:
+        # the process fleet attaches them AFTER the builder spec ran
+        # (fleet/proc.py replica_main).
+        self.tracer = tracer
+        self.recorder = recorder
         self.prefix_cache = bool(prefix_cache)
         # speculative decoding (serve/spec.py): None/False -> off,
         # True -> defaults, or a SpecConfig. Drafting is host-side;
@@ -929,7 +942,8 @@ class ServeEngine:
     def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
                key=None, on_token=None,
                adapter_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> int:
         """Queue one request; returns its id. ``key``: per-request
         sampling key (defaults to fold_in(key(0), rid)) — pass the SAME
         key an independent ``gpt2_generate`` call would get to reproduce
@@ -941,7 +955,11 @@ class ServeEngine:
         whose deadline lapses mid-generation is retired with a typed
         :class:`DeadlineExceeded` (its blocks published back to the
         prefix cache) instead of burning pool capacity on a stream
-        nobody is waiting for."""
+        nobody is waiting for. ``trace_id``: the request's
+        observability identity (quintnet_tpu/obs/) — pass the id an
+        upstream surface (fleet, front door) already assigned so spans
+        recorded here continue that timeline; defaults to an
+        engine-local id. Inert: never influences output."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._check_admissible(prompt, max_new_tokens)
         if deadline_s is not None and deadline_s <= 0:
@@ -958,9 +976,16 @@ class ServeEngine:
                       arrival=self._arrival_counter, on_token=on_token,
                       adapter_id=adapter_id,
                       deadline=(None if deadline_s is None
-                                else self.clock() + float(deadline_s)))
+                                else self.clock() + float(deadline_s)),
+                      trace_id=trace_id or f"req-{rid}")
         self._arrival_counter += 1
         req.key_data = np.asarray(jax.random.key_data(key))
+        if self.tracer is not None:
+            self.tracer.event(req.trace_id, "submit", rid=rid,
+                              prompt_len=int(prompt.size),
+                              max_new_tokens=int(max_new_tokens),
+                              adapter_id=adapter_id,
+                              priority=int(priority))
         return self._enqueue(req)
 
     def restore_progress(self, progress: RequestProgress, *,
@@ -1000,11 +1025,19 @@ class ServeEngine:
                       adapter_id=progress.adapter_id,
                       deadline=(None if progress.deadline_s is None
                                 else self.clock()
-                                + float(progress.deadline_s)))
+                                + float(progress.deadline_s)),
+                      trace_id=progress.trace_id or f"req-{rid}")
         self._arrival_counter += 1
         req.generated = list(progress.generated)
         req.key_data = np.array(progress.key_data, copy=True)
         req.preemptions = int(progress.preemptions)
+        if self.tracer is not None:
+            # the migrated timeline CONTINUES here under the same
+            # trace id the exporting engine (or the journal) carried
+            self.tracer.event(req.trace_id, "restore", rid=rid,
+                              generated=len(req.generated),
+                              preemptions=req.preemptions,
+                              adapter_id=req.adapter_id)
         return self._enqueue(req)
 
     def result(self, rid: int) -> np.ndarray:
@@ -1078,6 +1111,10 @@ class ServeEngine:
         req.finish_time = self.clock()
         self.metrics.record_finish(req.finish_time - req.submit_time,
                                    adapter_id=req.adapter_id)
+        if self.tracer is not None:
+            self.tracer.event(req.trace_id, "finish", rid=req.rid,
+                              generated=len(req.generated),
+                              preemptions=req.preemptions)
         if req.adapter_id is not None:
             self.adapters.release(req.adapter_id)  # submit-time pin
         return req.rid
@@ -1115,6 +1152,10 @@ class ServeEngine:
                 f"retired mid-decode (blocks published)",
                 rid=req.rid, generated=len(req.generated)))
             self.metrics.record_deadline_exceeded()
+            if self.tracer is not None:
+                self.tracer.event(req.trace_id, "deadline_exceeded",
+                                  generated=len(req.generated),
+                                  where="running")
             finished.append(req.rid)
         expired = [r for r in self.scheduler.waiting
                    if r.deadline is not None and now >= r.deadline]
@@ -1124,6 +1165,9 @@ class ServeEngine:
                 f"request {req.rid} still waiting at its deadline; "
                 f"never admitted", rid=req.rid, generated=0))
             self.metrics.record_deadline_exceeded()
+            if self.tracer is not None:
+                self.tracer.event(req.trace_id, "deadline_exceeded",
+                                  generated=0, where="waiting")
             finished.append(req.rid)
 
     def _preempt(self, slot: int) -> None:
@@ -1138,6 +1182,10 @@ class ServeEngine:
         self._clear_slot(slot)
         req.preemptions += 1
         self.metrics.record_preempt()
+        if self.tracer is not None:
+            self.tracer.event(req.trace_id, "preempt",
+                              generated=len(req.generated),
+                              preemptions=req.preemptions)
         self.scheduler.push_front(req)
 
     def _append_token(self, slot: int, token: int) -> bool:
@@ -1197,6 +1245,27 @@ class ServeEngine:
         self._tables[slot] = row
         return plan
 
+    def _trace_admit(self, req: Request, plan, *, evictions: int,
+                     chunked: bool) -> None:
+        """Span hook shared by both admission paths: close the queue
+        wait and record the AdmitPlan outcome — prefix-hit tokens,
+        COW, evictions the allocation forced — the facts that explain
+        a slow TTFT after the fact."""
+        tr = self.tracer
+        if tr is None:
+            return
+        now = self.clock()
+        tr.add(req.trace_id, "queue", t0=req.submit_time, t1=now,
+               preemptions=req.preemptions)
+        tr.event(req.trace_id, "admit",
+                 cached_tokens=int(plan.cached_tokens),
+                 shared_blocks=len(plan.shared_blocks),
+                 new_blocks=int(plan.n_new_blocks),
+                 cow=plan.cow_src is not None,
+                 cow_len=int(plan.cow_len),
+                 evictions_forced=int(evictions),
+                 chunked=chunked, adapter_id=req.adapter_id)
+
     def _admit_one(self, slot: int, req: Request) -> Tuple[int, int]:
         """Admit ``req`` into ``slot``: reuse the longest cached prefix
         chain, prefill only the uncached tail in the smallest bucket
@@ -1204,7 +1273,11 @@ class ServeEngine:
         reused)."""
         t0 = req.total_len
         tokens = req.output_ids()
+        ev0 = self.pool.cache_evictions
         plan = self._allocate_slot(slot, req)
+        self._trace_admit(req, plan,
+                          evictions=self.pool.cache_evictions - ev0,
+                          chunked=False)
         row = self._tables[slot]
 
         start = plan.cached_tokens
@@ -1234,6 +1307,10 @@ class ServeEngine:
         self._tok[slot] = tok0
         self._pos[slot] = t0
         self.metrics.record_admit()
+        if self.tracer is not None:
+            self.tracer.event(req.trace_id, "prefill",
+                              tokens=len(tail), bucket=bucket,
+                              start=int(start))
         if self._append_token(slot, tok0):
             self._retire(slot)
         return len(tail), start
@@ -1251,7 +1328,11 @@ class ServeEngine:
         from quintnet_tpu.serve.longctx import ChunkState
 
         t0 = req.total_len
+        ev0 = self.pool.cache_evictions
         plan = self._allocate_slot(slot, req)
+        self._trace_admit(req, plan,
+                          evictions=self.pool.cache_evictions - ev0,
+                          chunked=True)
         # mid-prefill invariants: _pos counts exactly the positions
         # holding valid KV (so publish-on-preempt/deadline stays
         # correct), and the PRNG key has NOT advanced — sampling
@@ -1300,6 +1381,10 @@ class ServeEngine:
         st.chunks_done += 1
         self._pos[slot] = st.next
         req.prefilled = st.next
+        if self.tracer is not None:
+            self.tracer.event(req.trace_id, "prefill_chunk",
+                              tokens=int(n), bucket=bucket,
+                              start=st.next - n, final=st.done)
         if not st.done:
             return  # intermediate chunk: tok0/key2 discarded
         self._slot_chunk[slot] = None
@@ -1493,6 +1578,10 @@ class ServeEngine:
             # matched prefix committed (an EOS/budget stop inside the
             # draft commits drafted tokens only)
             accepted += min(c, a)
+            if self.tracer is not None:
+                self.tracer.event(self._slot_req[slot].trace_id,
+                                  "verify", committed=c,
+                                  drafted=len(d), accepted=min(c, a))
             if done:
                 finished.append(self._retire(slot))
         return committed, drafted, accepted
@@ -1505,6 +1594,15 @@ class ServeEngine:
         finished: List[int] = []
         prefill_tokens = 0
         prefix_hit_tokens = 0
+        # flight recorder (obs/recorder.py): the step's wall window is
+        # read from the injectable clock WITHOUT any device drain —
+        # the recorder must never add blocking to the step loop, so it
+        # times dispatch + whatever blocking the step itself did
+        rec_t0 = self.clock() if self.recorder is not None else None
+        if self.recorder is not None:
+            m = self.metrics
+            rec_admitted0 = m.admitted
+            rec_preempted0 = m.preempted
 
         # 0. deadline enforcement — running slots AND the waiting queue
         self._sweep_deadlines(finished)
@@ -1598,6 +1696,10 @@ class ServeEngine:
                     self._tok[slot] = token
                     self._pos[slot] += 1
                     decode_tokens += 1
+                    if self.tracer is not None:
+                        self.tracer.event(
+                            self._slot_req[slot].trace_id, "decode",
+                            token=token, pos=int(self._pos[slot]))
                     if self._append_token(slot, token):
                         finished.append(self._retire(slot))
 
@@ -1616,6 +1718,25 @@ class ServeEngine:
             draft_tokens=draft_tokens,
             accepted_draft_tokens=accepted_draft,
             prefill_chunks=prefill_chunks)
+        if self.recorder is not None:
+            from quintnet_tpu.obs.recorder import StepRecord
+
+            m = self.metrics
+            self.recorder.record(StepRecord(
+                step=m.steps, t0=rec_t0, t1=self.clock(),
+                running=m.running, waiting=m.waiting,
+                decoding=len(decoding), prefilling=len(prefilling),
+                admitted=m.admitted - rec_admitted0,
+                finished=len(finished),
+                preempted=m.preempted - rec_preempted0,
+                kv_blocks_used=m.kv_blocks_used,
+                kv_blocks_total=m.kv_blocks_total,
+                prefill_tokens=prefill_tokens,
+                decode_tokens=decode_tokens,
+                prefix_hit_tokens=prefix_hit_tokens,
+                prefill_chunks=prefill_chunks,
+                spec_step=spec_step, draft_tokens=draft_tokens,
+                accepted_draft_tokens=accepted_draft))
         if self.log_every:
             self.metrics.log_step(self.logger, every=self.log_every)
         return finished
@@ -1728,6 +1849,11 @@ class ServeEngine:
         for req in self.scheduler.waiting:
             out.append(req.progress(now=now))
         out.sort(key=lambda p: p.rid)
+        if self.tracer is not None:
+            for p in out:
+                self.tracer.event(p.trace_id, "export",
+                                  generated=len(p.generated),
+                                  prefilled=int(p.prefilled))
         return out
 
     # ------------------------------------------------------------------

@@ -14,20 +14,146 @@ trace replayer (tools/serve_bench.py) can drive deterministic
 from __future__ import annotations
 
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import numpy as np
 
+# default percentile-source bound (see Reservoir): exact below this,
+# documented uniform sampling above it
+RESERVOIR_CAP = 4096
 
-def _pcts(xs: List[float]) -> Dict[str, float]:
-    if not xs:
-        return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
-    a = np.asarray(xs, np.float64)
+
+class Reservoir:
+    """Bounded percentile source: EXACT below ``cap`` observations,
+    a uniform reservoir sample (Vitter's Algorithm R) above it.
+
+    The percentile source lists (``ttfts``/``latencies``/``itls`` and
+    the per-adapter TTFTs) previously grew without limit — a
+    long-running replica leaked one float per request/token forever.
+    The reservoir keeps memory O(cap) while every stored element
+    remains an unbiased uniform draw from the full stream, so the
+    p50/p95 estimates stay honest; p99 degrades gracefully (documented
+    sampling error ~1/sqrt(cap)). ``n`` is the TRUE stream count —
+    ``summary()`` surfaces it so a reader can tell exact-mode
+    (``n <= cap``) from sampled.
+
+    List-compatible surface (append/extend/iter/len/bool/indexing) so
+    ``aggregate()``'s pooling — extend into a plain list, percentiles
+    over the pool — keeps working unchanged; pooling reservoirs pools
+    their retained samples, which stays uniform per-replica.
+
+    Deterministic: the replacement RNG is seeded per-instance, so two
+    replays of the same trace summarize identically (the bench's A/B
+    discipline)."""
+
+    __slots__ = ("cap", "n", "_items", "_rng")
+
+    def __init__(self, cap: int = RESERVOIR_CAP, *, seed: int = 0):
+        if cap < 1:
+            raise ValueError(f"cap must be >= 1, got {cap}")
+        self.cap = int(cap)
+        self.n = 0
+        self._items: List[float] = []
+        self._rng = random.Random(seed)
+
+    def append(self, x: float) -> None:
+        self.n += 1
+        if len(self._items) < self.cap:
+            self._items.append(float(x))
+            return
+        j = self._rng.randrange(self.n)      # Algorithm R
+        if j < self.cap:
+            self._items[j] = float(x)
+
+    def extend(self, xs) -> None:
+        for x in xs:
+            self.append(x)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __eq__(self, other):
+        if isinstance(other, Reservoir):
+            return self._items == other._items
+        return self._items == other
+
+    def to_list(self) -> List[float]:
+        return list(self._items)
+
+
+def _pooled_pcts(groups) -> Dict[str, float]:
+    """Fleet-wide percentiles over several replicas' percentile
+    sources, each a ``(samples, true_n)`` pair where ``samples`` may
+    be a reservoir-capped subset of a ``true_n``-long stream.
+
+    When every group is exact (``true_n == len(samples)``) this is
+    plain pooling — concatenate and take percentiles, bit-identical
+    to the pre-reservoir behavior. When any replica exceeded its cap,
+    naive pooling would weight every RETAINED sample equally and bias
+    the fleet tail toward low-traffic replicas (a 100k-request replica
+    and a 5k-request one both retain cap samples); instead each
+    retained sample is weighted by the number of observations it
+    represents (``true_n / len(samples)``) and the percentiles come
+    from the weighted inverted CDF — an unbiased estimate of the true
+    pooled distribution, since each reservoir is a uniform draw from
+    its own stream."""
+    groups = [(list(s), int(n)) for s, n in groups]
+    total_n = sum(n for _s, n in groups)
+    if all(n == len(s) for s, n in groups):
+        pooled: List[float] = []
+        for s, _n in groups:
+            pooled.extend(s)
+        return _pcts(pooled, n=total_n)
+    vals: List[float] = []
+    wts: List[float] = []
+    for s, n in groups:
+        if not s:
+            continue
+        w = n / len(s)
+        vals.extend(s)
+        wts.extend([w] * len(s))
+    if not vals:
+        return {"p50": 0.0, "p95": 0.0, "p99": 0.0, "n": total_n}
+    v = np.asarray(vals, np.float64)
+    w = np.asarray(wts, np.float64)
+    order = np.argsort(v)
+    v, w = v[order], w[order]
+    cw = np.cumsum(w)
+    out: Dict[str, float] = {}
+    for name, p in (("p50", 50), ("p95", 95), ("p99", 99)):
+        idx = int(np.searchsorted(cw, p / 100.0 * cw[-1]))
+        out[name] = float(v[min(idx, len(v) - 1)])
+    out["n"] = total_n
+    return out
+
+
+def _pcts(xs, n: Optional[int] = None) -> Dict[str, float]:
+    """Percentiles over a source list/Reservoir. ``n`` reports the
+    TRUE observation count behind the (possibly reservoir-sampled)
+    stored values; it defaults to the source's own ``n`` (Reservoir)
+    or its length (plain pooled list)."""
+    stored = xs if isinstance(xs, list) else list(xs)
+    if n is None:
+        n = getattr(xs, "n", len(stored))
+    if not stored:
+        return {"p50": 0.0, "p95": 0.0, "p99": 0.0, "n": int(n)}
+    a = np.asarray(stored, np.float64)
     return {"p50": float(np.percentile(a, 50)),
             "p95": float(np.percentile(a, 95)),
-            "p99": float(np.percentile(a, 99))}
+            "p99": float(np.percentile(a, 99)),
+            "n": int(n)}
 
 
 @dataclass
@@ -84,18 +210,21 @@ class ServeMetrics:
 
     # per-adapter ledger (multi-tenant LoRA, serve/adapters.py):
     # adapter id -> {"requests": finished, "gen_tokens": generated,
-    # "ttfts": [s, ...]} — the per-tenant slice of the totals above
+    # "ttfts": Reservoir} — the per-tenant slice of the totals above
     # (base-model traffic is the remainder)
     per_adapter: Dict[str, Dict] = field(default_factory=dict)
 
-    # per-request marks ----------------------------------------------
-    ttfts: List[float] = field(default_factory=list)
-    latencies: List[float] = field(default_factory=list)
+    # per-request marks (percentile SOURCES, reservoir-bounded: exact
+    # below RESERVOIR_CAP observations, uniform sampling above — a
+    # long-running replica's memory stays O(cap); summary() surfaces
+    # the true count as "n" beside the percentiles) -------------------
+    ttfts: Reservoir = field(default_factory=Reservoir)
+    latencies: Reservoir = field(default_factory=Reservoir)
     # inter-token gaps (seconds between a request's consecutive
     # tokens, pooled across requests) — the decode-starvation signal:
     # a monolithic prefill shows up as one giant gap in every
     # concurrent stream, a budgeted chunked prefill does not
-    itls: List[float] = field(default_factory=list)
+    itls: Reservoir = field(default_factory=Reservoir)
     _t0: Optional[float] = None
     _t_end: Optional[float] = None
 
@@ -149,7 +278,8 @@ class ServeMetrics:
 
     def _adapter(self, adapter_id: str) -> Dict:
         return self.per_adapter.setdefault(
-            adapter_id, {"requests": 0, "gen_tokens": 0, "ttfts": []})
+            adapter_id,
+            {"requests": 0, "gen_tokens": 0, "ttfts": Reservoir()})
 
     def record_adapter_token(self, adapter_id: str) -> None:
         """One generated token attributed to ``adapter_id`` (the engine
@@ -295,34 +425,42 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
     into one summary-shaped dict (quintnet_tpu/fleet/ reads it for the
     whole-fleet throughput line).
 
-    Counters are summed; the TTFT/latency percentile SOURCE lists are
-    pooled before taking percentiles (true fleet-wide tails, not an
-    average of per-replica percentiles); the wall clock spans the
-    earliest first step to the latest last step across replicas, so
-    ``tokens_per_sec`` is aggregate fleet throughput, not a per-replica
-    mean. Replicas that never stepped contribute counters only."""
+    Counters are summed; the TTFT/latency percentile SOURCES (now
+    reservoir-bounded, see :class:`Reservoir`) are pooled per replica
+    with each retained sample weighted by the observations it
+    represents (:func:`_pooled_pcts`) — true fleet-wide tails, not an
+    average of per-replica percentiles, and not biased toward
+    low-traffic replicas when a busy one exceeded its cap; the wall
+    clock spans the earliest first step to the latest last step across
+    replicas, so ``tokens_per_sec`` is aggregate fleet throughput, not
+    a per-replica mean. Replicas that never stepped contribute
+    counters only."""
     t0s = [m._t0 for m in all_metrics if m._t0 is not None]
     ends = [m._t_end for m in all_metrics if m._t_end is not None]
     wall = (max(ends) - min(t0s)) if t0s and ends else 0.0
     wall = max(wall, 0.0)
     gen_tokens = sum(m.gen_tokens for m in all_metrics)
-    ttfts: List[float] = []
-    latencies: List[float] = []
-    itls: List[float] = []
-    for m in all_metrics:
-        ttfts.extend(m.ttfts)
-        latencies.extend(m.latencies)
-        itls.extend(m.itls)
+
+    def _true_n(src) -> int:
+        return getattr(src, "n", len(src))
+
+    def _group(src):
+        return (src, _true_n(src))
+
+    ttft_groups = [_group(m.ttfts) for m in all_metrics]
+    lat_groups = [_group(m.latencies) for m in all_metrics]
+    itl_groups = [_group(m.itls) for m in all_metrics]
     # per-adapter ledgers merge the same way the totals do: counters
-    # summed across replicas, TTFT sources pooled before percentiles
+    # summed across replicas, TTFT sources pooled (weighted) before
+    # percentiles
     adapters: Dict[str, Dict] = {}
     for m in all_metrics:
         for aid, d in m.per_adapter.items():
             agg = adapters.setdefault(
-                aid, {"requests": 0, "gen_tokens": 0, "ttfts": []})
+                aid, {"requests": 0, "gen_tokens": 0, "groups": []})
             agg["requests"] += d["requests"]
             agg["gen_tokens"] += d["gen_tokens"]
-            agg["ttfts"].extend(d["ttfts"])
+            agg["groups"].append(_group(d["ttfts"]))
     hit = sum(m.prefix_hit_tokens for m in all_metrics)
     prefill = sum(m.prefill_tokens for m in all_metrics)
     dsteps = sum(m.decode_steps for m in all_metrics)
@@ -360,9 +498,9 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
             / max(sum(m.chunk_steps for m in all_metrics), 1), 4),
         "wall_s": round(wall, 4),
         "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
-        "ttft_s": _pcts(ttfts),
-        "latency_s": _pcts(latencies),
-        "itl_s": _pcts(itls),
+        "ttft_s": _pooled_pcts(ttft_groups),
+        "latency_s": _pooled_pcts(lat_groups),
+        "itl_s": _pooled_pcts(itl_groups),
         "peak_kv_utilization": round(
             max((m.peak_kv_utilization for m in all_metrics), default=0.0),
             4),
@@ -378,6 +516,6 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "adapters": {
             aid: {"requests": d["requests"],
                   "gen_tokens": d["gen_tokens"],
-                  "ttft_s": _pcts(d["ttfts"])}
+                  "ttft_s": _pooled_pcts(d["groups"])}
             for aid, d in sorted(adapters.items())},
     }

@@ -93,6 +93,14 @@ class RequestProgress:
     gotten. Zero for requests that never started prefilling and for
     engines without chunked prefill.
 
+    ``trace_id`` is the request's OBSERVABILITY identity
+    (quintnet_tpu/obs/): assigned once at the outermost submit surface
+    and carried across preemption, export and migration so the spans a
+    destination replica records continue the SAME timeline the source
+    started — one trace shows a request's life across processes. Pure
+    metadata: it never influences scheduling, sampling or output
+    (observation is inert), and None is always valid.
+
     ``rid`` is the EXPORTING engine's request id (engine-local; the
     restoring engine assigns its own)."""
 
@@ -106,6 +114,7 @@ class RequestProgress:
     adapter_id: Optional[str] = None
     deadline_s: Optional[float] = None
     prefilled: int = 0
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -126,6 +135,7 @@ class Request:
     on_token: Optional[Callable] = None     # streaming callback
     adapter_id: Optional[str] = None        # LoRA binding (None = base)
     deadline: Optional[float] = None        # absolute ENGINE-clock time
+    trace_id: Optional[str] = None          # obs identity (inert)
 
     # --- runtime (engine-managed) ---
     state: str = WAITING
@@ -183,7 +193,8 @@ class Request:
                       else np.array(self.key_data, copy=True)),
             max_new_tokens=self.max_new_tokens, priority=self.priority,
             preemptions=self.preemptions, adapter_id=self.adapter_id,
-            deadline_s=deadline_s, prefilled=self.prefilled)
+            deadline_s=deadline_s, prefilled=self.prefilled,
+            trace_id=self.trace_id)
 
 
 class Scheduler:

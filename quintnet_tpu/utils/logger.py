@@ -29,8 +29,13 @@ def setup_logging(log_dir: Optional[str] = None, *, name: str = "quintnet",
 
 
 def log_once(logger: logging.Logger, msg: str, *, _seen=set()):  # noqa: B006
-    """Log a message at most once per process (dedups warnings emitted
-    from inside retraced functions)."""
-    if msg not in _seen:
-        _seen.add(msg)
+    """Log a message at most once per LOGGER per process (dedups
+    warnings emitted from inside retraced functions). Keyed by
+    ``(logger.name, msg)``: the module-level set is shared across all
+    callers, so keying by message alone made two differently-named
+    loggers dedupe EACH OTHER's messages — the second logger's first
+    warning silently vanished."""
+    key = (logger.name, msg)
+    if key not in _seen:
+        _seen.add(key)
         logger.info(msg)

@@ -1,0 +1,672 @@
+"""Observability goldens (quintnet_tpu/obs/ + the threaded hooks).
+
+THE contract is inertness: arming the flight recorder — per-request
+Tracer spans, per-step StepRecorder ring — changes NOTHING about what
+the engine computes. Tracing on is token-BIT-identical to tracing off
+(greedy and sampled) with prefix cache, speculation, chunked prefill
+and int8 KV composed, and the compiled-program census is unchanged.
+On top of that: the fleet's black box — a replica death produces a
+crash dump carrying the corpse's last-known step ring and the affected
+requests' spans, and those spans CONTINUE on the destination replica
+under the same trace id (thread fleet in-process; process fleet across
+a real SIGKILL with zero cooperation from the corpse). The Prometheus
+exposition and Chrome trace-event exports are gated by actual parsers,
+not shape squints. Satellites ride along: reservoir-bounded percentile
+sources, zero-traffic aggregation without NaN, the per-logger
+log_once fix, and trace-id round-trip over the wire.
+"""
+
+import json
+import logging
+import os
+import signal
+import time
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from quintnet_tpu.fleet import ProcessFleet, ServeFleet, Backoff, FrontDoor
+from quintnet_tpu.fleet import wire
+from quintnet_tpu.fleet.fleet import FleetMetrics
+from quintnet_tpu.ft.chaos import ChaosMonkey
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.obs import (EventLog, StepRecorder, Tracer,
+                              load_crash_dump, parse_exposition,
+                              render_exposition, write_crash_dump)
+from quintnet_tpu.obs.prom import sample
+from quintnet_tpu.serve import ServeEngine, gpt2_family
+from quintnet_tpu.serve import metrics as serve_metrics
+from quintnet_tpu.serve.metrics import Reservoir, ServeMetrics
+from quintnet_tpu.serve.scheduler import RequestProgress
+
+CFG = GPT2Config.tiny(n_layer=2)
+FACTORY_FILE = os.path.join(os.path.dirname(__file__),
+                            "_proc_factories.py")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+def _engine(params, *, obs=False, **kw):
+    kwargs = dict(max_slots=2, block_size=4, num_blocks=32,
+                  max_seq_len=48)
+    kwargs.update(kw)
+    eng = ServeEngine(gpt2_family(CFG), params, **kwargs)
+    if obs:
+        eng.tracer = Tracer(clock=eng.clock)
+        eng.recorder = StepRecorder(capacity=64, clock=eng.clock)
+    return eng
+
+
+def _wait_until(pred, *, timeout=120.0, msg=""):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for: {msg}")
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------
+# THE inertness golden: observed == unobserved, bit for bit
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("combo", [
+    dict(spec=True, kv_dtype="int8", temperature=0.8, top_k=5),
+    dict(chunked_prefill=True, prefill_len=16, temperature=0.8,
+         top_k=5),
+    dict(lora=True, kv_dtype="int8", temperature=0.8, top_k=5),
+], ids=["spec+int8+sampled", "chunked+sampled", "lora+int8+sampled"])
+def test_tracing_is_token_bit_identical(params, rng, combo):
+    """Same params, same trace, same keys — one engine with the full
+    flight recorder armed, one without. Every output array must be
+    bit-identical and the compile census unchanged (observation adds
+    zero programs). Sampled, with prefix cache on and the combo's
+    feature stack composed — the inertness acceptance gate."""
+    from quintnet_tpu.models.lora import LoRAConfig, lora_init
+    from quintnet_tpu.serve import AdapterRegistry
+
+    combo = dict(combo)
+    lora = combo.pop("lora", False)
+    lens = (5, 9, 3, 7, 30 if combo.get("chunked_prefill") else 12)
+    prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (t,)),
+                          np.int32) for t in lens]
+    keys = [jax.random.key(100 + i) for i in range(len(prompts))]
+    adapter_ids = [None] * len(prompts)
+
+    outs = {}
+    stats = {}
+    obs_engine = None
+    for obs in (False, True):
+        kw = dict(combo)
+        if lora:
+            lcfg = LoRAConfig(rank=4, alpha=8.0)
+            tree = lora_init(jax.random.key(77), params["blocks"],
+                             lcfg)
+            reg = AdapterRegistry()
+            reg.register("tenantA", tree=tree, cfg=lcfg)
+            kw["adapters"] = reg
+            adapter_ids = ["tenantA" if i % 2 == 0 else None
+                           for i in range(len(prompts))]
+        eng = _engine(params, obs=obs, prefix_cache=True, **kw)
+        rids = [eng.submit(p, 8, key=k, adapter_id=a)
+                for p, k, a in zip(prompts, keys, adapter_ids)]
+        eng.run()
+        outs[obs] = [eng.result(r) for r in rids]
+        stats[obs] = eng.compile_stats()
+        if obs:
+            obs_engine = eng
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_array_equal(a, b)
+    assert stats[False] == stats[True]
+    # and the observer actually observed
+    assert len(obs_engine.recorder) > 0
+    tids = obs_engine.tracer.trace_ids()
+    assert len(tids) == len(prompts)
+    names = {s.name for t in tids for s in obs_engine.tracer.spans(t)}
+    assert {"submit", "queue", "admit", "finish"} <= names
+    if combo.get("chunked_prefill"):
+        assert "prefill_chunk" in names
+    if combo.get("spec"):
+        assert "verify" in names or "decode" in names
+
+
+def test_tracing_inert_across_preemption(params, rng):
+    """Preemption pressure (tiny pool) with tracing on vs off: same
+    outputs, and the traced side recorded the preempt/resume arc."""
+    prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (t,)),
+                          np.int32) for t in (6, 7, 6)]
+    keys = [jax.random.key(7 + i) for i in range(3)]
+    outs = {}
+    traced = None
+    for obs in (False, True):
+        eng = _engine(params, obs=obs, num_blocks=8, max_seq_len=20,
+                      temperature=0.7, top_k=4)
+        rids = [eng.submit(p, 10, key=k)
+                for p, k in zip(prompts, keys)]
+        eng.run()
+        outs[obs] = [eng.result(r) for r in rids]
+        if obs:
+            traced = eng
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_array_equal(a, b)
+    assert traced.metrics.preempted > 0      # pressure actually hit
+    names = [s.name for t in traced.tracer.trace_ids()
+             for s in traced.tracer.spans(t)]
+    assert "preempt" in names
+
+
+def test_fleet_tracing_inert(params, rng):
+    """Thread fleet with obs on vs off, chaos kill included: outputs
+    identical (the migration path is also observation-inert)."""
+    def factory():
+        return ServeEngine(gpt2_family(CFG), params, max_slots=2,
+                           block_size=4, num_blocks=24, max_seq_len=40,
+                           temperature=0.8, top_k=5)
+
+    prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                          np.int32) for _ in range(4)]
+    keys = [jax.random.key(40 + i) for i in range(4)]
+    outs = {}
+    for obs in (False, True):
+        fleet = ServeFleet(
+            factory, n_replicas=2, obs=obs,
+            chaos=ChaosMonkey(kill_at_step=3, mode="raise",
+                              target="r0"))
+        try:
+            fids = [fleet.submit(p, 12, key=k)
+                    for p, k in zip(prompts, keys)]
+            outs[obs] = [fleet.result(f, timeout=300) for f in fids]
+            assert fleet.metrics.replica_deaths == 1
+        finally:
+            fleet.close()
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------
+# crash-dump forensics
+# ---------------------------------------------------------------------
+
+def test_thread_fleet_crash_dump(params, rng, tmp_path):
+    """A chaos-killed thread replica leaves a black box: the dump file
+    carries its step ring and the migrated requests' spans, and those
+    requests' timelines CONTINUE (restore -> finish) under the same
+    trace id after migration."""
+    def factory():
+        return ServeEngine(gpt2_family(CFG), params, max_slots=2,
+                           block_size=4, num_blocks=24, max_seq_len=40)
+
+    fleet = ServeFleet(
+        factory, n_replicas=2, obs=True, crash_dir=str(tmp_path),
+        chaos=ChaosMonkey(kill_at_step=3, mode="raise", target="r0"))
+    try:
+        prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                              np.int32) for _ in range(4)]
+        fids = [fleet.submit(p, 12) for p in prompts]
+        [fleet.result(f, timeout=300) for f in fids]
+        assert fleet.metrics.replica_deaths == 1
+        # the dump file is written by the dispatcher OUTSIDE the fleet
+        # lock — wait for the flush, don't race it
+        _wait_until(lambda: len(fleet.crash_dumps) == 1,
+                    msg="crash dump flushed")
+        dump = load_crash_dump(fleet.crash_dumps[0])
+        assert dump["replica"] == "r0"
+        assert dump["reason"] == "death"
+        assert len(dump["ring"]) >= 1            # the corpse's steps
+        assert dump["requests"], "affected requests recorded"
+        for r in dump["requests"]:
+            assert r["trace_id"] in dump["traces"]
+            assert dump["traces"][r["trace_id"]]
+            # continuation: the SAME id later carries the restore on
+            # the survivor and the finish
+            names = [s.name
+                     for s in fleet.tracer.spans(r["trace_id"])]
+            assert "migration" in names
+            assert "restore" in names
+            assert names.index("restore") > names.index("migration")
+            assert "finish" in names
+        kinds = [e["kind"] for e in fleet.events.snapshot()]
+        assert "replica_death" in kinds
+        assert "migration" in kinds
+        assert "crash_dump" in kinds
+        assert "replica_restart" in kinds or "breaker" in kinds
+    finally:
+        fleet.close()
+
+
+def test_process_fleet_sigkill_crash_dump(params, rng, tmp_path):
+    """THE acceptance golden on the PR 8 harness: a real
+    ``os.kill(pid, SIGKILL)`` mid-stream produces a crash dump
+    containing the dead replica's (heartbeat-mirrored) step ring and
+    the migrated requests' spans — assembled with zero cooperation
+    from the corpse — and the migrated requests' spans CONTINUE on the
+    destination replica under the same trace id, while every output
+    stays token-identical to the undisturbed oracle."""
+    from quintnet_tpu.models.gpt2_generate import gpt2_generate
+
+    max_new = 64       # a tiny model decodes in a burst; the stream
+    #                    must outlive a few heartbeats so the mirror
+    #                    is non-empty when the kill lands mid-flight
+    spec = {"file": FACTORY_FILE, "func": "build_tiny_gpt2",
+            "kwargs": {"max_seq_len": 110, "n_positions": 128,
+                       "num_blocks": 64}}
+    fleet = ProcessFleet(spec, n_replicas=2, policy="round_robin",
+                         platform="cpu", heartbeat_s=0.005,
+                         backoff=Backoff(base_s=0.01, cap_s=0.1),
+                         obs=True, crash_dir=str(tmp_path))
+    try:
+        prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (t,)),
+                              np.int32) for t in (5, 7, 3, 6)]
+        keys = [jax.random.key(500 + i) for i in range(4)]
+        streamed = []
+        fids = []
+        for i, (p, k) in enumerate(zip(prompts, keys)):
+            cb = ((lambda fid, tok, last:
+                   streamed.append(tok)) if i == 1 else None)
+            fids.append(fleet.submit(p, max_new, key=k, on_token=cb))
+        victim = fleet.replica("p1")     # round_robin: i=1 -> p1
+        # kill mid-stream AND after at least one heartbeat shipped
+        # step records — the mirror is "last-known", and last-known
+        # must be non-empty for the dump to mean anything
+        _wait_until(lambda: len(streamed) >= 2 and len(victim.ring) > 0,
+                    msg="victim streaming with a mirrored ring")
+        assert len(streamed) < max_new
+        os.kill(victim.pid, signal.SIGKILL)
+
+        outs = [fleet.result(f, timeout=300) for f in fids]
+        cfg_128 = GPT2Config.tiny(n_layer=2, n_positions=128)
+        params_128 = gpt2_init(jax.random.key(0), cfg_128)
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(
+                o, np.asarray(gpt2_generate(
+                    params_128, p[None], cfg_128,
+                    max_new_tokens=max_new,
+                    temperature=0.0, key=k)[0]))
+        assert fleet.metrics.replica_deaths == 1
+        assert fleet.metrics.migrations >= 1
+
+        _wait_until(lambda: len(fleet.crash_dumps) == 1,
+                    msg="crash dump flushed")
+        dump = load_crash_dump(fleet.crash_dumps[0])
+        assert dump["replica"] == "p1"
+        assert dump["reason"] == "death"
+        assert len(dump["ring"]) >= 1        # the corpse's last-known
+        assert all("step" in r and "t0" in r and "t1" in r
+                   for r in dump["ring"])
+        assert dump["requests"]
+        migrated_tids = [r["trace_id"] for r in dump["requests"]]
+        for tid in migrated_tids:
+            assert dump["traces"].get(tid), \
+                f"no spans for migrated {tid} in the dump"
+
+        # continuation on the DESTINATION replica, same trace id: the
+        # survivor's engine recorded restore -> decode -> finish under
+        # the id the journal carried over the wire
+        dest = fleet.replica_traces("p0", migrated_tids)
+        for tid in migrated_tids:
+            names = [s["name"] for s in dest.get(tid, [])]
+            assert "restore" in names, (tid, names)
+            assert "finish" in names, (tid, names)
+        kinds = [e["kind"] for e in fleet.events.snapshot()]
+        assert "replica_death" in kinds
+        assert "migration" in kinds
+        assert "crash_dump" in kinds
+    finally:
+        fleet.drain(timeout=180)
+
+
+def test_crash_dump_file_roundtrip(tmp_path):
+    path = write_crash_dump(
+        str(tmp_path), replica="rX", reason="stall", error="wedged",
+        ring=[{"step": 1, "t0": 0.0, "t1": 0.1}],
+        traces={"f0": [{"trace_id": "f0", "name": "queue",
+                        "t0": 0.0, "t1": 0.2, "attrs": {}}]},
+        events=[{"ts": 0.0, "seq": 1, "kind": "replica_stall"}],
+        requests=[{"fid": 0, "trace_id": "f0", "committed": 3}])
+    dump = load_crash_dump(path)
+    assert dump["replica"] == "rX" and dump["reason"] == "stall"
+    assert dump["ring"] and dump["traces"]["f0"]
+    # two dumps in the same second must not collide
+    path2 = write_crash_dump(str(tmp_path), replica="rX",
+                             reason="death")
+    assert path2 != path
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"kind": "crash_dump", "v": 999}))
+    with pytest.raises(ValueError, match="version"):
+        load_crash_dump(str(bad))
+
+
+# ---------------------------------------------------------------------
+# obs primitives
+# ---------------------------------------------------------------------
+
+def test_tracer_bounds_and_merge():
+    clk = [0.0]
+    tr = Tracer(clock=lambda: clk[0], max_traces=2,
+                max_spans_per_trace=8)
+    for i in range(20):
+        clk[0] = float(i)
+        tr.add("a", f"s{i}")
+    spans = tr.spans("a")
+    assert len(spans) == 8                      # bounded
+    assert spans[0].name == "s0"                # first kept (anchor)
+    assert spans[-1].name == "s19"              # latest kept
+    assert tr.dropped("a") == 12
+    tr.add("b", "x")
+    tr.add("c", "y")                            # evicts oldest trace
+    assert "a" not in tr.trace_ids()
+    # merge: another tracer's snapshot folds in under the same ids
+    other = Tracer()
+    other.add("b", "remote", t0=1.0, t1=2.0, replica="p1")
+    tr.merge(other.snapshot())
+    assert [s.name for s in tr.spans("b")] == ["x", "remote"]
+    # None trace_id is a no-op, not an error
+    tr.add(None, "ignored")
+
+
+def test_recorder_ring_and_drain():
+    from quintnet_tpu.obs.recorder import StepRecord
+
+    rec = StepRecorder(capacity=4)
+    for i in range(3):
+        rec.record(StepRecord(step=i + 1, t0=float(i),
+                              t1=float(i) + 0.5))
+    assert [r["step"] for r in rec.drain_new()] == [1, 2, 3]
+    assert rec.drain_new() == []                # cursor advanced
+    for i in range(3, 10):                      # overflow the ring
+        rec.record(StepRecord(step=i + 1, t0=float(i),
+                              t1=float(i) + 0.5))
+    assert len(rec) == 4 and rec.total == 10
+    # records that scrolled off before a drain are lost, not
+    # re-shipped: only the surviving window arrives, exactly once
+    drained = rec.drain_new()
+    assert [r["step"] for r in drained] == [7, 8, 9, 10]
+    assert rec.drain_new() == []
+    # max_records caps one drain; the rest comes next call
+    for i in range(10, 14):
+        rec.record(StepRecord(step=i + 1, t0=float(i),
+                              t1=float(i) + 0.5))
+    assert len(rec.drain_new(max_records=3)) == 3
+    assert [r["step"] for r in rec.drain_new()] == [14]
+
+
+def test_event_log_typed_and_jsonl(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path=str(path), capacity=4)
+    log.emit("replica_death", replica="p0", error="boom")
+    log.emit("migration", fid=3)
+    with pytest.raises(ValueError, match="unknown event kind"):
+        log.emit("oops")
+    assert [e["kind"] for e in log.snapshot()] == ["replica_death",
+                                                   "migration"]
+    assert log.snapshot(kind="migration")[0]["fid"] == 3
+    log.close()
+    lines = [json.loads(ln) for ln in
+             path.read_text().strip().splitlines()]
+    assert [ln["kind"] for ln in lines] == ["replica_death",
+                                            "migration"]
+    assert lines[0]["seq"] == 1 and lines[1]["seq"] == 2
+
+
+def test_prometheus_render_and_parse(params, rng):
+    """render_exposition over REAL ledgers parses with the strict
+    parser; samples are addressable by name + labels; malformed text
+    is rejected."""
+    eng = _engine(params)
+    rids = [eng.submit(np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                                  np.int32), 6) for _ in range(2)]
+    eng.run()
+    fm = FleetMetrics()
+    fm.submitted = 2
+    fm.finished = 2
+    text = render_exposition(
+        fm.summary(), {"r0": eng.metrics.summary()},
+        health={"replicas": {"r0": {"state": "healthy"}},
+                "queue_depth": 0, "open_requests": 0})
+    parsed = parse_exposition(text)
+    assert sample(parsed, "quintnet_fleet_finished") == 2.0
+    assert sample(parsed, "quintnet_engine_finished",
+                  replica="r0") == 2.0
+    assert sample(parsed, "quintnet_engine_ttft_s", replica="r0",
+                  quantile="p50") >= 0.0
+    assert sample(parsed, "quintnet_engine_ttft_s_count",
+                  replica="r0") == 2.0
+    assert sample(parsed, "quintnet_replica_up", replica="r0") == 1.0
+    # one TYPE header per metric name (the format's requirement)
+    types = [ln for ln in text.splitlines()
+             if ln.startswith("# TYPE")]
+    assert len(types) == len({ln.split()[2] for ln in types})
+    with pytest.raises(ValueError):
+        parse_exposition("this is not { exposition\n")
+    assert rids
+
+
+def test_trace_view_chrome_export(params, rng, tmp_path):
+    """The Perfetto export validates as Chrome trace-event JSON (the
+    acceptance parser, not a shape squint), covers steps AND request
+    spans, and the CLI round-trips a crash dump."""
+    from tools.trace_view import chrome_trace, validate_chrome_trace
+    import tools.trace_view as trace_view
+
+    eng = _engine(params, obs=True, chunked_prefill=True,
+                  prefill_len=16)
+    rid = eng.submit(np.asarray(rng.integers(0, CFG.vocab_size, (30,)),
+                                np.int32), 6)
+    eng.run()
+    trace = chrome_trace(eng.recorder.snapshot(),
+                         eng.tracer.snapshot())
+    n = validate_chrome_trace(trace)
+    assert n > 0
+    # json-serializable end to end
+    reparsed = json.loads(json.dumps(trace))
+    assert validate_chrome_trace(reparsed) == n
+    phases = {e["ph"] for e in trace["traceEvents"]}
+    assert {"M", "X", "i"} <= phases             # steps + instants
+    assert "b" in phases and "e" in phases       # async request spans
+    x = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 for e in x)
+    assert any(e["args"].get("prefill_chunks", 0) > 0 for e in x)
+    # unbalanced async must be rejected
+    bad = {"traceEvents": [
+        {"name": "q", "ph": "e", "ts": 0, "pid": 1, "cat": "r",
+         "id": "f0"}]}
+    with pytest.raises(ValueError, match="without begin"):
+        validate_chrome_trace(bad)
+    # the CLI path over a crash-dump-shaped file
+    dump_path = tmp_path / "dump.json"
+    dump_path.write_text(json.dumps(
+        {"ring": eng.recorder.snapshot(),
+         "traces": eng.tracer.snapshot()}))
+    out_path = tmp_path / "trace.json"
+    assert trace_view.main([str(dump_path), "-o", str(out_path)]) == 0
+    validate_chrome_trace(json.loads(out_path.read_text()))
+    assert rid == 0
+
+
+def test_frontdoor_metrics_endpoints(params, rng):
+    """GET /metrics parses as Prometheus text exposition (acceptance)
+    and GET /v1/metrics is explicit application/json carrying the
+    per-replica engine_summary."""
+    import http.client
+
+    def factory():
+        return ServeEngine(gpt2_family(CFG), params, max_slots=2,
+                           block_size=4, num_blocks=24, max_seq_len=24)
+
+    fleet = ServeFleet(factory, n_replicas=2, obs=True)
+    try:
+        fleet.generate([np.asarray(rng.integers(0, CFG.vocab_size,
+                                                (5,)), np.int32)],
+                       max_new_tokens=6, timeout=300)
+        with FrontDoor(fleet) as fd:
+            conn = http.client.HTTPConnection(fd.host, fd.port,
+                                              timeout=60)
+            conn.request("GET", "/metrics")
+            r = conn.getresponse()
+            assert r.status == 200
+            assert r.getheader("Content-Type").startswith(
+                "text/plain; version=0.0.4")
+            parsed = parse_exposition(r.read().decode())
+            assert sample(parsed, "quintnet_fleet_finished") == 1.0
+            ups = [v for (name, _l), v in parsed.items()
+                   if name == "quintnet_replica_up"]
+            assert len(ups) == 2 and all(v == 1.0 for v in ups)
+            assert any(name == "quintnet_engine_gen_tokens"
+                       for name, _l in parsed)
+
+            conn2 = http.client.HTTPConnection(fd.host, fd.port,
+                                               timeout=60)
+            conn2.request("GET", "/v1/metrics")
+            r2 = conn2.getresponse()
+            assert r2.status == 200
+            assert r2.getheader("Content-Type") == "application/json"
+            body = json.loads(r2.read())
+            assert body["frontdoor"]["finished"] == 1
+            assert set(body["engine_summary"]) == {"r0", "r1"}
+            assert all("gen_tokens" in s
+                       for s in body["engine_summary"].values())
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------
+
+def test_reservoir_bounds_percentile_sources():
+    r = Reservoir(cap=8, seed=1)
+    for x in range(5):
+        r.append(float(x))
+    assert r.n == 5 and len(r) == 5             # exact below the cap
+    assert sorted(r) == [0.0, 1.0, 2.0, 3.0, 4.0]
+    for x in range(5, 1000):
+        r.append(float(x))
+    assert r.n == 1000 and len(r) == 8          # bounded above it
+    assert all(0.0 <= x < 1000.0 for x in r)
+    # deterministic: same seed, same stream -> same retained sample
+    r2 = Reservoir(cap=8, seed=1)
+    r2.extend(float(x) for x in range(1000))
+    assert r.to_list() == r2.to_list()
+
+
+def test_serve_metrics_reservoir_and_count_surfaced():
+    clk = [0.0]
+    m = ServeMetrics(clock=lambda: clk[0])
+    m.ttfts = Reservoir(cap=16)
+    for i in range(100):
+        m.record_first_token(i / 100.0, adapter_id="t0")
+        m.record_finish(i / 10.0, adapter_id="t0")
+        m.record_itl(0.01)
+    s = m.summary()
+    assert s["ttft_s"]["n"] == 100              # TRUE count surfaced
+    assert s["latency_s"]["n"] == 100
+    assert s["itl_s"]["n"] == 100
+    assert len(m.ttfts) == 16                   # storage bounded
+    assert s["adapters"]["t0"]["ttft_s"]["n"] == 100
+    assert len(m.per_adapter["t0"]["ttfts"]) <= \
+        serve_metrics.RESERVOIR_CAP
+    # aggregate pools retained samples and SUMS true counts
+    m2 = ServeMetrics(clock=lambda: clk[0])
+    m2.record_first_token(0.5, adapter_id="t0")
+    agg = serve_metrics.aggregate([m, m2])
+    assert agg["ttft_s"]["n"] == 101
+    assert agg["adapters"]["t0"]["ttft_s"]["n"] == 101
+
+
+def test_aggregate_weights_capped_reservoirs_by_true_count():
+    """A busy replica whose reservoir hit its cap must not be
+    out-voted by a quiet one: pooling weights each retained sample by
+    the observations it represents, so fleet percentiles track the
+    TRUE traffic mix (naive equal-weight pooling would report the
+    quiet replica's tail as the fleet median)."""
+    busy = ServeMetrics()
+    busy.ttfts = Reservoir(cap=64)
+    for _ in range(10000):
+        busy.ttfts.append(0.01)          # 10k fast requests, sampled
+    quiet = ServeMetrics()
+    for _ in range(100):
+        quiet.ttfts.append(1.0)          # 100 slow requests, exact
+    agg = serve_metrics.aggregate([busy, quiet])
+    assert agg["ttft_s"]["n"] == 10100
+    # true mix is ~99% fast: every reported percentile up to p99 must
+    # be the fast value (equal-weight pooling of 64 vs 100 samples
+    # would have said 1.0 at p50)
+    assert agg["ttft_s"]["p50"] == 0.01
+    assert agg["ttft_s"]["p95"] == 0.01
+    # below every cap the pooled result stays the plain exact pooling
+    a, b = ServeMetrics(), ServeMetrics()
+    a.ttfts.extend([0.1, 0.2, 0.3])
+    b.ttfts.extend([0.4])
+    exact = serve_metrics.aggregate([a, b])
+    assert exact["ttft_s"]["n"] == 4
+    assert exact["ttft_s"]["p50"] == float(
+        np.percentile([0.1, 0.2, 0.3, 0.4], 50))
+
+
+def test_zero_traffic_aggregation_no_nan():
+    """aggregate() and FleetMetrics.summary() over zero-step engines:
+    zeroed dicts, finite floats, NO RuntimeWarning (the StepTimer fix
+    from PR 4, applied one layer up)."""
+    def _all_finite(obj):
+        if isinstance(obj, dict):
+            return all(_all_finite(v) for v in obj.values())
+        if isinstance(obj, (int, float)):
+            return np.isfinite(obj)
+        return True
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        empty = serve_metrics.aggregate([])
+        assert empty["replicas"] == 0
+        assert empty["tokens_per_sec"] == 0.0
+        assert empty["ttft_s"] == {"p50": 0.0, "p95": 0.0,
+                                   "p99": 0.0, "n": 0}
+        assert _all_finite(empty)
+
+        fresh = serve_metrics.aggregate([ServeMetrics(),
+                                         ServeMetrics()])
+        assert fresh["replicas"] == 2
+        assert fresh["steps"] == 0
+        assert fresh["prefix_hit_rate"] == 0.0
+        assert fresh["tokens_per_decode_step"] == 0.0
+        assert _all_finite(fresh)
+
+        fm = FleetMetrics().summary()
+        assert fm["finished"] == 0 and fm["shed_rate"] == 0.0
+        assert fm["ttft_s"]["n"] == 0
+        assert _all_finite(fm)
+
+        one = ServeMetrics().summary()
+        assert one["tokens_per_sec"] == 0.0
+        assert _all_finite(one)
+
+
+def test_log_once_keyed_by_logger(capsys):
+    from quintnet_tpu.utils.logger import log_once, setup_logging
+
+    a = setup_logging(name="qt-test-a")
+    b = setup_logging(name="qt-test-b")
+    msg = "unique-warning-xyz"
+    log_once(a, msg)
+    log_once(b, msg)       # a DIFFERENT logger must not be deduped
+    log_once(a, msg)       # the same one must
+    log_once(b, msg)
+    out = capsys.readouterr().out
+    assert out.count(msg) == 2
+
+
+def test_trace_id_rides_the_wire():
+    p = RequestProgress(
+        rid=1, prompt=np.arange(3, dtype=np.int32), generated=[7],
+        key_data=np.zeros((4,), np.uint32), max_new_tokens=4,
+        trace_id="f42")
+    back = wire.progress_from_wire(wire.progress_to_wire(p))
+    assert back.trace_id == "f42"
+    # pre-obs payloads (no field) decode to None, not KeyError
+    payload = wire.progress_to_wire(p)
+    del payload["trace_id"]
+    assert wire.progress_from_wire(payload).trace_id is None

@@ -22,7 +22,12 @@ artifacts/serve_r14.json gates the quantized KV pool: at EQUAL POOL
 BYTES the int8 side holds >= 1.8x the usable blocks and wins
 structurally on the shared-prefix trace — admits more concurrently,
 preempts less, evicts no cached chains — with the plain default trace
-(f32 policy) no worse than r13.
+(f32 policy) no worse than r13. artifacts/obs_r15.json gates the
+flight recorder (quintnet_tpu/obs/): observation must be nearly free —
+tracing-on tok/s >= 0.95x tracing-off on the same trace (bit-identity
+is pinned separately in tests/test_obs.py) with real spans and ring
+records behind the numbers, and the obs-off side (the plain default
+trace) no worse than r14's plain baseline.
 """
 
 import json
@@ -42,11 +47,13 @@ SPEC_METRIC = "serve_gpt2_tiny_spec_tokens_per_sec"
 LORA_METRIC = "serve_gpt2_tiny_lora_tokens_per_sec"
 LONG_METRIC = "serve_gpt2_tiny_long_tokens_per_sec"
 KVCAP_METRIC = "serve_gpt2_tiny_kvcap_tokens_per_sec"
+OBS_METRIC = "serve_gpt2_tiny_obs_tokens_per_sec"
 R09 = os.path.join(REPO, "artifacts", "serve_r09.json")
 R10 = os.path.join(REPO, "artifacts", "serve_r10.json")
 R11 = os.path.join(REPO, "artifacts", "serve_r11.json")
 R13 = os.path.join(REPO, "artifacts", "serve_r13.json")
 R14 = os.path.join(REPO, "artifacts", "serve_r14.json")
+R15 = os.path.join(REPO, "artifacts", "obs_r15.json")
 
 
 @pytest.mark.fast
@@ -463,6 +470,78 @@ def test_kv_capacity_artifact_surfaces_in_staleness_scan():
     last = bench.last_known_result(metric=KVCAP_METRIC)
     assert last is not None
     assert last["metric"] == KVCAP_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_obs_ab_smoke_cli(tmp_path):
+    """`serve_bench.py --obs-ab --trace-out` runs the observability
+    overhead A/B end-to-end on CPU and emits both the comparison
+    record and a Perfetto-loadable Chrome trace (validated by the real
+    validator, not a shape check)."""
+    trace_out = str(tmp_path / "trace.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--synthetic", "--obs-ab", "--requests", "6",
+         "--rate", "0.3", "--max-new", "4", "--trace-out", trace_out],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == OBS_METRIC
+    assert rec["rc"] == 0
+    e = rec["extras"]
+    for k in ("obs_off_tokens_per_sec", "obs_on_ratio", "obs_traces",
+              "obs_spans", "obs_ring_steps", "trace_events"):
+        assert k in e, k
+    assert e["obs_traces"] == 6          # every request traced
+    assert e["obs_spans"] > 0 and e["obs_ring_steps"] > 0
+    assert e["finished"] == e["submitted"] == 6
+
+    from tools.trace_view import validate_chrome_trace
+
+    with open(trace_out) as f:
+        trace = json.load(f)
+    assert validate_chrome_trace(trace) == e["trace_events"]
+    phases = {ev["ph"] for ev in trace["traceEvents"]}
+    assert "X" in phases                 # engine steps as slices
+    assert "b" in phases and "e" in phases   # request async spans
+
+
+@pytest.mark.fast
+def test_committed_obs_artifact_meets_acceptance():
+    """The committed obs_r15.json is the flight-recorder PR's
+    acceptance evidence: observation is nearly free — tracing-on
+    >= 0.95x tracing-off tok/s on the same trace (the A/B is
+    warm-replay-first, obs-on timed before obs-off, so the ratio is
+    conservative) — with real spans/ring behind it, everything
+    finished on both sides, and the obs-off side (the plain default
+    trace) no worse than r14's plain baseline."""
+    with open(R15) as f:
+        records = json.load(f)
+    rec = {r["metric"]: r for r in records}[OBS_METRIC]
+    e = rec["extras"]
+    assert e["obs_on_ratio"] >= 0.95, (
+        f"observation cost {1 - e['obs_on_ratio']:.1%} of throughput")
+    assert rec["vs_baseline"] == e["obs_on_ratio"]
+    assert e["obs_traces"] == e["requests"]
+    assert e["obs_spans"] > 0
+    assert e["obs_ring_steps"] > 0
+    assert e["finished"] == e["submitted"] == e["requests"]
+    # the obs-off side IS the plain default trace: no regression vs
+    # the r14 plain baseline (same trace family, same machine era)
+    with open(R14) as f:
+        r14 = [r for r in json.load(f) if r["metric"] == SERVE_METRIC]
+    assert e["obs_off_tokens_per_sec"] >= max(r["value"] for r in r14)
+
+
+@pytest.mark.fast
+def test_obs_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=OBS_METRIC)
+    assert last is not None
+    assert last["metric"] == OBS_METRIC
     assert last["value"] > 0
     assert last["source"].startswith("artifacts")
     assert last["as_of"]

@@ -65,6 +65,19 @@ Exp(rate)). Two trace shapes:
 Every mode's extras carry ``decode_steps`` and
 ``tokens_per_decode_step`` (decode_tokens / decode_steps).
 
+- ``--obs-ab``: the observability overhead A/B (quintnet_tpu/obs/):
+  the SAME default Poisson trace replayed through an engine with the
+  flight recorder armed (per-request Tracer + per-step StepRecorder)
+  and one without. Observation is contractually inert on tokens
+  (bit-identity is pinned in tests/test_obs.py); this mode prices the
+  host-side overhead — the record's value is obs-on tok/s,
+  ``vs_baseline`` the on/off ratio (the committed artifact gates it
+  >= 0.95), and extras carry the trace summary (spans, ring depth).
+  ``--trace-out FILE`` additionally writes the obs-on replay's ring +
+  spans as Chrome trace-event JSON loadable in Perfetto
+  (tools/trace_view.py renders; also accepted standalone with the
+  default trace).
+
 Modes:
   python tools/serve_bench.py --synthetic              # tiny cfg, CPU-ok
   python tools/serve_bench.py --synthetic --model llama
@@ -417,8 +430,93 @@ def _common_extras(args, s: dict) -> dict:
     }
 
 
+def _arm_obs(engine, ring_capacity: int = 4096):
+    """Attach the flight recorder to a bench engine; returns
+    (tracer, recorder)."""
+    from quintnet_tpu.obs import StepRecorder, Tracer
+
+    engine.tracer = Tracer(clock=engine.clock, max_traces=4096)
+    engine.recorder = StepRecorder(capacity=ring_capacity,
+                                   clock=engine.clock)
+    return engine.tracer, engine.recorder
+
+
+def _write_trace_out(path: str, tracer, recorder) -> dict:
+    """Write the replay's ring + spans as validated Chrome trace-event
+    JSON (Perfetto-loadable); returns the trace summary extras."""
+    import json as _json
+
+    from tools.trace_view import chrome_trace, validate_chrome_trace
+
+    ring = recorder.snapshot()
+    traces = tracer.snapshot()
+    trace = chrome_trace(ring, traces, label="serve_bench")
+    n_events = validate_chrome_trace(trace)
+    with open(path, "w") as f:
+        _json.dump(trace, f)
+    return {"trace_out": path, "trace_events": n_events}
+
+
+def _obs_summary(tracer, recorder) -> dict:
+    snap = tracer.snapshot()
+    return {
+        "obs_traces": len(snap),
+        "obs_spans": sum(len(v) for v in snap.values()),
+        "obs_ring_steps": len(recorder),
+        "obs_ring_total": recorder.total,
+    }
+
+
 def run(args) -> dict:
     tag = "tiny" if args.synthetic else "full"
+
+    if args.obs_ab:
+        # observability overhead A/B over the SAME default trace:
+        # flight recorder armed vs off. Tokens are contractually
+        # bit-identical either way (tests/test_obs.py); what this
+        # prices is the host-side span/ring bookkeeping.
+        prefix_cache = args.prefix_cache == "on"
+        # a throwaway UNTIMED replay first: process-level warm-up
+        # (first-touch jit plumbing, allocator growth) is several
+        # times the effect being measured and would otherwise be
+        # charged entirely to whichever side runs first. After it,
+        # obs-on is timed before obs-off — any residual ordering
+        # advantage goes to the OFF side, keeping the committed
+        # >= 0.95 ratio conservative.
+        eng_warm = build_engine(args, prefix_cache=prefix_cache)
+        trace = poisson_trace(args, eng_warm.family.cfg.vocab_size)
+        replay(eng_warm, trace, args)
+        del eng_warm
+        eng_on = build_engine(args, prefix_cache=prefix_cache)
+        tracer, recorder = _arm_obs(eng_on)
+        s_on = replay(eng_on, trace, args)
+        eng_off = build_engine(args, prefix_cache=prefix_cache)
+        s_off = replay(eng_off, trace, args)
+        extras = _common_extras(args, s_on)
+        extras.update(_obs_summary(tracer, recorder))
+        ratio = (round(s_on["tokens_per_sec"]
+                       / s_off["tokens_per_sec"], 3)
+                 if s_off["tokens_per_sec"] else 0.0)
+        extras.update({
+            "obs_ab": True,
+            "obs_off_tokens_per_sec": s_off["tokens_per_sec"],
+            "obs_off_wall_s": s_off["wall_s"],
+            "obs_off_gen_tokens": s_off["gen_tokens"],
+            # the overhead gate: obs-on throughput / obs-off (the
+            # committed artifact pins >= 0.95)
+            "obs_on_ratio": ratio,
+        })
+        if args.trace_out:
+            extras.update(_write_trace_out(args.trace_out, tracer,
+                                           recorder))
+        return {
+            "metric": f"serve_{args.model}_{tag}_obs_tokens_per_sec",
+            "value": s_on["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": ratio,
+            "rc": 0,
+            "extras": extras,
+        }
 
     if args.kv_capacity:
         # equal-pool-BYTES capacity A/B over the shared-prefix trace
@@ -707,12 +805,18 @@ def run(args) -> dict:
     prefix_cache = args.prefix_cache == "on"
     spec = args.spec == "on"
     engine = build_engine(args, prefix_cache=prefix_cache, spec=spec)
+    obs = None
+    if args.trace_out:
+        obs = _arm_obs(engine)     # standalone Perfetto export
     trace = poisson_trace(args, engine.family.cfg.vocab_size)
     s = replay(engine, trace, args)
     extras = _common_extras(args, s)
     extras["prefix_cache"] = prefix_cache
     extras["spec"] = spec
     extras["kv_dtype"] = args.kv_dtype
+    if obs is not None:
+        extras.update(_obs_summary(*obs))
+        extras.update(_write_trace_out(args.trace_out, *obs))
     if spec:
         extras.update({
             "spec_steps": s["spec_steps"],
@@ -820,6 +924,15 @@ def main():
                     help="synthetic-config max-positions override")
     ap.add_argument("--vocab-size", type=int, default=None,
                     help="synthetic-config vocab override")
+    ap.add_argument("--obs-ab", action="store_true",
+                    help="observability overhead A/B over the default "
+                         "trace: flight recorder (obs/) armed vs off; "
+                         "value = obs-on tok/s, vs_baseline = on/off")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the replay's flight-recorder ring + "
+                         "request spans as Chrome trace-event JSON "
+                         "(Perfetto-loadable; arms obs on the timed "
+                         "engine)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
                     help="append the record to this artifacts JSON file")

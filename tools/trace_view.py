@@ -1,0 +1,196 @@
+"""Render flight-recorder output as Chrome trace-event JSON (loadable
+in Perfetto / chrome://tracing).
+
+Input is any JSON file carrying a step ring and/or request spans in
+the obs formats (quintnet_tpu/obs/):
+
+- a crash dump (``obs/crashdump.py``: ``{"kind": "crash_dump",
+  "ring": [...], "traces": {...}}``) — the post-mortem, visualized;
+- a raw obs dump (``{"ring": [...], "traces": {...}}``) — what
+  ``tools/serve_bench.py --trace-out`` writes from a timed replay.
+
+Mapping (the Chrome trace-event format, JSON Array/Object flavor):
+
+- each engine STEP becomes a complete ("ph": "X") slice on the
+  "engine steps" thread — duration = the step's clock window, args =
+  the step's phase mix / occupancy / KV pressure / chunk + spec
+  ledgers, so the Perfetto timeline shows exactly the prefill/decode
+  interference Sarathi argues about;
+- each request SPAN becomes an async begin/end pair ("ph": "b"/"e",
+  id = trace id) on the "requests" track, instants (t1 == t0) become
+  instant events ("ph": "i") — one row per request from queue to
+  finish, migrations included (the id stitches cross-process spans).
+
+Timestamps are microseconds (the format's unit), re-based to the
+earliest event so Perfetto opens at t=0 instead of hours into a
+monotonic clock.
+
+Usage:
+  python tools/trace_view.py DUMP.json -o trace.json
+  python tools/trace_view.py DUMP.json            # stdout
+
+Library surface: :func:`chrome_trace` (dict in, dict out — the bench
+and tests call this), :func:`validate_chrome_trace` (structural check
+used by CI so the export can never drift off-format).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+_US = 1e6
+
+# pid/tid are display coordinates in the trace-event format; one
+# process row with named threads reads best in Perfetto
+PID = 1
+TID_STEPS = 1
+TID_REQUESTS = 2
+
+
+def _base_ts(ring: List[Dict], traces: Dict[str, List[Dict]]) -> float:
+    ts = [r["t0"] for r in ring]
+    ts += [s["t0"] for spans in traces.values() for s in spans]
+    return min(ts) if ts else 0.0
+
+
+def chrome_trace(ring: Optional[List[Dict]] = None,
+                 traces: Optional[Dict[str, List[Dict]]] = None,
+                 *, label: str = "quintnet-serve") -> Dict:
+    """Build the Chrome trace-event JSON object (see module
+    docstring). ``ring``: StepRecorder.snapshot(); ``traces``:
+    Tracer.snapshot()."""
+    ring = ring or []
+    traces = traces or {}
+    t_base = _base_ts(ring, traces)
+    events: List[Dict] = [
+        {"ph": "M", "pid": PID, "name": "process_name",
+         "args": {"name": label}},
+        {"ph": "M", "pid": PID, "tid": TID_STEPS, "name": "thread_name",
+         "args": {"name": "engine steps"}},
+        {"ph": "M", "pid": PID, "tid": TID_REQUESTS,
+         "name": "thread_name", "args": {"name": "requests"}},
+    ]
+    for rec in ring:
+        args = {k: v for k, v in rec.items()
+                if k not in ("t0", "t1", "attrs")}
+        args.update(rec.get("attrs") or {})
+        events.append({
+            "name": f"step {rec.get('step', '?')}",
+            "cat": "engine", "ph": "X",
+            "ts": (rec["t0"] - t_base) * _US,
+            "dur": max(rec["t1"] - rec["t0"], 0.0) * _US,
+            "pid": PID, "tid": TID_STEPS, "args": args,
+        })
+    for trace_id, spans in sorted(traces.items()):
+        for s in spans:
+            common = {"cat": "request", "id": trace_id, "pid": PID,
+                      "tid": TID_REQUESTS,
+                      "args": dict(s.get("attrs") or {})}
+            t0 = (s["t0"] - t_base) * _US
+            if s["t1"] > s["t0"]:
+                events.append({"name": s["name"], "ph": "b",
+                               "ts": t0, **common})
+                events.append({"name": s["name"], "ph": "e",
+                               "ts": (s["t1"] - t_base) * _US,
+                               **common})
+            else:
+                # instant: scope "t" (thread) keeps it a tick mark
+                events.append({"name": s["name"], "ph": "i", "s": "t",
+                               "ts": t0, **common})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"source": label}}
+
+
+def validate_chrome_trace(obj: Dict) -> int:
+    """Structural validation of a trace-event JSON object; returns the
+    event count. Raises ValueError on anything Perfetto would choke
+    on — the CI gate behind 'the export validates as Chrome
+    trace-event JSON'."""
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise ValueError("not a trace-event object: no 'traceEvents'")
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        raise ValueError("'traceEvents' must be a list")
+    open_async: Dict = {}
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            raise ValueError(f"event {i} is not an object")
+        ph = e.get("ph")
+        if ph is None or "pid" not in e or "name" not in e:
+            raise ValueError(
+                f"event {i} is missing ph/pid/name: {e}")
+        if ph == "M":
+            continue
+        if "ts" not in e or not isinstance(e["ts"], (int, float)):
+            raise ValueError(f"event {i} has no numeric ts: {e}")
+        if ph == "X":
+            if "dur" not in e or e["dur"] < 0:
+                raise ValueError(
+                    f"complete event {i} needs a dur >= 0: {e}")
+        elif ph in ("b", "e"):
+            if "id" not in e or "cat" not in e:
+                raise ValueError(
+                    f"async event {i} needs id + cat: {e}")
+            key = (e["cat"], e["id"], e["name"])
+            if ph == "b":
+                open_async[key] = open_async.get(key, 0) + 1
+            else:
+                if open_async.get(key, 0) < 1:
+                    raise ValueError(
+                        f"async end without begin at event {i}: {e}")
+                open_async[key] -= 1
+        elif ph == "i":
+            if e.get("s") not in (None, "t", "p", "g"):
+                raise ValueError(
+                    f"instant event {i} has invalid scope: {e}")
+        else:
+            raise ValueError(f"event {i} has unknown ph {ph!r}")
+    dangling = {k: v for k, v in open_async.items() if v}
+    if dangling:
+        raise ValueError(f"unbalanced async begin/end: {dangling}")
+    return len(events)
+
+
+def _load_dump(path: str) -> Dict:
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict):
+        raise SystemExit(f"{path}: expected a JSON object")
+    if "ring" not in payload and "traces" not in payload:
+        raise SystemExit(
+            f"{path}: no 'ring' or 'traces' — not a crash dump or obs "
+            f"dump (tools/serve_bench.py --trace-out writes one)")
+    return payload
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_view",
+        description="crash dump / obs dump -> Chrome trace-event JSON "
+                    "(Perfetto)")
+    ap.add_argument("dump", help="crash-dump or obs-dump JSON file")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output file (default: stdout)")
+    args = ap.parse_args(argv)
+
+    payload = _load_dump(args.dump)
+    label = payload.get("replica") or "quintnet-serve"
+    trace = chrome_trace(payload.get("ring"), payload.get("traces"),
+                         label=label)
+    validate_chrome_trace(trace)
+    text = json.dumps(trace, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {len(trace['traceEvents'])} events to "
+              f"{args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
