@@ -36,10 +36,21 @@ corpse). :class:`FrontDoor` is the asyncio HTTP/SSE server in front of
 either fleet, mapping the typed ``Overloaded`` shedding onto
 429/503 + Retry-After.
 
+Disaggregated serving (``ProcessFleet(pools={"prefill": P,
+"decode": D})``, fleet/proc.py): the two regimes run on dedicated
+replica pools — prefill replicas commit a request's first token and
+ship its KV chain to a decode replica over a checksummed wire frame
+(fleet/wire.py), retried under the shared
+:class:`~quintnet_tpu.fleet.retry.RetryPolicy` with local re-prefill
+as the always-correct fallback, and pool loss walks an explicit
+degradation ladder surfaced at /healthz.
+
 tools/fleet_bench.py replays a trace against the fleet per routing
 policy — with a mid-trace replica kill and an over-capacity burst —
 and emits one JSON record per policy (threads:
-artifacts/fleet_r08.json; ``--process``: artifacts/fleet_r12.json).
+artifacts/fleet_r08.json; ``--process``: artifacts/fleet_r12.json;
+``--disagg``: the TTFT-vs-ITL interference A/B of
+artifacts/fleet_r16.json).
 """
 
 from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
@@ -49,9 +60,11 @@ from quintnet_tpu.fleet.health import (CLOSED, DEAD, HALF_OPEN, HEALTHY,
                                        OPEN, STALLED, STARTING, STOPPED,
                                        Backoff, CircuitBreaker,
                                        HeartbeatMonitor)
-from quintnet_tpu.fleet.proc import ProcessFleet, ProcReplica, replica_main
+from quintnet_tpu.fleet.proc import (POOLS, ProcessFleet, ProcReplica,
+                                     replica_main)
 from quintnet_tpu.fleet.replica import Replica
-from quintnet_tpu.fleet.router import POLICIES, Router, eligible
+from quintnet_tpu.fleet.retry import RetryPolicy
+from quintnet_tpu.fleet.router import ANY_POOL, POLICIES, Router, eligible
 
 __all__ = [
     "AdmissionQueue",
@@ -62,10 +75,13 @@ __all__ = [
     "FrontDoor",
     "HeartbeatMonitor",
     "Overloaded",
+    "ANY_POOL",
     "POLICIES",
+    "POOLS",
     "ProcReplica",
     "ProcessFleet",
     "Replica",
+    "RetryPolicy",
     "Router",
     "ServeFleet",
     "eligible",

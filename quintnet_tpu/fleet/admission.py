@@ -30,13 +30,16 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional
 
-SHED_REASONS = ("queue_full", "deadline", "shutdown")
+SHED_REASONS = ("queue_full", "deadline", "shutdown", "pool_down")
 
 
 class Overloaded(RuntimeError):
     """Typed rejection: the fleet refused (or abandoned) a request
     instead of queueing it forever. ``reason`` is one of
-    ``queue_full`` / ``deadline`` / ``shutdown``."""
+    ``queue_full`` / ``deadline`` / ``shutdown`` / ``pool_down``
+    (disaggregated fleets only: the decode pool has no live member
+    and every breaker is tripped — queueing would hide an outage the
+    client should route around; fleet/proc.py)."""
 
     def __init__(self, reason: str, message: str):
         assert reason in SHED_REASONS, reason
@@ -102,6 +105,19 @@ class AdmissionQueue:
     def pop(self):
         """Head of the line, or None."""
         return self._items.pop(0) if self._items else None
+
+    def items(self) -> List:
+        """Queue contents in order (a read-only view for the
+        disaggregated dispatcher, which must skip past a head it has
+        no pool for — a decode-phase request waiting on its pool must
+        not block a prefill-phase request behind it)."""
+        return list(self._items)
+
+    def remove(self, item) -> None:
+        """Take one specific item out of line (the disaggregated
+        dispatcher claims the first DISPATCHABLE item, not
+        necessarily the head)."""
+        self._items.remove(item)
 
     def drain_all(self) -> List:
         """Empty the queue (shutdown path); returns what was pending."""

@@ -77,6 +77,14 @@ class FleetRequest:
         self.migrations = 0
         self.cost = 0                     # outstanding-token estimate
         self.replica_name: Optional[str] = None
+        # disaggregated-fleet state (fleet/proc.py): what KIND of
+        # dispatch this request last got ("prefill" = prefill-pool
+        # prefill-only; "full" = run to completion), and — after a
+        # successful KV handoff — which decode replica holds the
+        # imported chain (a routing PREFERENCE: landing elsewhere
+        # re-prefills locally, slower but identical)
+        self.dispatched_phase: Optional[str] = None
+        self.warm_replica: Optional[str] = None
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.output: Optional[np.ndarray] = None
@@ -142,6 +150,10 @@ class FleetMetrics:
     shed_queue_full: int = 0
     shed_deadline: int = 0
     shed_shutdown: int = 0
+    # disaggregated fleets only: decode pool hard-down (no live
+    # member, every breaker tripped) — new work shed typed instead of
+    # queueing behind a breaker that cannot act (fleet/proc.py)
+    shed_pool_down: int = 0
     # admitted requests retired MID-GENERATION at their deadline
     # (typed serve.DeadlineExceeded) — disjoint from shed_deadline,
     # which counts requests still QUEUED at expiry
@@ -150,6 +162,17 @@ class FleetMetrics:
     replica_deaths: int = 0
     stalls: int = 0                     # missed-heartbeat detections
     restarts: int = 0
+    # disaggregated prefill→decode handoffs (fleet/proc.py):
+    # ``handoffs`` counts prefill-phase completions that moved to the
+    # decode pool; ``handoff_transfers`` the KV chains that actually
+    # landed (wire frame imported, checksum good); ``handoff_retries``
+    # every retried transfer attempt; ``handoff_fallbacks`` transfers
+    # that exhausted retries and fell back to local re-prefill on the
+    # decode side (slower, token-identical — the chain is just cache)
+    handoffs: int = 0
+    handoff_transfers: int = 0
+    handoff_retries: int = 0
+    handoff_fallbacks: int = 0
     # percentile sources, reservoir-bounded like the engine's
     # (serve/metrics.Reservoir): exact below the cap, uniform sampling
     # above — a long-lived front door stops leaking one float per
@@ -162,7 +185,7 @@ class FleetMetrics:
     @property
     def shed(self) -> int:
         return (self.shed_queue_full + self.shed_deadline
-                + self.shed_shutdown)
+                + self.shed_shutdown + self.shed_pool_down)
 
     @property
     def shed_rate(self) -> float:
@@ -177,12 +200,17 @@ class FleetMetrics:
             "shed_queue_full": self.shed_queue_full,
             "shed_deadline": self.shed_deadline,
             "shed_shutdown": self.shed_shutdown,
+            "shed_pool_down": self.shed_pool_down,
             "shed_rate": round(self.shed_rate, 4),
             "deadline_exceeded": self.deadline_exceeded,
             "migrations": self.migrations,
             "replica_deaths": self.replica_deaths,
             "stalls": self.stalls,
             "restarts": self.restarts,
+            "handoffs": self.handoffs,
+            "handoff_transfers": self.handoff_transfers,
+            "handoff_retries": self.handoff_retries,
+            "handoff_fallbacks": self.handoff_fallbacks,
             "ttft_s": serve_metrics._pcts(self.ttfts),
             "latency_s": serve_metrics._pcts(self.latencies),
         }

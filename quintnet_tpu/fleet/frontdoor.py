@@ -12,10 +12,17 @@ fleet signal                          HTTP response
 ``Overloaded('queue_full')``          **429 Too Many Requests** + Retry-After
 ``Overloaded('shutdown')``            **503 Service Unavailable** + Retry-After
 ``Overloaded('deadline')``            **503 Service Unavailable** + Retry-After
+``Overloaded('pool_down')``           **503 Service Unavailable** + Retry-After
 ``serve.DeadlineExceeded``            **504 Gateway Timeout** (typed body)
 request timeout / unmet result        **504 Gateway Timeout**
 malformed request / never admissible  **400 Bad Request**
 ====================================  =======================================
+
+``GET /healthz`` on a disaggregated fleet (fleet/proc.py pools) is
+three-valued: 200 ``"ok"`` (every pool live), 200 ``"degraded"`` (one
+pool down, the fallback ladder still serves — the body's ``"pools"``
+map says which), 503 ``"unavailable"`` + Retry-After (nothing can
+serve).
 
 The degradation ladder under trouble is explicit and this is its
 first rung: **shed new work** (the typed 429/503 above, the queue
@@ -238,15 +245,46 @@ class FrontDoor:
     # routes
     # ------------------------------------------------------------------
     async def _healthz(self, writer) -> None:
+        """POOL-AWARE liveness: a disaggregated fleet (fleet/proc.py)
+        reports per-pool membership, and the status encodes the
+        degradation ladder rather than a binary —
+
+        - ``ok`` (200): every pool has a live replica;
+        - ``degraded`` (200): a pool is not serving but the node is
+          still making progress — the ``pools`` map says HOW: state
+          ``"down"`` means the fallback ladder is engaged (prefill
+          down -> the decode pool absorbs prefill work; decode down
+          -> admitted work requeues behind the breaker-gated
+          restart), state ``"recovering"`` means a restart is in
+          flight and that pool's work is HELD for it rather than
+          absorbed — 200 either way because a load balancer must NOT
+          pull a node that is still making progress;
+        - ``unavailable`` (503 + Retry-After): nothing can serve (no
+          live replica anywhere, or draining).
+
+        Colocated fleets (and the thread fleet, which reports no
+        pools) keep the original any-replica-serving mapping."""
         h = self.fleet.health()
-        serving = any(r["state"] == HEALTHY
-                      for r in h["replicas"].values())
-        h["status"] = ("ok" if serving and not h["draining"]
-                       else "unavailable")
+        pools = h.get("pools") or {}
+        if len(pools) > 1:
+            n_up = sum(1 for p in pools.values()
+                       if p.get("state") == "up")
+            if h["draining"] or n_up == 0:
+                h["status"] = "unavailable"
+            elif n_up < len(pools):
+                h["status"] = "degraded"
+            else:
+                h["status"] = "ok"
+        else:
+            serving = any(r["state"] == HEALTHY
+                          for r in h["replicas"].values())
+            h["status"] = ("ok" if serving and not h["draining"]
+                           else "unavailable")
+        unavailable = h["status"] == "unavailable"
         await self._respond(
-            writer, 200 if h["status"] == "ok" else 503, h,
-            headers=(None if h["status"] == "ok"
-                     else {"Retry-After": self._retry_after()}))
+            writer, 503 if unavailable else 200, h,
+            headers=({"Retry-After": self._retry_after()}
+                     if unavailable else None))
 
     def _retry_after(self) -> str:
         return str(int(math.ceil(self.retry_after_s)))

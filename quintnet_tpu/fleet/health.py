@@ -34,6 +34,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
+from quintnet_tpu.fleet.retry import RetryPolicy
+
 # replica lifecycle states (Replica.state / ProcReplica.state)
 HEALTHY = "healthy"
 DEAD = "dead"
@@ -91,6 +93,22 @@ class CircuitBreaker:
             return True
         return False
 
+    @property
+    def restart_conceivable(self) -> bool:
+        """Read-only: could a restart be granted now or soon WITHOUT
+        driving the state machine (``allow_restart`` transitions to
+        half-open as a side effect — unusable as a pure query)?
+        False exactly when the breaker is OPEN inside its cool-down or
+        a half-open probe is already out — the window the
+        disaggregated fleet's degradation ladder (fleet/proc.py)
+        treats a pool as hard-down and sheds typed instead of
+        queueing behind a breaker that cannot act."""
+        if self.state == CLOSED:
+            return True
+        if self.state == HALF_OPEN:
+            return False
+        return self.clock() - self._opened_at >= self.reset_s
+
 
 class HeartbeatMonitor:
     """Liveness by heartbeat age, the ONLY wedge detector that needs no
@@ -123,24 +141,22 @@ class HeartbeatMonitor:
         return self.age_s > self.budget_s
 
 
-class Backoff:
+class Backoff(RetryPolicy):
     """Jittered exponential restart backoff (the ft_run supervisor's
     relaunch discipline, made policy): attempt ``n`` (1-based) waits
     ``base * 2^(n-1)`` capped at ``cap``, times a jitter factor in
     ``[1, 1+jitter]`` so N replicas felled by one cause do not
     restart — and re-fail — in lockstep. ``rand`` is injectable for
-    deterministic tests."""
+    deterministic tests.
+
+    The math now lives in the shared
+    :class:`~quintnet_tpu.fleet.retry.RetryPolicy` (the KV-handoff
+    retry loop of the disaggregated fleet uses the same envelope);
+    this subclass keeps the restart-flavored name and its original
+    delay-only constructor."""
 
     def __init__(self, *, base_s: float = 0.05, cap_s: float = 5.0,
-                 jitter: float = 0.25, rand: Callable[[], float] = None):
-        import random
-
-        self.base_s = float(base_s)
-        self.cap_s = float(cap_s)
-        self.jitter = float(jitter)
-        self.rand = rand if rand is not None else random.random
-
-    def delay_s(self, attempt: int) -> float:
-        """Backoff before restart attempt ``attempt`` (1-based)."""
-        raw = min(self.base_s * (2 ** max(attempt - 1, 0)), self.cap_s)
-        return raw * (1.0 + self.jitter * self.rand())
+                 jitter: float = 0.25,
+                 rand: Optional[Callable[[], float]] = None):
+        super().__init__(base_s=base_s, cap_s=cap_s, jitter=jitter,
+                         rand=rand)

@@ -62,11 +62,17 @@ import numpy as np
 from quintnet_tpu.fleet import wire
 from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
 from quintnet_tpu.fleet.fleet import FleetMetrics, FleetRequest
-from quintnet_tpu.fleet.health import (DEAD, HEALTHY, STALLED, STARTING,
-                                       STOPPED, Backoff, CircuitBreaker,
-                                       HeartbeatMonitor)
-from quintnet_tpu.fleet.router import Router
+from quintnet_tpu.fleet.health import (DEAD, HEALTHY, STALLED,
+                                       STARTING, STOPPED, Backoff,
+                                       CircuitBreaker, HeartbeatMonitor)
+from quintnet_tpu.fleet.retry import RetryPolicy
+from quintnet_tpu.fleet.router import ANY_POOL, Router
 from quintnet_tpu.fleet.router import eligible as router_eligible
+
+# the two serving regimes a disaggregated fleet splits apart
+# (DistServe/Splitwise): prefill is compute-bound and bursty, decode
+# memory-bound and steady — see PAPERS.md and docs/serving.md
+POOLS = ("prefill", "decode")
 
 
 # ---------------------------------------------------------------------------
@@ -115,7 +121,7 @@ def replica_main(name: str, host: str, port: int, token: str,
     if platform:
         jax.config.update("jax_platforms", platform)
 
-    from quintnet_tpu.ft.chaos import ChaosMonkey
+    from quintnet_tpu.ft.chaos import CHAOS_KILL_EXIT_CODE, ChaosMonkey
 
     engine = _load_builder(engine_spec)(**engine_spec.get("kwargs", {}))
     if obs:
@@ -151,8 +157,8 @@ def replica_main(name: str, host: str, port: int, token: str,
     def reader() -> None:
         try:
             while True:
-                cmds.put(wire.recv_frame(sock))
-        except (wire.ConnectionClosed, OSError):
+                cmds.put(wire.recv_frame(sock, peer="dispatcher"))
+        except (wire.ConnectionClosed, wire.WireError, OSError):
             cmds.put(None)      # dispatcher went away -> shut down
 
     def heartbeat() -> None:
@@ -194,12 +200,87 @@ def replica_main(name: str, host: str, port: int, token: str,
             fid = cmd["fid"]
             try:
                 prog = wire.progress_from_wire(cmd["progress"])
-                rid = engine.restore_progress(prog, on_token=deliver)
+                rid = engine.restore_progress(
+                    prog, on_token=deliver,
+                    prefill_only=bool(cmd.get("prefill_only", False)))
                 # registered BEFORE any token can flow: restore only
                 # queues — tokens appear at the next step()
                 rid2fid[rid] = fid
             except (ValueError, KeyError, wire.WireError) as e:
                 send({"t": "reject", "fid": fid,
+                      "error": wire.error_to_wire(e)})
+        elif t == "kv_export":
+            # the disaggregated handoff, sending side: ship the
+            # published chain for a prefix as a checksummed KV frame.
+            # Chaos hooks HERE model the transfer's failure modes:
+            # 'kill' = the exporter vanishes mid-transfer, 'corrupt' =
+            # the frame is damaged AFTER its checksum (the importer
+            # must catch it), 'stall' = the reply outwaits the
+            # dispatcher's handoff timeout. 'corrupt' fires separately
+            # below, only once a frame actually exists to damage —
+            # a declined transfer must not consume the arming.
+            fault = (chaos.fire_handoff(kinds=("kill", "stall"))
+                     if chaos is not None else None)
+            if fault == "kill":
+                os._exit(CHAOS_KILL_EXIT_CODE)
+            tokens = np.asarray(cmd.get("tokens", []), np.int32)
+            chain = engine.export_kv_chain(
+                tokens, namespace=cmd.get("namespace"),
+                trace_id=cmd.get("trace_id"))
+            kv, reason = None, None
+            if chain is None:
+                reason = ("prefill replica no longer holds the chain "
+                          "(evicted before the transfer, or the "
+                          "prefix cache is off)")
+            else:
+                kv = wire.kv_chain_to_wire(chain,
+                                           namespace=cmd.get("namespace"))
+                if not wire.kv_chain_fits(kv):
+                    # shipping it would trip the receiver's frame
+                    # guard and read as a DEAD connection — decline
+                    # instead, so the dispatcher takes the documented
+                    # local-re-prefill fallback on a healthy fleet
+                    reason = (f"chain frame (~{wire.kv_chain_wire_size(kv)}"
+                              f" bytes) exceeds MAX_FRAME_BYTES "
+                              f"({wire.MAX_FRAME_BYTES}) — decode "
+                              f"replica re-prefills locally")
+                    kv = None
+                elif (chaos is not None
+                      and chaos.fire_handoff(kinds=("corrupt",))):
+                    b64 = kv["blocks"][0]["k"]["b64"]
+                    kv["blocks"][0]["k"]["b64"] = (
+                        ("A" if b64[:1] != "A" else "B") + b64[1:])
+            if fault == "stall":
+                time.sleep(chaos.handoff_stall_s)
+            send({"t": "kv", "id": cmd["id"], "kv": kv,
+                  "reason": reason})
+        elif t == "kv_import":
+            # receiving side: verify the checksum, admit the chain as
+            # a warm prefix hit. A corrupt/mismatched frame is a TYPED
+            # error reply — the dispatcher retries or falls back to
+            # local re-prefill; this replica never caches wrong KV.
+            # Only kill/stall are injectable here ('corrupt' is an
+            # export-side fault: this handler never builds a frame, so
+            # firing it would consume the arming without injecting).
+            fault = (chaos.fire_handoff(kinds=("kill", "stall"))
+                     if chaos is not None else None)
+            if fault == "kill":
+                os._exit(CHAOS_KILL_EXIT_CODE)
+            if fault == "stall":
+                # the receiving socket goes quiet past the handoff
+                # timeout (heartbeats keep flowing from their own
+                # thread — this is a TRANSFER stall, not a replica
+                # stall, and must be handled by the retry policy, not
+                # the stall detector)
+                time.sleep(chaos.handoff_stall_s)
+            try:
+                chain, ns = wire.kv_chain_from_wire(cmd["kv"])
+                n = engine.import_kv_chain(
+                    chain, namespace=ns, trace_id=cmd.get("trace_id"))
+                send({"t": "kv_ok", "id": cmd["id"],
+                      "imported": int(n)})
+            except (ValueError, KeyError, wire.WireError) as e:
+                send({"t": "kv_ok", "id": cmd["id"], "imported": 0,
                       "error": wire.error_to_wire(e)})
         elif t == "pause":
             paused = True
@@ -264,10 +345,16 @@ def replica_main(name: str, host: str, port: int, token: str,
             steps[0] += 1
             for rid in finished:
                 fid = rid2fid.pop(rid)
-                err = engine.request(rid).error
-                if err is not None:
+                req = engine.request(rid)
+                if req.error is not None:
                     send({"t": "failed", "fid": fid,
-                          "error": wire.error_to_wire(err)})
+                          "error": wire.error_to_wire(req.error)})
+                elif req.handed_off:
+                    # prefill-phase retirement (disaggregated fleet):
+                    # the first token streamed with its real last
+                    # flag, the blocks are published — tell the
+                    # dispatcher this is a HANDOFF, not a completion
+                    send({"t": "fin", "fid": fid, "handoff": True})
                 else:
                     send({"t": "fin", "fid": fid})
             if chaos is not None:
@@ -308,11 +395,16 @@ class ProcReplica:
     unchanged."""
 
     def __init__(self, name: str, fleet: "ProcessFleet",
-                 chaos_spec: Optional[Dict]):
+                 chaos_spec: Optional[Dict], *,
+                 pool: str = ANY_POOL):
         self.name = name
         self.fleet = fleet
         self.chaos_spec = chaos_spec
         self.token = uuid.uuid4().hex
+        # which serving pool this replica belongs to: "prefill" /
+        # "decode" for a disaggregated fleet, "any" (serves every
+        # phase) for colocated ones — router.eligible filters on it
+        self.pool = pool
         self.state = STARTING
         self.paused = False
         self.in_flight = 0
@@ -455,9 +547,14 @@ class ProcReplica:
                          name=f"fleet-{self.name}-reader").start()
 
     def _read_loop(self) -> None:
+        # WireError (corrupt length prefix, flipped-bit JSON, a frame
+        # truncated mid-body) is caught EXACTLY like ConnectionClosed/
+        # OSError below: a replica whose stream desynchronized is a
+        # dead replica — its work migrates off the journal — never a
+        # dispatcher crash (a replica can corrupt only itself)
         try:
             while True:
-                frame = wire.recv_frame(self.sock)
+                frame = wire.recv_frame(self.sock, peer=self.name)
                 rid = frame.get("id")
                 if rid is not None:
                     pend = self._pending.pop(rid, None)
@@ -496,16 +593,33 @@ class ProcessFleet:
     - dispatch-side connection failure = death: the send's requests
       (and everything in flight there) re-queue at the front and the
       next healthy replica takes them — the retry-with-backoff story
-      for replica connection failures.
+      for replica connection failures;
+    - ``pools={"prefill": P, "decode": D}`` DISAGGREGATES the fleet
+      (DistServe/Splitwise): prefill replicas run a prompt's prefill
+      and commit the first token (``prefill_only`` dispatch), the KV
+      chain ships to a decode replica as a checksummed wire frame
+      (``fleet/wire.kv_chain_to_wire``), and the decode replica
+      admits it as a warm prefix hit — the continuation is the
+      PROVEN journal-resume path, so disaggregated output is
+      token-identical to colocated. The handoff retries under a
+      jittered :class:`~quintnet_tpu.fleet.retry.RetryPolicy` and
+      falls back to local re-prefill on exhaustion; pool loss
+      degrades along an explicit ladder (prefill down -> decode
+      absorbs prefill work; decode down -> requeue behind the
+      breaker-gated restart, then shed typed
+      ``Overloaded('pool_down')`` once every breaker is tripped).
     """
 
     def __init__(self, engine_spec: Dict, *, n_replicas: int = 2,
+                 pools: Optional[Dict[str, int]] = None,
                  policy: str = "least_work", max_pending: int = 64,
                  max_dispatch: Optional[int] = None,
                  trip_after: int = 3, breaker_reset_s: float = 30.0,
                  heartbeat_s: float = 0.1,
                  heartbeat_budget_s: Optional[float] = None,
                  backoff: Optional[Backoff] = None,
+                 handoff_retry: Optional[RetryPolicy] = None,
+                 handoff_timeout_s: float = 60.0,
                  chaos: Optional[Sequence[Dict]] = None,
                  platform: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
@@ -513,8 +627,40 @@ class ProcessFleet:
                  spawn_timeout_s: float = 300.0,
                  obs: bool = False, crash_dir: Optional[str] = None,
                  ring_capacity: int = 512):
+        # disaggregated prefill/decode pools (DistServe/Splitwise):
+        # ``pools={"prefill": P, "decode": D}`` splits the replicas
+        # onto dedicated pools — prefill replicas run a prompt's
+        # prefill, commit the first token, then ship the KV chain to a
+        # decode replica over a checksummed wire frame; pools=None is
+        # the colocated fleet, byte-identical to the pre-pool surface
+        if pools is not None:
+            if set(pools) != set(POOLS):
+                unknown = sorted(set(pools) - set(POOLS))
+                missing = sorted(set(POOLS) - set(pools))
+                detail = "; ".join(
+                    [f"unknown: {unknown}"] * bool(unknown)
+                    + [f"missing: {missing}"] * bool(missing))
+                raise ValueError(
+                    f"pools must name exactly {POOLS}, got "
+                    f"{sorted(pools)} ({detail})")
+            if any(int(n) < 1 for n in pools.values()):
+                raise ValueError(
+                    f"each pool needs >= 1 replica, got {pools} — a "
+                    f"pool born empty has no degradation ladder to "
+                    f"climb, it just never serves")
+            n_replicas = sum(int(n) for n in pools.values())
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self._disagg = pools is not None
+        self._pools_spec = None if pools is None else {
+            k: int(v) for k, v in pools.items()}
+        # KV-handoff fault tolerance: bounded jittered-exponential
+        # retries on the transfer, then fall back to local re-prefill
+        # on the decode replica (correct because the chain is cache)
+        self._handoff_retry = handoff_retry or RetryPolicy(
+            base_s=0.05, cap_s=1.0, jitter=0.25, max_attempts=3)
+        self._handoff_timeout_s = float(handoff_timeout_s)
+        self._pool_down_seen: Dict[str, bool] = {}
         self.engine_spec = dict(engine_spec)
         self.platform = platform
         self.clock = clock
@@ -571,7 +717,16 @@ class ProcessFleet:
 
         chaos_list = [] if chaos is None else (
             list(chaos) if isinstance(chaos, (list, tuple)) else [chaos])
-        names = [f"{name_prefix}{i}" for i in range(n_replicas)]
+        if self._disagg:
+            # pool-named replicas: prefill0.., decode0.. — chaos
+            # targets, breakers, events and /healthz all speak these
+            pool_of = {f"{pool}{i}": pool
+                       for pool in POOLS
+                       for i in range(self._pools_spec[pool])}
+            names = list(pool_of)
+        else:
+            names = [f"{name_prefix}{i}" for i in range(n_replicas)]
+            pool_of = {name: ANY_POOL for name in names}
         by_target: Dict[str, Dict] = {}
         for spec in chaos_list:
             spec = dict(spec)
@@ -603,7 +758,8 @@ class ProcessFleet:
         self._accept_thread.start()
 
         self._replicas: List[ProcReplica] = [
-            ProcReplica(name, self, by_target.get(name))
+            ProcReplica(name, self, by_target.get(name),
+                        pool=pool_of[name])
             for name in names]
         self._await_hellos()
 
@@ -628,7 +784,7 @@ class ProcessFleet:
                 return
             try:
                 conn.settimeout(30.0)
-                hello = wire.recv_frame(conn)
+                hello = wire.recv_frame(conn, peer="handshake")
                 conn.settimeout(None)
                 if hello.get("t") != "hello":
                     conn.close()
@@ -655,6 +811,29 @@ class ProcessFleet:
                 missing = [r.name for r in self._replicas
                            if r.state == STARTING]
                 if not missing:
+                    if (self._disagg and self._limits is not None
+                            and not self._limits.get("prefix_cache",
+                                                     True)):
+                        # fail fast instead of silently burning the
+                        # handoff retry budget on EVERY request: the
+                        # KV handoff exports the PUBLISHED chain, and
+                        # a cache-off engine never publishes — every
+                        # transfer would fall back to local re-prefill
+                        self._closed = True
+                        for rep in self._replicas:
+                            rep.kill()
+                        try:
+                            self._listener.close()
+                        except OSError:
+                            pass
+                        raise ValueError(
+                            "disaggregated pools need "
+                            "prefix_cache=True engines: the "
+                            "prefill->decode KV handoff ships the "
+                            "published prefix chain, which a "
+                            "cache-off engine never produces — build "
+                            "the engine spec with prefix_cache=True "
+                            "or run colocated (pools=None)")
                     return
                 dead = [r.name for r in self._replicas
                         if r.state == STARTING and not r.proc.is_alive()]
@@ -730,6 +909,21 @@ class ProcessFleet:
                 raise Overloaded(
                     "deadline", f"deadline_s={deadline_s} already "
                     f"expired at submit")
+            if self._disagg and self._pool_hard_down_locked("decode"):
+                # the last rung of the decode-pool ladder: requests
+                # already admitted requeue behind the breaker-gated
+                # restart, but NEW work is shed typed — queueing it
+                # would hide an outage every breaker says is not
+                # about to heal (prefill-pool loss never sheds: the
+                # decode pool absorbs prefill work instead)
+                self.metrics.shed_pool_down += 1
+                self._emit("shed", fid=None, reason="pool_down")
+                raise Overloaded(
+                    "pool_down",
+                    "decode pool has no live replica and every "
+                    "breaker is tripped; shedding instead of queueing "
+                    "behind a breaker that cannot act — retry with "
+                    "backoff against another fleet")
             fid = self._fid_counter
             self._fid_counter += 1
             if key is None:
@@ -862,7 +1056,8 @@ class ProcessFleet:
                     self.tracer.event(freq.trace_id, "first_token",
                                       replica=rep.name)
         elif t == "fin":
-            self._finish(rep, frame["fid"])
+            self._finish(rep, frame["fid"],
+                         handoff=bool(frame.get("handoff")))
         elif t in ("failed", "reject"):
             self._reject(rep, frame["fid"],
                          wire.error_from_wire(frame["error"]))
@@ -884,10 +1079,33 @@ class ProcessFleet:
             with self._cv:
                 rep.state = STOPPED
 
-    def _finish(self, rep: ProcReplica, fid: int) -> None:
+    def _finish(self, rep: ProcReplica, fid: int, *,
+                handoff: bool = False) -> None:
         with self._cv:
             freq = rep._fid2freq.pop(fid, None)
             if freq is None:
+                return
+            incomplete = (not freq.last_seen
+                          and len(freq.committed) < freq.max_new_tokens)
+            if handoff and incomplete:
+                # prefill-phase retirement: the first token is
+                # journaled+delivered, the chain is published on the
+                # prefill replica — release the replica's counters
+                # (this dispatch is DONE for it) and move the request
+                # to the decode pool through the KV-transfer thread
+                rep.in_flight -= 1
+                rep.outstanding_tokens -= freq.cost
+                self._breakers[rep.name].record_success()
+                self._note_breaker(rep.name)
+                if self._closed:
+                    self._shed_locked(freq, "shutdown",
+                                      "fleet closed mid-handoff")
+                    return
+                self.metrics.handoffs += 1
+                threading.Thread(
+                    target=self._run_handoff, args=(rep, freq),
+                    daemon=True,
+                    name=f"handoff-{freq.fid}").start()
                 return
             self._finalize_locked(rep, freq)
 
@@ -913,6 +1131,159 @@ class ProcessFleet:
         self._open -= 1
         freq.event.set()
         self._cv.notify_all()
+
+    def _run_handoff(self, src: ProcReplica,
+                     freq: FleetRequest) -> None:
+        """Move one prefilled request's KV chain from ``src`` (its
+        prefill replica) to a decode replica, then requeue the request
+        for decode dispatch — on its OWN thread, outside the fleet
+        lock: the transfer is a pair of RPCs (export from the source,
+        import into the destination) that may block, retry and sleep,
+        none of which must stall token delivery or stall detection.
+
+        Fault-tolerant BY CONSTRUCTION, not by luck:
+
+        - every attempt runs under the shared jittered-exponential
+          :class:`~quintnet_tpu.fleet.retry.RetryPolicy` with a
+          per-RPC timeout — a stalled receiver costs one timeout, not
+          a wedged dispatcher;
+        - a SIGKILL'd source, a checksum-corrupt frame, a full
+          destination pool and a vanished destination are all just
+          failed attempts;
+        - exhaustion falls back to LOCAL RE-PREFILL on whichever
+          decode replica the request lands on: the chain is pure
+          cache, so the fallback is slower but token-identical — the
+          request is requeued either way, and ``close()`` racing the
+          transfer sheds it typed instead of stranding it."""
+        tokens = [int(t) for t in np.asarray(freq.prompt).reshape(-1)]
+        ns = freq.adapter_id
+        # the exported frame is cached ACROSS attempts: a
+        # destination-side failure (busy receiver, timeout) must not
+        # re-gather and re-ship a multi-megabyte chain the source
+        # already produced. A checksum-rejected frame (WireError from
+        # the importer) drops the cache — that frame is damaged and a
+        # fresh export is the whole point of the retry.
+        cached = {"kv": None}
+
+        def rpc_timeout_s() -> float:
+            # a deadline-bound request must not spend more wall clock
+            # in a single transfer RPC than it has left to live
+            rem = freq.remaining_deadline()
+            if rem is None:
+                return self._handoff_timeout_s
+            return min(self._handoff_timeout_s, max(rem, 0.05))
+
+        def attempt(n: int):
+            with self._cv:
+                cands = router_eligible(self._replicas, pool="decode")
+                # the SAME router pick the dispatch path uses —
+                # adapter affinity included, so a tenant's chain lands
+                # on a replica already holding its adapter instead of
+                # pinning the request (via warm_replica) to one that
+                # must load it
+                dst = (self._router.pick(cands, adapter_id=ns)
+                       if cands else None)
+            if dst is None:
+                raise OSError(
+                    "no decode replica is accepting a KV transfer")
+            if cached["kv"] is None:
+                f = src.rpc({"t": "kv_export", "tokens": tokens,
+                             "namespace": ns,
+                             "trace_id": freq.trace_id},
+                            timeout=rpc_timeout_s())
+                kv = f.get("kv")
+                if kv is None:
+                    # permanent (evicted chain, cache off, oversized
+                    # frame): a plain ValueError is NOT in retry_on —
+                    # straight to the local-re-prefill fallback
+                    raise ValueError(
+                        f.get("reason")
+                        or "prefill replica declined the KV export")
+                cached["kv"] = kv
+            f2 = dst.rpc({"t": "kv_import", "kv": cached["kv"],
+                          "trace_id": freq.trace_id},
+                         timeout=rpc_timeout_s())
+            if f2.get("error") is not None:
+                err = wire.error_from_wire(f2["error"])
+                if isinstance(err, wire.WireError):
+                    cached["kv"] = None   # frame damaged: re-export
+                raise err
+            return dst, int(f2.get("imported", 0))
+
+        def on_retry(attempt_no: int, error: BaseException) -> None:
+            with self._cv:
+                self.metrics.handoff_retries += 1
+            self._emit("handoff_retry", fid=freq.fid,
+                       trace_id=freq.trace_id, attempt=attempt_no,
+                       error=f"{type(error).__name__}: {error}")
+
+        imported, dst = 0, None
+        # the request's remaining deadline bounds the WHOLE transfer:
+        # retrying past it wastes RPCs on a request that can only be
+        # shed as expired at its next dispatch — fall back (a no-op
+        # requeue; the expired request never decodes) instead of
+        # out-waiting the client by attempts x handoff_timeout_s
+        remaining = freq.remaining_deadline()
+        policy = (self._handoff_retry if remaining is None
+                  else self._handoff_retry.bounded(remaining))
+        try:
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(
+                    f"deadline budget already spent "
+                    f"({remaining:.3f}s remaining) — skipping the KV "
+                    f"transfer")
+            # retry TRANSIENT faults only: connection loss/timeouts
+            # (OSError covers ConnectionClosed) and damaged frames
+            # (WireError). Plain ValueError/KeyError are permanent —
+            # geometry mismatch, evicted chain, declined export — and
+            # fall through to the fallback immediately instead of
+            # burning the budget re-confirming a misconfiguration.
+            dst, imported = policy.run(
+                attempt,
+                retry_on=(OSError, TimeoutError, wire.WireError),
+                on_retry=on_retry)
+        except Exception as e:  # noqa: BLE001 — the fallback is total
+            self._emit("handoff_fallback", fid=freq.fid,
+                       trace_id=freq.trace_id,
+                       error=f"{type(e).__name__}: {e}")
+            if self.tracer is not None:
+                self.tracer.event(freq.trace_id, "handoff",
+                                  fallback=True,
+                                  error=type(e).__name__)
+            with self._cv:
+                self.metrics.handoff_fallbacks += 1
+        else:
+            if imported > 0:
+                freq.warm_replica = dst.name
+                self._emit("handoff", fid=freq.fid,
+                           trace_id=freq.trace_id,
+                           from_replica=src.name, to_replica=dst.name,
+                           transferred_tokens=imported)
+                if self.tracer is not None:
+                    self.tracer.event(freq.trace_id, "handoff",
+                                      to_replica=dst.name,
+                                      transferred_tokens=imported)
+                with self._cv:
+                    self.metrics.handoff_transfers += 1
+            else:
+                # the frame landed but nothing was cached (destination
+                # pool full, or its cache off): not a wire fault, and
+                # retrying would not change it — local re-prefill
+                self._emit("handoff_fallback", fid=freq.fid,
+                           trace_id=freq.trace_id,
+                           error="import cached 0 tokens "
+                                 "(destination pool full or cache off)")
+                with self._cv:
+                    self.metrics.handoff_fallbacks += 1
+        finally:
+            with self._cv:
+                if self._closed:
+                    self._shed_locked(
+                        freq, "shutdown",
+                        "fleet closed during the KV handoff")
+                else:
+                    self._queue.push_front([freq])
+                    self._cv.notify_all()
 
     def _reject(self, rep: ProcReplica, fid: int,
                 error: BaseException) -> None:
@@ -1064,8 +1435,45 @@ class ProcessFleet:
             migrated.append(freq)
         self._queue.push_front(migrated)
 
+    def _pool_members(self, pool: str) -> List["ProcReplica"]:
+        return [r for r in self._replicas if r.pool == pool]
+
+    def _pool_alive_locked(self, pool: str) -> bool:
+        """Does the pool have a member that serves now or is coming up
+        (STARTING = a restart already in flight)? The degradation
+        ladder keys on this: prefill down -> decode absorbs prefill
+        work; decode down -> requests requeue behind the breaker."""
+        return any(r.state in (HEALTHY, STARTING)
+                   for r in self._pool_members(pool))
+
+    def _pool_hard_down_locked(self, pool: str) -> bool:
+        """No live member AND no breaker that could grant a restart
+        (all tripped inside their cool-down): queueing new work would
+        hide an outage the client should route around — the shed rung
+        of the ladder (typed ``Overloaded('pool_down')``)."""
+        members = self._pool_members(pool)
+        if any(r.state in (HEALTHY, STARTING) for r in members):
+            return False
+        return all(not self._breakers[r.name].restart_conceivable
+                   for r in members)
+
+    def _tend_pools_locked(self) -> None:
+        """Edge-detected pool health events: a pool losing its last
+        live replica emits ``pool_degraded`` once (and
+        ``pool_recovered`` when it serves again) — the obs trail of
+        the fallback ladder."""
+        if not self._disagg:
+            return
+        for pool in POOLS:
+            down = not self._pool_alive_locked(pool)
+            if down != self._pool_down_seen.get(pool, False):
+                self._pool_down_seen[pool] = down
+                self._emit("pool_degraded" if down else "pool_recovered",
+                           pool=pool)
+
     def _tend_locked(self) -> None:
         now = self.clock()
+        self._tend_pools_locked()
         for i, rep in enumerate(self._replicas):
             if rep.state == STARTING:
                 if not rep.proc.is_alive():
@@ -1096,7 +1504,8 @@ class ProcessFleet:
             chaos_spec = rep.chaos_spec
             if not (chaos_spec or {}).get("rearm", False):
                 chaos_spec = None   # one-shot faults do not respawn
-            self._replicas[i] = ProcReplica(rep.name, self, chaos_spec)
+            self._replicas[i] = ProcReplica(rep.name, self, chaos_spec,
+                                            pool=rep.pool)
             self.metrics.restarts += 1
             self._emit("replica_restart", replica=rep.name)
 
@@ -1121,24 +1530,86 @@ class ProcessFleet:
         freq.event.set()
         self._cv.notify_all()
 
+    def _route_disagg_locked(self, freq: FleetRequest):
+        """Pool routing for one queued request (fleet lock held).
+        Returns ``(replica, mode)`` — mode ``"prefill"`` dispatches
+        prefill-only (first token + published chain, then handoff),
+        ``"full"`` runs to completion — or ``(None, None)`` when
+        nothing can take it NOW (it stays queued: the requeue rung).
+
+        The degradation ladder, encoded:
+
+        - prefill phase, prefill pool has candidates -> prefill pool;
+        - prefill phase, prefill pool DOWN (no live/starting member)
+          -> the decode pool absorbs the whole request, colocated
+          style (mode "full", no handoff) — slower for decode tails,
+          but the fleet keeps serving;
+        - prefill pool merely BUSY (live but at its dispatch window)
+          -> wait; absorbing would defeat the isolation the pools buy;
+        - decode phase -> decode pool only, preferring the replica a
+          successful KV handoff warmed; decode pool empty -> the
+          request requeues behind the breaker-gated restart (new
+          submits shed typed once every breaker is tripped —
+          :meth:`submit`)."""
+        if not freq.committed:
+            cands = router_eligible(self._replicas, pool="prefill")
+            if cands:
+                return (self._router.pick(
+                    cands, adapter_id=freq.adapter_id), "prefill")
+            if not self._pool_alive_locked("prefill"):
+                cands = router_eligible(self._replicas, pool="decode")
+                if cands:
+                    return (self._router.pick(
+                        cands, adapter_id=freq.adapter_id), "full")
+            return None, None
+        cands = router_eligible(self._replicas, pool="decode")
+        if not cands:
+            return None, None
+        if freq.warm_replica is not None:
+            warm = next((r for r in cands
+                         if r.name == freq.warm_replica), None)
+            if warm is not None:
+                return warm, "full"
+        return self._router.pick(cands,
+                                 adapter_id=freq.adapter_id), "full"
+
     def _reserve_dispatch_locked(self):
-        """Pick a replica and claim the queue head for it (fleet lock
+        """Pick a replica and claim a queued request for it (fleet lock
         held): ownership — ``rep._fid2freq`` and the routing counters —
         is established HERE, so the payload construction and the
         socket write can happen OUTSIDE the lock without racing the
-        journal or a migration. Returns (rep, freq) or None."""
+        journal or a migration. Returns (rep, freq) or None.
+
+        Colocated fleets dispatch the queue head. Disaggregated fleets
+        dispatch the FIRST DISPATCHABLE request in queue order — a
+        decode-phase request waiting for its pool must not block a
+        prefill-phase request behind it (head-of-line isolation
+        between the two regimes is half the point of the split)."""
         for freq in self._queue.shed_expired():
             self._shed_locked(
                 freq, "deadline",
                 f"request {freq.fid} still queued at its deadline")
         if not len(self._queue):
             return None
-        cands = router_eligible(self._replicas)
-        if not cands:
-            return None
-        rep = self._router.pick(
-            cands, adapter_id=self._queue.peek_adapter_id())
-        freq = self._queue.pop()
+        if not self._disagg:
+            cands = router_eligible(self._replicas)
+            if not cands:
+                return None
+            rep = self._router.pick(
+                cands, adapter_id=self._queue.peek_adapter_id())
+            freq = self._queue.pop()
+            freq.dispatched_phase = "full"
+        else:
+            rep = freq = None
+            for cand in self._queue.items():
+                got, mode = self._route_disagg_locked(cand)
+                if got is not None:
+                    rep, freq = got, cand
+                    freq.dispatched_phase = mode
+                    break
+            if freq is None:
+                return None
+            self._queue.remove(freq)
         freq.cost = freq.outstanding_cost()
         freq.replica_name = rep.name
         rep._fid2freq[freq.fid] = freq
@@ -1177,7 +1648,9 @@ class ProcessFleet:
             payload = wire.progress_to_wire(self._progress_for(freq))
             try:
                 rep.send({"t": "submit", "fid": freq.fid,
-                          "progress": payload})
+                          "progress": payload,
+                          "prefill_only":
+                              freq.dispatched_phase == "prefill"})
             except OSError:
                 # connection failure AT dispatch (dead socket, or a
                 # send timed out against a wedged peer): the replica
@@ -1364,10 +1837,36 @@ class ProcessFleet:
 
     def health(self) -> Dict:
         """Cheap liveness snapshot (no RPCs) — what the HTTP front
-        door's /healthz serves."""
+        door's /healthz serves. ``pools`` reports each pool's live
+        membership so the front door can distinguish DEGRADED (one
+        pool down, the fallback ladder still serves) from
+        unavailable (nothing can serve); colocated fleets report one
+        ``"any"`` pool."""
         with self._cv:
+            pools: Dict[str, Dict] = {}
+            for r in self._replicas:
+                p = pools.setdefault(r.pool, {"replicas": [],
+                                              "healthy": 0,
+                                              "starting": 0})
+                p["replicas"].append(r.name)
+                if r.state == HEALTHY:
+                    p["healthy"] += 1
+                elif r.state == STARTING:
+                    p["starting"] += 1
+            # three-valued, mirroring the routing ladder's aliveness
+            # (_pool_alive_locked counts STARTING too): "recovering"
+            # = no member serves NOW but a restart is in flight, so
+            # the dispatcher HOLDS that pool's work instead of
+            # engaging the fallback ladder — an operator reading
+            # "down" would expect the ladder (absorb/requeue/shed) to
+            # be serving, which it is not during the spawn window
+            for p in pools.values():
+                p["state"] = ("up" if p["healthy"] > 0
+                              else "recovering" if p["starting"] > 0
+                              else "down")
             return {
                 "replicas": {r.name: {"state": r.state,
+                                      "pool": r.pool,
                                       "pid": r.pid,
                                       "steps": r.steps,
                                       "in_flight": r.in_flight,
@@ -1376,6 +1875,8 @@ class ProcessFleet:
                                       "breaker":
                                           self._breakers[r.name].state}
                              for r in self._replicas},
+                "pools": pools,
+                "disaggregated": self._disagg,
                 "queue_depth": len(self._queue),
                 "open_requests": self._open,
                 "draining": self._draining,
@@ -1426,6 +1927,7 @@ class ProcessFleet:
             per_replica = {
                 rep.name: {
                     "state": rep.state,
+                    "pool": rep.pool,
                     "pid": rep.pid,
                     "steps": rep.steps,
                     "in_flight": rep.in_flight,
@@ -1436,6 +1938,7 @@ class ProcessFleet:
                 } for rep in self._replicas}
         out = self.metrics.summary()
         out["policy"] = self._router.policy
+        out["disaggregated"] = self._disagg
         out["replicas"] = per_replica
         out["tokens_delivered"] = self.tokens_delivered()
         out["engines"] = {name: s["metrics"]
@@ -1454,6 +1957,15 @@ class ProcessFleet:
         for name, s in self.replica_stats().items():
             if s["admitted"] == 0:
                 continue
+            expect_decode = decode
+            if self.replica(name).pool == "prefill":
+                # a prefill-pool replica legitimately never runs the
+                # decode program (its requests retire at the first
+                # token) — but warmup() compiles it, so accept 0 OR
+                # the fleet-wide expectation, never more
+                observed = int(s["compile"].get("decode", 0))
+                if observed in (0, decode):
+                    expect_decode = observed
             check_serving_compile_counts(
                 f"replica {name}", s["compile"],
-                max_prefill=prefill, decode=decode)
+                max_prefill=prefill, decode=expect_decode)

@@ -35,17 +35,29 @@ from quintnet_tpu.fleet.health import HEALTHY
 
 POLICIES = ("least_work", "round_robin")
 
+# a replica without a pool assignment serves every phase (colocated
+# fleets, and the thread fleet's Replica which predates pools)
+ANY_POOL = "any"
 
-def eligible(replicas: List) -> List:
+
+def eligible(replicas: List, *, pool: Optional[str] = None) -> List:
     """The dispatch-candidate predicate both fleets share (threads:
     fleet/fleet.py; processes: fleet/proc.py): serving state, not
     paused, below its dispatch window. STARTING (process still
     building its engine) and STALLED (missed heartbeats) replicas fail
     the state test exactly like DEAD ones — a stalled replica is
-    routed AROUND, never at."""
+    routed AROUND, never at.
+
+    ``pool`` narrows to one pool of a disaggregated fleet
+    (fleet/proc.py): a candidate matches when it belongs to that pool
+    or carries no pool assignment (``"any"`` — colocated replicas
+    serve every phase). ``pool=None`` keeps the colocated behavior
+    byte-identical."""
     return [r for r in replicas
             if r.state == HEALTHY and not r.paused
-            and r.in_flight < r.max_dispatch]
+            and r.in_flight < r.max_dispatch
+            and (pool is None
+                 or getattr(r, "pool", ANY_POOL) in (pool, ANY_POOL))]
 
 
 class Router:

@@ -17,6 +17,17 @@ anything a future remote dispatcher would persist) goes through here:
   build does not speak is rejected with an actionable
   :class:`WireVersionError` naming both versions — never a KeyError
   three fields deep.
+- **KV-block frames** — ``kv_chain_to_wire``/``kv_chain_from_wire``
+  for the disaggregated fleet's prefill→decode handoff: a published
+  prefix chain exported from one replica's :class:`KVPool` (int8
+  blocks + per-block scales when the pool is quantized — PR 10's
+  layout makes the transfer ~4x smaller at equal positions) framed
+  with a **per-frame CRC32 over the canonical payload** so a
+  corrupted or truncated transfer is detected at the importer as a
+  typed :class:`WireError`, never silently admitted as wrong KV. The
+  chain is pure CACHE: an importer that rejects (or never receives)
+  the frame falls back to local re-prefill — slower, never wrong —
+  which is what makes checksum-reject a safe answer.
 - **framing** — ``send_frame``/``recv_frame``: 4-byte big-endian
   length prefix + UTF-8 JSON over any stream socket. JSON, not pickle:
   a replica process must never be able to execute code in the
@@ -24,6 +35,9 @@ anything a future remote dispatcher would persist) goes through here:
   with tcpdump. Arrays ride as base64 raw bytes + dtype + shape, so a
   PRNG key round-trips bit-exactly (a float/list round-trip would not
   be bit-exact for every dtype and the resume contract IS bit-exactness).
+  ``recv_frame(..., peer=...)`` names the counterparty in every
+  framing error — a dispatcher watching three pools of replicas must
+  know WHICH socket desynchronized without correlating stack traces.
 
 The committed-tokens-only discipline of ``RequestProgress``
 (speculative drafts never reach an export, serve/scheduler.py) is what
@@ -38,7 +52,8 @@ from __future__ import annotations
 import base64
 import json
 import struct
-from typing import Dict, Optional, Tuple
+import zlib
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -207,6 +222,216 @@ def request_from_wire(payload: Dict):
 
 
 # ---------------------------------------------------------------------------
+# KV-block chain — the disaggregated prefill→decode handoff payload
+
+
+# geometry fields a KV frame must agree on with the importing pool —
+# a mismatch is a deployment error (mixed engine specs in one fleet),
+# surfaced as a typed WireError at import, never a shape crash inside
+# a jitted program
+KV_GEOMETRY_FIELDS = ("policy", "block_size", "n_layers", "n_kv_heads",
+                      "head_dim")
+
+
+def kv_chain_checksum(payload: Dict,
+                      _decoded: Optional[List] = None,
+                      _raw: Optional[List[Dict]] = None) -> int:
+    """CRC32 over the frame's header (canonical JSON, minus the
+    checksum and the block list) chained with every block's RAW bytes
+    — dtype/shape descriptors and decoded array data, not their
+    base64/JSON spelling. Hashing the raw bytes keeps the checksum
+    O(chain bytes) with no re-serialization of megabyte payloads (the
+    decode replica verifies this between decode steps), while still
+    catching any flip in geometry, fill counts, array metadata or
+    payload bits. Two internal hooks keep each hot path to ONE pass
+    over the chain bytes: ``_decoded`` (:func:`kv_chain_from_wire`)
+    collects each block's arrays as they are base64-decoded for
+    hashing, and ``_raw`` (:func:`kv_chain_to_wire`) supplies the
+    per-block raw bytes the encoder just serialized so the export
+    side never base64-decodes what it just encoded."""
+    head = {k: v for k, v in payload.items()
+            if k not in ("crc32", "blocks")}
+    crc = zlib.crc32(json.dumps(head, sort_keys=True,
+                                separators=(",", ":")).encode("utf-8"))
+    for i, b in enumerate(payload.get("blocks", ())):
+        if not isinstance(b, dict):
+            raise WireError(
+                f"kv_chain block entry is {type(b).__name__}, "
+                f"expected a dict — cannot checksum the frame")
+        try:
+            fill = int(b.get("fill", -1))
+        except (TypeError, ValueError) as e:
+            # null / non-numeric fill from a buggy or corrupted peer:
+            # a TYPED error the import handler maps to a failed
+            # transfer — never a TypeError that escapes replica_main
+            # and reads as a replica death
+            raise WireError(
+                f"kv_chain block field 'fill' is malformed ({e}) — "
+                f"cannot checksum the frame") from e
+        crc = zlib.crc32(str(fill).encode("ascii"), crc)
+        rec = {"fill": fill} if _decoded is not None else None
+        raws = _raw[i] if _raw is not None else None
+        for key in ("k", "v", "k_scale", "v_scale"):
+            d = b.get(key)
+            if d is None:
+                crc = zlib.crc32(b"\x00none", crc)
+                if rec is not None:
+                    rec[key] = None
+                continue
+            try:
+                meta = json.dumps({"dtype": d["dtype"],
+                                   "shape": d["shape"]},
+                                  sort_keys=True,
+                                  separators=(",", ":"))
+                raw = (raws[key] if raws is not None
+                       else base64.b64decode(d["b64"]))
+                if rec is not None:
+                    rec[key] = np.frombuffer(
+                        raw, dtype=np.dtype(d["dtype"])).reshape(
+                            d["shape"]).copy()
+            except (KeyError, TypeError, ValueError) as e:
+                raise WireError(
+                    f"kv_chain block field {key!r} is malformed "
+                    f"({e}) — cannot checksum the frame") from e
+            crc = zlib.crc32(meta.encode("utf-8"), crc)
+            crc = zlib.crc32(raw, crc)
+        if rec is not None:
+            _decoded.append(rec)
+    return crc & 0xFFFFFFFF
+
+
+def kv_chain_wire_size(payload: Dict) -> int:
+    """Conservative OVER-estimate of the framed byte size of a
+    KV-chain payload without serializing it (the b64 strings dominate;
+    keys, digits and punctuation ride in the per-field slack)."""
+    size = 4096
+    tokens = payload.get("tokens")
+    if isinstance(tokens, dict):
+        size += len(tokens.get("b64", "")) + 256
+    for b in payload.get("blocks", ()):
+        size += 512
+        for key in ("k", "v", "k_scale", "v_scale"):
+            d = b.get(key)
+            if isinstance(d, dict):
+                size += len(d.get("b64", "")) + 256
+    return size
+
+
+def kv_chain_fits(payload: Dict) -> bool:
+    """Would this KV-chain frame fit under :data:`MAX_FRAME_BYTES`?
+    The EXPORTER must check before shipping: an oversized frame would
+    trip the receiver's length guard, which reads as a desynchronized
+    stream and kills the CONNECTION — turning a healthy replica into
+    a declared death. Declining the transfer instead lets the handoff
+    take its documented fallback (local re-prefill on the decode
+    side: slower, never wrong)."""
+    return kv_chain_wire_size(payload) <= MAX_FRAME_BYTES
+
+
+def kv_chain_to_wire(chain: Dict, *,
+                     namespace: Optional[str] = None) -> Dict:
+    """Serialize one exported prefix chain
+    (:meth:`~quintnet_tpu.serve.kv_pool.KVPool.export_chain`): the
+    covered token prefix, the pool geometry the blocks were laid out
+    under, and each block's slot data (+ per-block-per-head scales for
+    scaled policies) as raw bytes — int8 blocks ship as int8, which is
+    what makes a quantized handoff ~4x smaller than f32. The frame
+    carries a CRC32 so the importer can refuse a corrupted transfer
+    with a typed error instead of caching wrong KV."""
+    def enc(a):
+        """(encoded dict, raw bytes): the same bytes the b64 field
+        spells, kept so the checksum hashes them directly instead of
+        base64-decoding what this function just encoded."""
+        if a is None:
+            return None, None
+        a = np.ascontiguousarray(a)
+        raw = a.tobytes()
+        return {"dtype": str(a.dtype), "shape": list(a.shape),
+                "b64": base64.b64encode(raw).decode("ascii")}, raw
+
+    blocks, raw_blocks = [], []
+    for b in chain["blocks"]:
+        rec, raws = {"fill": int(b["fill"])}, {}
+        for key in ("k", "v", "k_scale", "v_scale"):
+            # k/v are mandatory in an exported chain; scales only
+            # exist under scaled layout policies
+            a = b[key] if key in ("k", "v") else b.get(key)
+            rec[key], raws[key] = enc(a)
+        blocks.append(rec)
+        raw_blocks.append(raws)
+    payload = {
+        "kind": "kv_chain",
+        "v": WIRE_VERSION,
+        "namespace": namespace,
+        "n_tokens": int(chain["n_tokens"]),
+        "tokens": _enc_array(np.asarray(chain["tokens"], np.int32)),
+        "policy": str(chain["policy"]),
+        "block_size": int(chain["block_size"]),
+        "n_layers": int(chain["n_layers"]),
+        "n_kv_heads": int(chain["n_kv_heads"]),
+        "head_dim": int(chain["head_dim"]),
+        "blocks": blocks,
+    }
+    payload["crc32"] = kv_chain_checksum(payload, _raw=raw_blocks)
+    return payload
+
+
+def kv_chain_from_wire(payload: Dict) -> Tuple[Dict, Optional[str]]:
+    """Decode + VERIFY one KV-chain frame; returns ``(chain,
+    namespace)`` in :meth:`KVPool.import_chain` shape. A checksum
+    mismatch — a flipped bit, a truncated block, any corruption the
+    transport let through — is a typed :class:`WireError`: the
+    importer discards the frame and the handoff either retries or
+    falls back to local re-prefill (correct because the chain is just
+    cache). Never raises a raw ``KeyError``/``struct.error``."""
+    _check_header(payload, "kv_chain")
+    _require(payload, "kv_chain", "crc32", "tokens", "n_tokens",
+             "blocks", *KV_GEOMETRY_FIELDS)
+    if not isinstance(payload["blocks"], list) or not payload["blocks"]:
+        raise WireError("kv_chain payload carries no blocks")
+    for b in payload["blocks"]:
+        if not isinstance(b, dict):
+            raise WireError(
+                f"kv_chain block entry is {type(b).__name__}, "
+                f"expected a dict")
+        _require(b, "kv_chain block", "fill", "k", "v")
+    want = payload["crc32"]
+    # the checksum walk base64-decodes every array to hash its raw
+    # bytes; collect them as it goes so the hot path (decode replica,
+    # between decode steps) never decodes a megabyte chain twice
+    blocks: List = []
+    got = kv_chain_checksum(payload, _decoded=blocks)
+    if got != want:
+        raise WireError(
+            f"kv_chain checksum mismatch (frame says {want:#010x}, "
+            f"payload hashes to {got:#010x}) — the KV transfer was "
+            f"corrupted in flight; discarding the frame (the handoff "
+            f"retries or the decode replica re-prefills locally)")
+    try:
+        chain = {
+            "n_tokens": int(payload["n_tokens"]),
+            "tokens": _dec_array(payload["tokens"]),
+            "policy": payload["policy"],
+            "block_size": int(payload["block_size"]),
+            "n_layers": int(payload["n_layers"]),
+            "n_kv_heads": int(payload["n_kv_heads"]),
+            "head_dim": int(payload["head_dim"]),
+            "blocks": blocks,
+        }
+    except WireError:
+        raise               # _dec_array already typed it precisely
+    except (TypeError, ValueError) as e:
+        # null / non-numeric geometry from a buggy peer checksums
+        # consistently (the peer hashed the same nulls), so it reaches
+        # here — surface it typed, never a TypeError that escapes the
+        # import handler and reads as a replica death
+        raise WireError(
+            f"kv_chain geometry field is malformed ({e}); "
+            f"discarding the frame") from e
+    return chain, payload.get("namespace")
+
+
+# ---------------------------------------------------------------------------
 # typed errors (shed / deadline / request-scoped rejections)
 
 
@@ -222,6 +447,13 @@ def error_to_wire(e: BaseException) -> Dict:
         out["type"] = "deadline_exceeded"
         out["rid"] = getattr(e, "rid", None)
         out["generated"] = getattr(e, "generated", 0)
+    elif isinstance(e, WireError):
+        # distinct from a plain ValueError ON PURPOSE: a WireError is
+        # a damaged/mis-framed payload — TRANSIENT, the handoff retry
+        # loop re-exports — while a plain ValueError (geometry
+        # mismatch, evicted chain) is permanent and goes straight to
+        # the fallback
+        out["type"] = "wire_error"
     elif isinstance(e, KeyError):
         out["type"] = "key_error"
     else:
@@ -244,6 +476,8 @@ def error_from_wire(payload: Dict) -> BaseException:
     if t == "deadline_exceeded":
         return DeadlineExceeded(msg, rid=payload.get("rid"),
                                 generated=int(payload.get("generated", 0)))
+    if t == "wire_error":
+        return WireError(msg)
     if t == "key_error":
         return KeyError(msg)
     return ValueError(msg)
@@ -260,35 +494,48 @@ def send_frame(sock, obj: Dict) -> None:
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
-def _recv_exact(sock, n: int) -> bytes:
+def _peer_name(peer: Optional[str]) -> str:
+    return repr(peer) if peer else "peer"
+
+
+def _recv_exact(sock, n: int, *, peer: Optional[str] = None) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise ConnectionClosed(
-                f"peer closed the connection mid-frame "
+                f"{_peer_name(peer)} closed the connection mid-frame "
                 f"({len(buf)}/{n} bytes received)")
         buf.extend(chunk)
     return bytes(buf)
 
 
-def recv_frame(sock) -> Dict:
+def recv_frame(sock, *, peer: Optional[str] = None) -> Dict:
     """Blocking read of one frame; raises :class:`ConnectionClosed` on
     EOF (a SIGKILL'd peer looks like EOF after the kernel flushes
     whatever it had buffered — the dispatcher drains those frames
-    first, which is what keeps the token journal complete)."""
+    first, which is what keeps the token journal complete). ``peer``
+    names the counterparty in every error — a truncated frame, a
+    corrupt length prefix or non-JSON bytes all surface as typed
+    :class:`ConnectionClosed`/:class:`WireError` naming WHO
+    desynchronized, never a raw ``struct.error`` (``_LEN.unpack``
+    only ever sees exactly 4 bytes) or a bare ``JSONDecodeError``."""
     head = sock.recv(_LEN.size)
     if not head:
-        raise ConnectionClosed("peer closed the connection")
+        raise ConnectionClosed(
+            f"{_peer_name(peer)} closed the connection")
     if len(head) < _LEN.size:
-        head += _recv_exact(sock, _LEN.size - len(head))
+        head += _recv_exact(sock, _LEN.size - len(head), peer=peer)
     (n,) = _LEN.unpack(head)
     if n > MAX_FRAME_BYTES:
         raise WireError(
-            f"frame length {n} exceeds MAX_FRAME_BYTES "
-            f"({MAX_FRAME_BYTES}) — corrupt length prefix or a "
-            f"desynchronized stream")
+            f"frame length {n} from {_peer_name(peer)} exceeds "
+            f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES}) — corrupt length "
+            f"prefix or a desynchronized stream")
     try:
-        return json.loads(_recv_exact(sock, n).decode("utf-8"))
-    except json.JSONDecodeError as e:
-        raise WireError(f"frame is not valid JSON: {e}") from e
+        return json.loads(_recv_exact(sock, n, peer=peer)
+                          .decode("utf-8"))
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise WireError(
+            f"frame from {_peer_name(peer)} is not valid JSON "
+            f"(flipped bits or a desynchronized stream): {e}") from e

@@ -33,7 +33,7 @@ import os
 import signal
 import sys
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 # Distinct from PREEMPTED_EXIT_CODE (graceful): a hard chaos kill looks
 # like an unannounced node loss. Supervisors restart on both.
@@ -85,8 +85,23 @@ class ChaosMonkey:
     fail_restores: int = 0
     target: Optional[str] = None
     rearm: bool = False
+    # KV-handoff fault (disaggregated serving, fleet/proc.py): fired
+    # when the armed replica participates in a prefill→decode KV
+    # transfer. 'kill' = the exporting process dies mid-transfer (an
+    # abrupt exit, no reply ever sent); 'corrupt' = the exported frame
+    # is bit-flipped AFTER its checksum was computed, so the importer
+    # must detect it; 'stall' = the receiving side sits on the frame
+    # past the dispatcher's handoff timeout. Fires once per arming
+    # (``rearm=True`` re-fires on every transfer — how tests exhaust
+    # the retry budget and force the local re-prefill fallback).
+    handoff: Optional[str] = None   # kill | corrupt | stall
+    # how long 'stall' sits on a frame — must exceed the dispatcher's
+    # handoff timeout to inject anything (ProcessFleet defaults
+    # handoff_timeout_s=60; a shorter sleep is just a slow success)
+    handoff_stall_s: float = 90.0
     killed: bool = field(default=False, init=False)
     stalled: bool = field(default=False, init=False)
+    handoff_fired: bool = field(default=False, init=False)
     restore_failures_injected: int = field(default=0, init=False)
 
     @staticmethod
@@ -100,7 +115,9 @@ class ChaosMonkey:
             mode=spec.get("mode", "hard"),
             fail_restores=int(spec.get("fail_restores", 0)),
             target=spec.get("target"),
-            rearm=bool(spec.get("rearm", False)))
+            rearm=bool(spec.get("rearm", False)),
+            handoff=spec.get("handoff"),
+            handoff_stall_s=float(spec.get("handoff_stall_s", 90.0)))
 
     def on_step_end(self, global_step: int) -> None:
         """Die if the armed step was just completed (idempotent: the
@@ -129,6 +146,28 @@ class ChaosMonkey:
         sys.stdout.flush()
         os._exit(CHAOS_KILL_EXIT_CODE)
 
+    def fire_handoff(self, kinds: Optional[Tuple[str, ...]] = None
+                     ) -> Optional[str]:
+        """Consume the armed KV-handoff fault: returns its kind
+        ('kill'/'corrupt'/'stall') exactly once per arming — or every
+        time with ``rearm=True``, which is how a test makes the
+        dispatcher's retry budget run dry — and ``None`` otherwise.
+        The CALLER injects the fault (the replica process serving the
+        kv_export/kv_import frame, fleet/proc.py replica_main); the
+        monkey only decides whether this transfer is the unlucky one.
+        ``kinds`` restricts which faults THIS site can inject: an
+        armed fault of another kind is left armed — NOT consumed — so
+        e.g. 'corrupt' armed against a decode replica (whose import
+        handler cannot flip an outgoing frame) stays live instead of
+        silently burning its one shot."""
+        if self.handoff is None or (self.handoff_fired
+                                    and not self.rearm):
+            return None
+        if kinds is not None and self.handoff not in kinds:
+            return None
+        self.handoff_fired = True
+        return self.handoff
+
     def rearm_now(self) -> None:
         """Reset the fired state so the fault triggers again (the
         fleet calls this when restarting a chaos-killed replica with
@@ -137,6 +176,7 @@ class ChaosMonkey:
         ``kill_at_step``."""
         self.killed = False
         self.stalled = False
+        self.handoff_fired = False
 
     def on_restore_attempt(self, step: int) -> None:
         """Raise for the first ``fail_restores`` attempts (counted across

@@ -45,11 +45,12 @@ from quintnet_tpu.obs.crashdump import load_crash_dump, write_crash_dump
 from quintnet_tpu.obs.events import EVENT_KINDS, EventLog
 from quintnet_tpu.obs.prom import parse_exposition, render_exposition
 from quintnet_tpu.obs.recorder import StepRecord, StepRecorder
-from quintnet_tpu.obs.trace import Span, Tracer
+from quintnet_tpu.obs.trace import SPAN_NAMES, Span, Tracer
 
 __all__ = [
     "EVENT_KINDS",
     "EventLog",
+    "SPAN_NAMES",
     "Span",
     "StepRecord",
     "StepRecorder",

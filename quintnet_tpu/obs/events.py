@@ -36,6 +36,15 @@ EVENT_KINDS = frozenset({
     "drain",                # graceful shutdown began
     "close",                # hard stop
     "crash_dump",           # post-mortem file written (attrs: path)
+    # disaggregated prefill/decode pools (fleet/proc.py)
+    "handoff",              # prefill done -> request moves to decode
+    #                         (attrs: transferred tokens or fallback)
+    "handoff_retry",        # one KV-transfer attempt failed, retrying
+    #                         (attrs: attempt, error)
+    "handoff_fallback",     # transfer exhausted retries; decode-side
+    #                         local re-prefill serves instead
+    "pool_degraded",        # a pool lost its last live replica
+    "pool_recovered",       # a down pool is serving again
 })
 
 

@@ -35,6 +35,27 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+# Well-known span/event names recorded across the serving stack — a
+# registry for dashboards and the trace_view exporter. Tracers accept
+# any name at runtime (a site-specific span is better recorded under a
+# fresh name than forced into an old one), but tests/test_obs.py pins
+# engine-emitted names to this set so it cannot silently drift: add
+# the name here when you add a recording site.
+# Engine (serve/engine.py): submit, queue, admit, prefill,
+#   prefill_chunk, decode, verify, preempt, deadline_exceeded, export,
+#   restore, finish (attrs.handed_off marks a disaggregated prefill
+#   retirement), kv_export, kv_import.
+# Fleet (fleet/fleet.py, fleet/proc.py): fleet_submit, fleet_queue,
+#   dispatch, first_token, migration, handoff (attrs: to_replica /
+#   fallback — the prefill→decode KV transfer outcome).
+SPAN_NAMES = frozenset({
+    "submit", "queue", "admit", "prefill", "prefill_chunk", "decode",
+    "verify", "preempt", "deadline_exceeded", "export", "restore",
+    "finish", "kv_export", "kv_import",
+    "fleet_submit", "fleet_queue", "dispatch", "first_token",
+    "migration", "handoff",
+})
+
 
 @dataclass
 class Span:

@@ -125,16 +125,18 @@ def check_admissible(prompt_len: int, max_new_tokens: int, *,
                      max_seq_len: int, prefill_len: int,
                      usable_blocks: int, block_size: int,
                      max_slots: int = 0,
-                     chunked_prefill: bool = False) -> None:
+                     chunked_prefill: bool = False,
+                     prefix_cache: bool = True) -> None:
     """Submit-time rejection of requests an engine with these limits
     can NEVER run. Standalone (no engine instance) so a remote
     dispatcher — the process fleet's parent, which has only the
     engine's ``limits()`` dict from the hello handshake — fails fast at
     ITS front door instead of round-tripping a doomed request to a
-    replica process. ``max_slots`` rides along in ``limits()`` for
-    dispatch-window sizing and is accepted (unused) here so the dict
-    splats straight in — slot occupancy churns per step and is never an
-    admissibility bound. ``chunked_prefill`` (serve/longctx.py) lifts
+    replica process. ``max_slots`` (dispatch-window sizing) and
+    ``prefix_cache`` (the disaggregated fleet's handoff precondition,
+    validated at fleet startup) ride along in ``limits()`` and are
+    accepted (unused) here so the dict splats straight in — neither
+    is an admissibility bound. ``chunked_prefill`` (serve/longctx.py) lifts
     the prefill-window bound: a chunked engine streams any prompt
     through bucket-sized chunks, so only ``max_seq_len`` and pool
     capacity remain."""
@@ -906,7 +908,8 @@ class ServeEngine:
                 "usable_blocks": self.pool.usable_blocks,
                 "block_size": self.pool.block_size,
                 "max_slots": self.max_slots,
-                "chunked_prefill": self.chunked_prefill}
+                "chunked_prefill": self.chunked_prefill,
+                "prefix_cache": self.prefix_cache}
 
     def _check_admissible(self, prompt: np.ndarray,
                           max_new_tokens: int) -> None:
@@ -943,7 +946,8 @@ class ServeEngine:
                key=None, on_token=None,
                adapter_id: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> int:
+               trace_id: Optional[str] = None,
+               prefill_only: bool = False) -> int:
         """Queue one request; returns its id. ``key``: per-request
         sampling key (defaults to fold_in(key(0), rid)) — pass the SAME
         key an independent ``gpt2_generate`` call would get to reproduce
@@ -980,6 +984,7 @@ class ServeEngine:
                       trace_id=trace_id or f"req-{rid}")
         self._arrival_counter += 1
         req.key_data = np.asarray(jax.random.key_data(key))
+        req.prefill_only = bool(prefill_only)
         if self.tracer is not None:
             self.tracer.event(req.trace_id, "submit", rid=rid,
                               prompt_len=int(prompt.size),
@@ -989,7 +994,7 @@ class ServeEngine:
         return self._enqueue(req)
 
     def restore_progress(self, progress: RequestProgress, *,
-                         on_token=None) -> int:
+                         on_token=None, prefill_only: bool = False) -> int:
         """Admit a request MIGRATED from another engine of the same
         (family, params): resume from its exported
         :class:`RequestProgress` (see :meth:`export_progress`). The
@@ -1000,7 +1005,11 @@ class ServeEngine:
         continuation is token-identical to the run the exporting engine
         would have produced. Returns this engine's (new) request id;
         ``on_token`` fires only for tokens generated HERE
-        (already-exported tokens were delivered by the exporter)."""
+        (already-exported tokens were delivered by the exporter).
+        ``prefill_only``: serve only the prefill phase — commit and
+        emit the first token (real last flag), then retire with the
+        blocks published (the disaggregated fleet's prefill-pool
+        dispatch; see :class:`Request`.prefill_only)."""
         prompt = np.asarray(progress.prompt, np.int32).reshape(-1)
         if progress.key_data is None:
             raise ValueError(
@@ -1031,6 +1040,7 @@ class ServeEngine:
         req.generated = list(progress.generated)
         req.key_data = np.array(progress.key_data, copy=True)
         req.preemptions = int(progress.preemptions)
+        req.prefill_only = bool(prefill_only)
         if self.tracer is not None:
             # the migrated timeline CONTINUES here under the same
             # trace id the exporting engine (or the journal) carried
@@ -1114,7 +1124,8 @@ class ServeEngine:
         if self.tracer is not None:
             self.tracer.event(req.trace_id, "finish", rid=req.rid,
                               generated=len(req.generated),
-                              preemptions=req.preemptions)
+                              preemptions=req.preemptions,
+                              handed_off=req.handed_off)
         if req.adapter_id is not None:
             self.adapters.release(req.adapter_id)  # submit-time pin
         return req.rid
@@ -1311,7 +1322,17 @@ class ServeEngine:
             self.tracer.event(req.trace_id, "prefill",
                               tokens=len(tail), bucket=bucket,
                               start=int(start))
-        if self._append_token(slot, tok0):
+        done = self._append_token(slot, tok0)
+        if not done and req.prefill_only:
+            # disaggregated prefill phase: the first token is committed
+            # and emitted with its REAL last flag above (max_new was
+            # never capped, so EOS and one-token budgets retired via
+            # ``done``); what remains is decode-pool work. Retire with
+            # blocks PUBLISHED — the published chain is exactly the
+            # handoff payload export_kv_chain ships.
+            req.handed_off = True
+            done = True
+        if done:
             self._retire(slot)
         return len(tail), start
 
@@ -1392,7 +1413,14 @@ class ServeEngine:
         tok0 = int(tok0)
         self._tok[slot] = tok0
         self.metrics.record_admit()
-        if self._append_token(slot, tok0):
+        done = self._append_token(slot, tok0)
+        if not done and req.prefill_only:
+            # same handoff retirement as the single-shot path in
+            # _admit_one — a chunked prefill-phase request hands off
+            # after its final chunk commits the first token
+            req.handed_off = True
+            done = True
+        if done:
             finished.append(self._retire(slot))
 
     def _feed_chunks(self, finished: List[int]) -> Tuple[int, int]:
@@ -1855,6 +1883,43 @@ class ServeEngine:
                                   generated=len(p.generated),
                                   prefilled=int(p.prefilled))
         return out
+
+    # ------------------------------------------------------------------
+    # KV chain export/import — the disaggregated handoff surface
+    # ------------------------------------------------------------------
+    def export_kv_chain(self, tokens, *, namespace: Optional[str] = None,
+                        trace_id: Optional[str] = None) -> Optional[Dict]:
+        """The pool's published chain for ``tokens`` as host data
+        (:meth:`KVPool.export_chain`) — what a prefill replica ships
+        to a decode replica after a ``prefill_only`` retirement
+        published the request's blocks. ``None`` when the chain is
+        gone (evicted under pressure): the handoff caller falls back
+        to local re-prefill, which is always correct — the chain is
+        cache, not state."""
+        chain = self.pool.export_chain(tokens, namespace=namespace)
+        if self.tracer is not None:
+            self.tracer.event(trace_id, "kv_export",
+                              found=chain is not None,
+                              n_tokens=(0 if chain is None
+                                        else int(chain["n_tokens"])),
+                              namespace=namespace)
+        return chain
+
+    def import_kv_chain(self, chain: Dict, *,
+                        namespace: Optional[str] = None,
+                        trace_id: Optional[str] = None) -> int:
+        """Admit a transferred chain into this engine's pool as a warm
+        prefix hit (:meth:`KVPool.import_chain`); the next admission
+        for the prefix re-prefills ~1 token instead of the whole
+        prompt. Returns positions now cached (0 = pool full or cache
+        off — the caller re-prefills locally). Raises ``ValueError``
+        on a geometry/policy mismatch: mixed engine specs in one
+        fleet are a deployment error, not a retryable fault."""
+        n = self.pool.import_chain(chain, namespace=namespace)
+        if self.tracer is not None:
+            self.tracer.event(trace_id, "kv_import",
+                              n_tokens=int(n), namespace=namespace)
+        return n
 
     # ------------------------------------------------------------------
     def compile_stats(self) -> Dict[str, int]:

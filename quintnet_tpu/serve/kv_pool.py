@@ -517,6 +517,136 @@ class KVPool:
         self._block_fill[b] = fill
         self._touch(b)
 
+    # ---- chain export / import (disaggregated KV handoff) -----------
+    def export_chain(self, tokens, *,
+                     namespace: Optional[str] = None) -> Optional[Dict]:
+        """Snapshot the longest PUBLISHED chain for ``tokens`` as host
+        data — the prefill→decode handoff payload of the disaggregated
+        fleet (fleet/wire.py frames it, fleet/proc.py ships it). Each
+        record carries one block's slot data exactly as stored (the
+        policy's ``store_dtype`` — int8 blocks export as int8, ~4x
+        smaller than f32) plus its per-block-per-head scale rows when
+        the policy is scaled, so an import is a byte-exact replica of
+        the source blocks. Returns ``None`` when nothing is cached for
+        the prefix (evicted, or never published). Read-only: refcounts,
+        the index and the LRU are untouched beyond a touch."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        plan = self.lookup(tokens, max_tokens=len(tokens),
+                           namespace=namespace)
+        chain_blocks = list(plan.shared_blocks)
+        fills = [self.block_size] * len(chain_blocks)
+        if plan.cow_src is not None:
+            chain_blocks.append(plan.cow_src)
+            fills.append(plan.cow_len)
+        if not chain_blocks:
+            return None
+        bs = self.block_size
+        # ONE gather per pool array (then split host-side), not one
+        # device op per block: a chain transfer must cost O(chain
+        # bytes), never O(blocks * pool bytes)
+        idx = np.concatenate([np.arange(b * bs, (b + 1) * bs)
+                              for b in chain_blocks])
+        k_all = np.asarray(self.k[:, idx])
+        v_all = np.asarray(self.v[:, idx])
+        if self.policy.scaled:
+            barr = np.asarray(chain_blocks, np.int32)
+            ks_all = np.asarray(self.k_scale[:, barr])
+            vs_all = np.asarray(self.v_scale[:, barr])
+        records: List[Dict] = []
+        for j, fill in enumerate(fills):
+            rec = {"fill": int(fill),
+                   "k": k_all[:, j * bs:(j + 1) * bs],
+                   "v": v_all[:, j * bs:(j + 1) * bs]}
+            if self.policy.scaled:
+                rec["k_scale"] = ks_all[:, j]
+                rec["v_scale"] = vs_all[:, j]
+            records.append(rec)
+        return {"tokens": tokens[:plan.cached_tokens].copy(),
+                "n_tokens": int(plan.cached_tokens),
+                "policy": self.policy.name,
+                "block_size": bs,
+                "n_layers": self.n_layers,
+                "n_kv_heads": self.n_kv_heads,
+                "head_dim": self.head_dim,
+                "blocks": records}
+
+    def _check_chain_geometry(self, chain: Dict) -> None:
+        mine = {"policy": self.policy.name,
+                "block_size": self.block_size,
+                "n_layers": self.n_layers,
+                "n_kv_heads": self.n_kv_heads,
+                "head_dim": self.head_dim}
+        theirs = {k: chain[k] for k in mine}
+        if theirs != mine:
+            diffs = {k: (theirs[k], mine[k]) for k in mine
+                     if theirs[k] != mine[k]}
+            raise ValueError(
+                f"KV chain layout does not match this pool "
+                f"({{field: (chain, pool)}} = {diffs}) — the exporting "
+                f"and importing engines must be built from the same "
+                f"spec (same KV layout policy and pool geometry)")
+
+    def import_chain(self, chain: Dict, *,
+                     namespace: Optional[str] = None) -> int:
+        """Admit an exported chain as a warm prefix hit: allocate
+        private blocks, write the transferred slot data (and scales)
+        into them byte-exactly, PUBLISH them under the chain's token
+        prefix, and release — published refcount-zero blocks are
+        retained in the LRU exactly like a retired request's, so the
+        next admission for this prefix hits instead of re-prefilling.
+        Returns the number of token positions now served from cache
+        (0 when the pool cannot hold the chain or the prefix cache is
+        off — the caller's fallback is local re-prefill, which is
+        always correct). Keys already published keep their incumbent
+        block (the duplicate import frees on release), so a racing
+        local prefill can never be corrupted by a late handoff."""
+        self._check_chain_geometry(chain)
+        records = chain["blocks"]
+        n_tokens = int(chain["n_tokens"])
+        if not self.prefix_cache or n_tokens <= 0 or not records:
+            return 0
+        q, f = divmod(n_tokens, self.block_size)
+        if len(records) != q + (1 if f else 0):
+            raise ValueError(
+                f"KV chain block count {len(records)} does not cover "
+                f"n_tokens={n_tokens} at block_size={self.block_size}")
+        blocks = self.acquire(len(records))
+        if blocks is None:
+            return 0
+        bs = self.block_size
+        # ONE fused scatter per pool array — a per-block .at[].set
+        # would copy the whole pool once per block (O(blocks * pool
+        # bytes)); this is the decode-replica hot path during a
+        # handoff and must not stall decode steps behind pool-sized
+        # memcpys
+        idx = np.concatenate([np.arange(b * bs, (b + 1) * bs)
+                              for b in blocks])
+        k_new = np.concatenate([np.asarray(r["k"]) for r in records],
+                               axis=1)
+        v_new = np.concatenate([np.asarray(r["v"]) for r in records],
+                               axis=1)
+        k = self.k.at[:, idx].set(
+            jnp.asarray(k_new, self.policy.store_dtype))
+        v = self.v.at[:, idx].set(
+            jnp.asarray(v_new, self.policy.store_dtype))
+        if self.policy.scaled:
+            barr = np.asarray(blocks, np.int32)
+            ks = np.stack([np.asarray(r["k_scale"]) for r in records],
+                          axis=1)
+            vs = np.stack([np.asarray(r["v_scale"]) for r in records],
+                          axis=1)
+            k_scale = self.k_scale.at[:, barr].set(
+                jnp.asarray(ks, jnp.float32))
+            v_scale = self.v_scale.at[:, barr].set(
+                jnp.asarray(vs, jnp.float32))
+            self.update(k, v, k_scale, v_scale)
+        else:
+            self.update(k, v)
+        tokens = np.asarray(chain["tokens"], np.int32).reshape(-1)
+        self.publish(tokens, blocks, n_tokens, namespace=namespace)
+        self.release(blocks)
+        return n_tokens
+
     # ---- device views ----------------------------------------------
     def caches(self):
         """The pool's device arrays, as carried through the jitted step

@@ -155,6 +155,15 @@ class Request:
     # chunked-prefill high-water mark (serve/longctx.py): positions of
     # prompt + generated whose KV is in the pool; engine-maintained
     prefilled: int = 0
+    # disaggregated-fleet prefill phase (fleet/proc.py): run the
+    # prefill, commit+emit the FIRST token with its real last flag
+    # (max_new_tokens is NOT capped, so EOS/one-token requests finish
+    # naturally), then retire with blocks published — the chain is the
+    # handoff payload, the journal carries the rest to a decode
+    # replica. ``handed_off`` marks that retirement so the dispatcher
+    # can tell "finished" from "ready to hand off".
+    prefill_only: bool = False
+    handed_off: bool = False
     # terminal error (DeadlineExceeded): state goes FINISHED but
     # result() raises this instead of returning output_ids()
     error: Optional[BaseException] = None

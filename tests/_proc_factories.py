@@ -20,7 +20,8 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                     top_k: int = 0, eos_token_id=None,
                     n_positions=None, prefill_len=None,
                     chunked_prefill: bool = False,
-                    prefill_chunk_budget=None):
+                    prefill_chunk_budget=None,
+                    kv_dtype=None, prefix_cache: bool = True):
     from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
     from quintnet_tpu.serve import ServeEngine, gpt2_family
 
@@ -33,5 +34,6 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                        max_seq_len=max_seq_len, prefill_len=prefill_len,
                        chunked_prefill=chunked_prefill,
                        prefill_chunk_budget=prefill_chunk_budget,
+                       kv_dtype=kv_dtype, prefix_cache=prefix_cache,
                        temperature=temperature,
                        top_k=top_k, eos_token_id=eos_token_id)

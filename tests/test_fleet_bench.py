@@ -21,6 +21,7 @@ import bench  # noqa: E402
 
 FLEET_METRIC = "fleet_gpt2_tiny_tokens_per_sec"
 PROC_METRIC = "fleet_proc_gpt2_tiny_tokens_per_sec"
+DISAGG_METRIC = "fleet_disagg_gpt2_tiny_itl_interference"
 
 
 @pytest.mark.fast
@@ -96,6 +97,47 @@ def test_fleet_bench_process_smoke_cli():
 
 
 @pytest.mark.fast
+def test_fleet_bench_disagg_smoke_cli():
+    """A tiny --disagg replay — 1 prefill + 1 decode process vs a
+    2-replica colocated fleet, one long-prefill burst probe — runs
+    end-to-end on CPU and emits a well-formed interference record.
+    Wall-clock ratios are NOT asserted here (2-core CI noise); the
+    deterministic structural signal is: the decode pool prefilled
+    warm tails only while every long prefill ran on the prefill
+    pool, all via transferred (checksummed) KV chains."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_bench.py"),
+         "--synthetic", "--disagg", "--prefill-replicas", "1",
+         "--decode-replicas", "1", "--slots", "4", "--steady", "2",
+         "--steady-gap-s", "0.05", "--burst-prompts", "1",
+         "--burst-prompt-len", "24", "--max-new", "6",
+         "--num-blocks", "64", "--block-size", "8",
+         "--timeout-s", "240"],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == DISAGG_METRIC
+    assert rec["rc"] == 0 and rec["unit"] == "ratio"
+    ex = rec["extras"]
+    for k in ("colocated_interference", "disagg_itl_p99_burst_s",
+              "colocated_itl_p99_burst_s", "handoffs",
+              "handoff_transfers", "handoff_fallbacks",
+              "disagg_pool_prefill_tokens"):
+        assert k in ex, k
+    # nothing lost, every steady request handed off with its chain
+    assert ex["finished"] == ex["accepted"]
+    assert ex["colocated_finished"] == ex["colocated_accepted"]
+    assert ex["handoff_transfers"] == ex["handoffs"] == 2
+    assert ex["handoff_fallbacks"] == 0
+    # structural isolation: the burst's long prefill ran on the
+    # prefill pool; the decode pool prefilled warm-hit tails only
+    pool_tokens = ex["disagg_pool_prefill_tokens"]
+    assert pool_tokens["decode"] <= 2 * ex["accepted"]
+    assert pool_tokens["prefill"] >= 24
+
+
+@pytest.mark.fast
 def test_committed_fleet_artifact_surfaces_in_staleness_scan():
     """The committed fleet artifact is discoverable through the same
     last_known_result scanner every other bench uses."""
@@ -141,6 +183,59 @@ def test_committed_process_artifact_surfaces_in_staleness_scan():
     assert last["value"] > 0
     assert last["source"].startswith("artifacts")
     assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_committed_disagg_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=DISAGG_METRIC)
+    assert last is not None
+    assert last["stale"] is True
+    assert last["metric"] == DISAGG_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_committed_disagg_artifact_proves_acceptance_scenario():
+    """artifacts/fleet_r16.json documents the interference A/B at
+    matched load: on the disaggregated side a long-prefill burst
+    moves decode ITL p99 by at most the pinned bound over its own
+    no-burst baseline AND the burst-time decode ITL p99 beats the
+    colocated fleet's under the same burst on the same box (the
+    matched-load interference comparison — the self-ratios are not
+    comparable across modes on shared cores because disaggregation
+    also improves the NO-burst baseline, see run_disagg);
+    structurally, every long prefill ran on the prefill pool (int8
+    chains transferred, zero fallbacks, nothing lost)."""
+    recs = json.load(open(os.path.join(REPO, "artifacts",
+                                       "fleet_r16.json")))
+    rec = next(r for r in recs if r.get("metric") == DISAGG_METRIC)
+    ex = rec["extras"]
+    assert rec["rc"] == 0
+    # pinned interference bound on the disaggregated side
+    assert 0 < rec["value"] <= 2.5
+    # the matched-load win: under the SAME burst, decode ITL p99 is
+    # lower on the disaggregated side — and its clean-baseline p99 is
+    # no worse either
+    assert ex["burst_itl_p99_vs_colocated"] < 1.0
+    assert (ex["disagg_itl_p99_burst_s"]
+            < ex["colocated_itl_p99_burst_s"])
+    assert ex["baseline_itl_p99_vs_colocated"] <= 1.0
+    # fault-tolerant handoff did its job: every steady request's
+    # chain transferred (int8 — 4x smaller frames), zero fallbacks,
+    # nothing lost on either side
+    assert ex["kv_dtype"] == "int8"
+    assert ex["handoff_transfers"] == ex["handoffs"] == ex["steady"]
+    assert ex["handoff_fallbacks"] == 0
+    assert ex["finished"] == ex["accepted"]
+    assert ex["colocated_finished"] == ex["colocated_accepted"]
+    # structural isolation: decode pool prefilled warm tails only;
+    # the burst's long prefills all landed on the prefill pool
+    pool_tokens = ex["disagg_pool_prefill_tokens"]
+    assert pool_tokens["decode"] <= 2 * ex["accepted"]
+    assert (pool_tokens["prefill"]
+            >= ex["burst_prompts"] * ex["burst_prompt_len"])
 
 
 @pytest.mark.fast

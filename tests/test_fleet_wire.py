@@ -151,6 +151,225 @@ class TestErrorRoundTrip:
             wire.error_from_wire(wire.error_to_wire(
                 KeyError("tenant-z"))), KeyError)
 
+    def test_wire_error_keeps_its_type(self):
+        """A WireError must NOT degrade to plain ValueError across the
+        RPC reply: the handoff retry loop treats WireError (damaged
+        frame — transient, re-export) differently from ValueError
+        (geometry mismatch / evicted chain — permanent, straight to
+        the local-re-prefill fallback)."""
+        e = wire.error_from_wire(json.loads(json.dumps(
+            wire.error_to_wire(wire.WireError("checksum mismatch")))))
+        assert isinstance(e, wire.WireError)
+        assert "checksum" in str(e)
+
+
+class TestKVChainFrames:
+    """The disaggregated handoff payload: a published chain's blocks
+    (+ per-block scales under int8) round-trip bit-exactly through
+    JSON text, and EVERY corruption mode — flipped payload bits,
+    damaged geometry, missing fields — surfaces as a typed
+    :class:`WireError`, never wrong KV silently cached."""
+
+    def _chain(self, policy="int8"):
+        from quintnet_tpu.serve.kv_pool import KVPool
+
+        pool = KVPool(n_layers=2, n_kv_heads=2, head_dim=4,
+                      block_size=4, num_blocks=8, policy=policy)
+        toks = np.arange(10, dtype=np.int32)
+        blocks = pool.acquire(3)
+        k = pool.k
+        for i, b in enumerate(blocks):
+            k = k.at[:, b * 4:(b + 1) * 4].set(i + 1)
+        if pool.policy.scaled:
+            ks = pool.k_scale
+            for i, b in enumerate(blocks):
+                ks = ks.at[:, b].set(0.25 * (i + 1))
+            pool.update(k, pool.v, ks, pool.v_scale)
+        else:
+            pool.update(k, pool.v)
+        pool.publish(toks, blocks, 10)
+        pool.release(blocks)
+        return pool, pool.export_chain(toks), toks
+
+    def test_round_trip_through_json_bit_exact(self):
+        from quintnet_tpu.serve.kv_pool import KVPool
+
+        _pool, chain, toks = self._chain("int8")
+        payload = json.loads(json.dumps(
+            wire.kv_chain_to_wire(chain, namespace="tenant-a")))
+        got, ns = wire.kv_chain_from_wire(payload)
+        assert ns == "tenant-a"
+        assert got["n_tokens"] == 10 and got["policy"] == "int8"
+        np.testing.assert_array_equal(got["tokens"], toks)
+        for a, b in zip(chain["blocks"], got["blocks"]):
+            assert a["fill"] == b["fill"]
+            np.testing.assert_array_equal(a["k"], b["k"])
+            assert b["k"].dtype == np.int8   # int8 ships as int8
+            np.testing.assert_array_equal(a["k_scale"], b["k_scale"])
+        # and the decoded chain actually imports + hits
+        dst = KVPool(n_layers=2, n_kv_heads=2, head_dim=4,
+                     block_size=4, num_blocks=8, policy="int8")
+        assert dst.import_chain(got, namespace=ns) == 10
+        assert dst.lookup(toks, max_tokens=8,
+                          namespace=ns).cached_tokens == 8
+
+    def test_flipped_payload_bit_fails_checksum_typed(self):
+        _pool, chain, _toks = self._chain()
+        payload = wire.kv_chain_to_wire(chain)
+        b64 = payload["blocks"][1]["v"]["b64"]
+        flip = "A" if b64[0] != "A" else "B"
+        payload["blocks"][1]["v"]["b64"] = flip + b64[1:]
+        with pytest.raises(wire.WireError, match="checksum mismatch"):
+            wire.kv_chain_from_wire(payload)
+
+    def test_flipped_geometry_fails_checksum_typed(self):
+        _pool, chain, _toks = self._chain()
+        payload = wire.kv_chain_to_wire(chain)
+        payload["n_kv_heads"] = 7
+        with pytest.raises(wire.WireError, match="checksum mismatch"):
+            wire.kv_chain_from_wire(payload)
+
+    def test_missing_field_named_not_keyerror(self):
+        _pool, chain, _toks = self._chain()
+        payload = wire.kv_chain_to_wire(chain)
+        del payload["n_tokens"]
+        with pytest.raises(wire.WireError, match="n_tokens"):
+            wire.kv_chain_from_wire(payload)
+
+    def test_null_fill_is_typed_not_typeerror(self):
+        """A buggy peer's null fill checksums CONSISTENTLY on its side
+        (it hashed the same null), so the frame reaches the walk — it
+        must surface as a typed WireError, never a TypeError that
+        escapes the import handler and reads as a replica death."""
+        _pool, chain, _toks = self._chain()
+        payload = wire.kv_chain_to_wire(chain)
+        payload["blocks"][0]["fill"] = None
+        with pytest.raises(wire.WireError, match="fill"):
+            wire.kv_chain_from_wire(payload)
+        # string fill is the sibling case (ValueError path)
+        payload["blocks"][0]["fill"] = "x"
+        with pytest.raises(wire.WireError, match="fill"):
+            wire.kv_chain_from_wire(payload)
+
+    def test_null_geometry_is_typed_not_typeerror(self):
+        """Same vector on the header ints: the peer's checksum covers
+        its own null, so int(None) is reachable post-verification."""
+        _pool, chain, _toks = self._chain()
+        payload = wire.kv_chain_to_wire(chain)
+        payload["n_tokens"] = None
+        payload["crc32"] = wire.kv_chain_checksum(payload)
+        with pytest.raises(wire.WireError, match="geometry"):
+            wire.kv_chain_from_wire(payload)
+
+    def test_wire_size_estimate_is_conservative(self):
+        """The exporter's pre-ship size check must OVER-estimate: a
+        frame it approves can never trip the receiver's
+        MAX_FRAME_BYTES guard (which would read as a dead connection
+        and kill a healthy replica)."""
+        _pool, chain, _toks = self._chain("int8")
+        payload = wire.kv_chain_to_wire(chain, namespace="tenant-a")
+        actual = len(json.dumps(payload,
+                                separators=(",", ":")).encode())
+        assert wire.kv_chain_wire_size(payload) >= actual
+        assert wire.kv_chain_fits(payload)   # tiny chain fits
+
+    def test_geometry_mismatch_rejected_at_import(self):
+        from quintnet_tpu.serve.kv_pool import KVPool
+
+        _pool, chain, _toks = self._chain("f32")
+        dst = KVPool(n_layers=2, n_kv_heads=2, head_dim=4,
+                     block_size=4, num_blocks=8, policy="int8")
+        with pytest.raises(ValueError, match="does not match this pool"):
+            dst.import_chain(chain)
+
+
+class TestWireFaultsAreReplicaDeathNotFleetDeath:
+    """Satellite contract: a truncated frame mid-body, flipped-bit
+    payload bytes and an oversized length prefix all surface as typed
+    ``ConnectionClosed``/``WireError`` WITH THE PEER NAMED — never a
+    raw ``struct.error``/``KeyError`` — and the dispatcher's reader
+    treats them as the death of THAT replica, not of the fleet."""
+
+    def test_truncated_frame_mid_body_names_peer(self):
+        a, b = socket.socketpair()
+        try:
+            data = json.dumps({"t": "hb"}).encode()
+            a.sendall(len(data).to_bytes(4, "big") + data[:3])
+            a.close()
+            with pytest.raises(wire.ConnectionClosed,
+                               match=r"'decode0'.*mid-frame"):
+                wire.recv_frame(b, peer="decode0")
+        finally:
+            b.close()
+
+    def test_oversized_length_prefix_names_peer(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall((wire.MAX_FRAME_BYTES + 1).to_bytes(4, "big"))
+            with pytest.raises(wire.WireError,
+                               match=r"'prefill0'.*MAX_FRAME_BYTES"):
+                wire.recv_frame(b, peer="prefill0")
+        finally:
+            a.close()
+            b.close()
+
+    def test_flipped_bits_in_body_are_typed_not_decode_crash(self):
+        a, b = socket.socketpair()
+        try:
+            garbage = b"\xff\xfe{not json"
+            a.sendall(len(garbage).to_bytes(4, "big") + garbage)
+            with pytest.raises(wire.WireError,
+                               match=r"'p1'.*not valid JSON"):
+                wire.recv_frame(b, peer="p1")
+        finally:
+            a.close()
+            b.close()
+
+    def test_reader_thread_turns_wire_fault_into_replica_death(self):
+        """Drive the REAL ``ProcReplica._read_loop`` over a socketpair
+        feeding garbage: the loop must swallow the typed fault, abort
+        pending RPCs, and report the replica's death to the fleet —
+        the dispatcher thread never sees the exception."""
+        from quintnet_tpu.fleet.proc import ProcReplica
+
+        class FakeFleet:
+            def __init__(self):
+                self.dead = []
+                self.frames = []
+
+            def _on_frame(self, rep, frame):
+                self.frames.append(frame)
+
+            def _on_conn_lost(self, rep):
+                self.dead.append(rep.name)
+
+        a, b = socket.socketpair()
+        rep = ProcReplica.__new__(ProcReplica)   # no spawn
+        rep.name = "decode1"
+        rep.fleet = FakeFleet()
+        rep.sock = b
+        rep._pending = {}
+        rep._send_lock = threading.Lock()
+        ev = threading.Event()
+        rep._pending[1] = (ev, {})               # an in-flight RPC
+        try:
+            t = threading.Thread(target=rep._read_loop, daemon=True)
+            t.start()
+            # one good frame, then flipped-bit garbage
+            wire.send_frame(a, {"t": "hb", "steps": 1})
+            garbage = b"\x00garbage\xff"
+            a.sendall(len(garbage).to_bytes(4, "big") + garbage)
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "reader wedged on a wire fault"
+            # the good frame was processed, the fault became a DEATH
+            assert rep.fleet.frames == [{"t": "hb", "steps": 1}]
+            assert rep.fleet.dead == ["decode1"]
+            # pending RPCs were aborted, not left to time out
+            assert ev.is_set() and rep._pending == {}
+        finally:
+            a.close()
+            b.close()
+
 
 class TestFraming:
     def test_frames_round_trip_over_a_socket(self):

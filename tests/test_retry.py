@@ -1,0 +1,183 @@
+"""Shared retry policy units (quintnet_tpu/fleet/retry.py).
+
+THE contract: attempt ``n`` (1-based) waits ``min(base * 2^(n-1),
+cap) * u`` with ``u`` uniform in ``[1, 1+jitter]`` — the envelope is
+pinned at both edges and the delays are deterministic under a seeded
+RNG; :meth:`RetryPolicy.run` retries ONLY the declared exception
+types, stops on attempt count or wall-clock budget, re-raises the
+LAST retryable error on exhaustion, and the legacy ``Backoff``
+(fleet/health.py) is the same class wearing its old constructor."""
+
+import random
+
+import pytest
+
+from quintnet_tpu.fleet import Backoff, RetryPolicy
+
+
+class TestDelayEnvelope:
+    def test_zero_jitter_is_exact_exponential_with_cap(self):
+        p = RetryPolicy(base_s=0.1, cap_s=0.5, jitter=0.0)
+        assert p.delay_s(1) == pytest.approx(0.1)
+        assert p.delay_s(2) == pytest.approx(0.2)
+        assert p.delay_s(3) == pytest.approx(0.4)
+        assert p.delay_s(4) == pytest.approx(0.5)   # capped
+        assert p.delay_s(9) == pytest.approx(0.5)   # stays capped
+
+    @pytest.mark.parametrize("attempt", [1, 2, 3, 5, 8])
+    def test_jitter_envelope_pinned_both_edges(self, attempt):
+        lo = RetryPolicy(base_s=0.05, cap_s=5.0, jitter=0.25,
+                         rand=lambda: 0.0)
+        hi = RetryPolicy(base_s=0.05, cap_s=5.0, jitter=0.25,
+                         rand=lambda: 1.0)
+        raw = min(0.05 * 2 ** (attempt - 1), 5.0)
+        assert lo.delay_s(attempt) == pytest.approx(raw)
+        assert hi.delay_s(attempt) == pytest.approx(raw * 1.25)
+        # any rand value lands inside the envelope
+        mid = RetryPolicy(base_s=0.05, cap_s=5.0, jitter=0.25,
+                          rand=lambda: 0.37)
+        assert raw <= mid.delay_s(attempt) <= raw * 1.25
+
+    def test_deterministic_under_seeded_rng(self):
+        a = RetryPolicy(rand=random.Random(7).random)
+        b = RetryPolicy(rand=random.Random(7).random)
+        assert [a.delay_s(n) for n in range(1, 8)] == \
+            [b.delay_s(n) for n in range(1, 8)]
+
+    def test_invalid_params_rejected(self):
+        with pytest.raises(ValueError, match="max_attempts"):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError, match="jitter"):
+            RetryPolicy(jitter=-0.1)
+        with pytest.raises(ValueError, match="base_s"):
+            RetryPolicy(base_s=-1.0)
+
+
+class TestRun:
+    def _policy(self, **kw):
+        slept = []
+        kw.setdefault("max_attempts", 3)
+        kw.setdefault("base_s", 0.1)
+        kw.setdefault("jitter", 0.0)
+        p = RetryPolicy(sleep=slept.append, **kw)
+        return p, slept
+
+    def test_succeeds_after_transient_failures(self):
+        p, slept = self._policy()
+        seen = []
+
+        def fn(attempt):
+            seen.append(attempt)
+            if attempt < 3:
+                raise OSError("transient")
+            return "done"
+
+        assert p.run(fn, retry_on=(OSError,)) == "done"
+        assert seen == [1, 2, 3]
+        assert slept == pytest.approx([0.1, 0.2])  # between attempts
+
+    def test_exhaustion_reraises_last_error(self):
+        p, slept = self._policy()
+
+        def fn(attempt):
+            raise OSError(f"boom {attempt}")
+
+        with pytest.raises(OSError, match="boom 3"):
+            p.run(fn, retry_on=(OSError,))
+        assert len(slept) == 2   # no sleep after the final failure
+
+    def test_non_retryable_type_propagates_immediately(self):
+        p, slept = self._policy()
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            raise KeyError("programming error")
+
+        with pytest.raises(KeyError):
+            p.run(fn, retry_on=(OSError,))
+        assert calls == [1] and slept == []
+
+    def test_on_retry_hook_sees_attempt_and_error(self):
+        p, _slept = self._policy()
+        hooks = []
+
+        def fn(attempt):
+            if attempt == 1:
+                raise ValueError("first")
+            return attempt
+
+        assert p.run(fn, retry_on=(ValueError,),
+                     on_retry=lambda n, e: hooks.append((n, str(e)))) == 2
+        assert hooks == [(1, "first")]
+
+    def test_wall_clock_budget_stops_retrying(self):
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            now[0] += s
+
+        p = RetryPolicy(base_s=1.0, jitter=0.0, max_attempts=100,
+                        timeout_s=2.5, clock=clock, sleep=sleep)
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            now[0] += 1.0           # each attempt costs 1s
+            raise OSError("slow failure")
+
+        with pytest.raises(OSError):
+            p.run(fn, retry_on=(OSError,))
+        # attempt 1 (t=1) -> sleep 1 (t=2) -> attempt 2 (t=3) is past
+        # the 2.5s budget -> give up; attempts are bounded by TIME
+        # here, not by max_attempts=100
+        assert calls == [1, 2]
+
+    def test_bounded_tightens_the_wall_clock_budget(self):
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            now[0] += s
+
+        base = RetryPolicy(base_s=1.0, jitter=0.0, max_attempts=100,
+                           timeout_s=60.0, clock=clock, sleep=sleep)
+        # the handoff path: a request with 2.5s of deadline left must
+        # bound the transfer by ITS budget, not the policy's 60s
+        p = base.bounded(2.5)
+        assert p.timeout_s == 2.5
+        assert p.max_attempts == base.max_attempts
+        assert p.clock is clock and p.sleep is sleep
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            now[0] += 1.0
+            raise OSError("slow failure")
+
+        with pytest.raises(OSError):
+            p.run(fn, retry_on=(OSError,))
+        assert calls == [1, 2]
+        # bounded() never LOOSENS an existing budget
+        assert base.bounded(90.0).timeout_s == 60.0
+        # and the original policy is untouched
+        assert base.timeout_s == 60.0
+
+
+class TestBackoffAlias:
+    def test_backoff_is_a_retry_policy(self):
+        b = Backoff(base_s=0.05, cap_s=5.0, jitter=0.25,
+                    rand=lambda: 0.0)
+        assert isinstance(b, RetryPolicy)
+        assert b.delay_s(3) == pytest.approx(0.2)
+
+    def test_backoff_keeps_its_legacy_constructor(self):
+        # the restart sites construct Backoff(base_s=..., cap_s=...)
+        # with no retry-loop arguments — that surface must keep working
+        b = Backoff(base_s=0.02, cap_s=0.5)
+        assert 0.02 <= b.delay_s(1) <= 0.025

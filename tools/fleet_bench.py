@@ -28,6 +28,20 @@ Reported tokens come from the dispatcher's journal
 (``tokens_delivered``), which survives replica deaths; the metric name
 gains a ``proc`` tag so the thread and process records never alias.
 
+``--disagg`` runs the disaggregation A/B (quintnet_tpu/fleet/proc.py
+``pools=``): the same steady-decode trace + long-prefill burst through
+a disaggregated prefill/decode fleet AND a colocated fleet of equal
+size, each also replayed without the burst. The reported value is the
+disaggregated side's SELF-interference (decode ITL p99, burst /
+no-burst — the "burst must not move decode ITL" bound); the
+matched-load comparison vs colocated is ``burst_itl_p99_vs_colocated``
+(< 1 = the dedicated prefill pool wins under the same burst on the
+same box; see run_disagg for why the two modes' self-ratios are not
+directly comparable on shared cores). Structural isolation —
+``disagg_pool_prefill_tokens`` — is the noise-free signal: every long
+prefill must land on the prefill pool (DistServe/Splitwise;
+artifacts/fleet_r16.json).
+
 Modes:
   python tools/fleet_bench.py --synthetic                # tiny, CPU-ok
   python tools/fleet_bench.py --synthetic --requests 6 \
@@ -35,6 +49,8 @@ Modes:
   python tools/fleet_bench.py --synthetic --out artifacts/fleet_r08.json
   python tools/fleet_bench.py --synthetic --process \
       --out artifacts/fleet_r12.json                     # process fleet
+  python tools/fleet_bench.py --synthetic --disagg \
+      --out artifacts/fleet_r16.json                     # interference A/B
 
 ``--out FILE`` appends the records to an artifacts JSON list
 (bench.last_known_result scans them — same staleness story as the
@@ -51,12 +67,15 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def model_setup(model: str, synthetic: bool, seed: int):
+def model_setup(model: str, synthetic: bool, seed: int,
+                n_positions=None, n_embd=None):
     """THE single source of the benched model: (family, params). Both
     modes — the thread factory and the process children, each in their
     own interpreter — construct the model HERE from the same seed, so
     they cannot drift apart and every replica holds identical
-    (family, params), the migration-contract precondition."""
+    (family, params), the migration-contract precondition.
+    ``n_positions`` widens the synthetic gpt2 context (the --disagg
+    trace needs prompts long enough for a prefill burst to hurt)."""
     import jax
 
     from quintnet_tpu.serve import gpt2_family, llama_family
@@ -64,7 +83,18 @@ def model_setup(model: str, synthetic: bool, seed: int):
     if model == "gpt2":
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
 
-        cfg = GPT2Config.tiny(n_layer=2) if synthetic else GPT2Config.base()
+        if synthetic:
+            kw = {}
+            if n_positions is not None:
+                kw["n_positions"] = int(n_positions)
+            if n_embd is not None:
+                # the --disagg interference probe needs a prefill that
+                # actually costs something; width is the cheapest lever
+                kw.update(n_embd=int(n_embd),
+                          n_head=max(2, int(n_embd) // 64))
+            cfg = GPT2Config.tiny(n_layer=2, **kw)
+        else:
+            cfg = GPT2Config.base()
         return gpt2_family(cfg), gpt2_init(jax.random.key(seed), cfg)
     if model == "llama":
         from quintnet_tpu.models.llama import LlamaConfig, llama_init
@@ -77,17 +107,19 @@ def model_setup(model: str, synthetic: bool, seed: int):
 
 def build_engine(*, model="gpt2", synthetic=True, seed=0, slots=2,
                  block_size=16, num_blocks=64, max_seq_len=40,
-                 eos=None, temperature=0.0):
+                 eos=None, temperature=0.0, n_positions=None,
+                 n_embd=None, kv_dtype=None):
     """One replica engine, DETERMINISTIC in its kwargs — the builder
     the process fleet's spawn children load by file path."""
     from quintnet_tpu.serve import ServeEngine
 
-    family, params = model_setup(model, synthetic, seed)
+    family, params = model_setup(model, synthetic, seed,
+                                 n_positions=n_positions, n_embd=n_embd)
     return ServeEngine(
         family, params, max_slots=slots, block_size=block_size,
         num_blocks=num_blocks,
         max_seq_len=min(max_seq_len, family.max_positions),
-        eos_token_id=eos, temperature=temperature)
+        kv_dtype=kv_dtype, eos_token_id=eos, temperature=temperature)
 
 
 def engine_kwargs(args) -> dict:
@@ -339,6 +371,256 @@ def run_policy_process(args, policy: str) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# --disagg: TTFT-vs-ITL interference A/B (disaggregated vs colocated)
+# ---------------------------------------------------------------------------
+
+
+def _disagg_engine_kwargs(args) -> dict:
+    """Engine spec for the interference A/B: context wide enough for
+    the long-prefill burst, pool sized so nothing preempts."""
+    # the window must hold BOTH trace populations: long burst prompts
+    # AND the steady prompts (which --max-prompt can size past the
+    # burst length)
+    max_seq = max(args.burst_prompt_len, args.max_prompt) + args.max_new
+    return {"model": args.model, "synthetic": bool(args.synthetic),
+            "seed": args.seed, "slots": args.slots,
+            "block_size": args.block_size,
+            "num_blocks": args.num_blocks,
+            "max_seq_len": max_seq, "n_positions": max_seq,
+            "n_embd": args.disagg_n_embd,
+            "kv_dtype": args.kv_dtype,
+            "eos": args.eos, "temperature": args.temperature}
+
+
+def _replay_itl(args, fleet, vocab: int, *, burst: bool,
+                seed: int) -> dict:
+    """One replay against an ALREADY-WARM fleet: ``--steady`` short
+    decode-heavy requests submitted at t=0, then (burst replays only)
+    ``--burst-prompts`` long-prefill requests mid-decode. Inter-token
+    gaps are timestamped AT THE DISPATCHER as tokens stream in — the
+    client-visible ITL, which is exactly what a monolithic prefill on
+    a colocated replica inflates and a dedicated prefill pool must
+    not."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from quintnet_tpu.fleet import Overloaded
+
+    rng = np.random.default_rng(seed)
+    marks = {}          # steady fid -> token arrival timestamps
+    lock = threading.Lock()
+
+    def on_token(fid, tok, last):  # appends only; contractually quick
+        with lock:
+            # setdefault: a first token can land before the submit
+            # call returns and the fid is registered below — a plain
+            # KeyError here would be SWALLOWED by FleetRequest.deliver
+            # (client callbacks must not read as replica faults) and
+            # silently drop timestamps, shifting the per[:2]
+            # admission-gap trim onto steady-state gaps
+            marks.setdefault(fid, []).append(time.perf_counter())
+
+    fleet.reset_metrics()
+    fids, burst_fids = [], []
+    for i in range(args.steady):
+        # staggered arrivals: the prefill pool (and the handoff path)
+        # stays periodically busy through BOTH replays, so the
+        # no-burst baseline carries the same steady-state load as the
+        # burst replay and the ratio isolates the BURST, not the
+        # difference between an idle and a working prefill pool
+        if i:
+            time.sleep(args.steady_gap_s)
+        n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
+        prompt = rng.integers(0, vocab, (n,)).astype(np.int32)
+        fid = fleet.submit(prompt, args.max_new, on_token=on_token)
+        with lock:
+            marks.setdefault(fid, [])
+        fids.append(fid)
+    if burst:
+        time.sleep(args.burst_delay_s)
+        for _ in range(args.burst_prompts):
+            # the burst is TTFT-bound prefill work (max_new=1): on a
+            # disaggregated fleet it lives and dies in the prefill
+            # pool — which is the isolation claim under test. The
+            # steady requests above exercise the full handoff path
+            # (prefill pool -> KV transfer -> decode pool) either way.
+            prompt = rng.integers(
+                0, vocab, (args.burst_prompt_len,)).astype(np.int32)
+            try:
+                burst_fids.append(fleet.submit(prompt, 1))
+            except Overloaded:
+                pass
+    for fid in fids + burst_fids:
+        fleet.result(fid, timeout=args.timeout_s)
+    gaps, first_gaps = [], []
+    with lock:
+        for ts in marks.values():
+            per = [b - a for a, b in zip(ts, ts[1:])]
+            # the first two gaps straddle the admission boundary —
+            # on a disaggregated fleet that includes the one-time KV
+            # handoff (a TTFT-class cost, reported separately below),
+            # on any fleet the admission prefill of the cohort itself.
+            # Steady-state decode ITL — the thing a prefill burst must
+            # not disturb — is everything after
+            first_gaps.extend(per[:2])
+            gaps.extend(per[2:])
+    gaps.sort()
+    s = fleet.summary()
+    # the NOISE-FREE structural signal: where did prefill compute
+    # actually run? On a disaggregated fleet the decode pool's
+    # engines prefill only warm-hit tails (~1 token per handed-off
+    # request) — the burst's long prefills must all land on the
+    # prefill pool. Wall-clock ITL wobbles on a loaded CPU box; token
+    # accounting does not.
+    pool_of = {r.name: r.pool for r in fleet.replicas}
+    pool_prefill = {}
+    for name, eng in s.get("engines", {}).items():
+        pool = pool_of.get(name, "any")
+        pool_prefill[pool] = (pool_prefill.get(pool, 0)
+                              + int(eng.get("prefill_tokens", 0)))
+    return {
+        "pool_prefill_tokens": pool_prefill,
+        "itl_p99_s": (round(float(np.percentile(gaps, 99)), 5)
+                      if gaps else 0.0),
+        "itl_p50_s": (round(float(np.percentile(gaps, 50)), 5)
+                      if gaps else 0.0),
+        "first_gap_max_s": (round(max(first_gaps), 5)
+                            if first_gaps else 0.0),
+        "gaps": len(gaps),
+        "finished": s["finished"],
+        "accepted": s["accepted"],
+        "handoffs": s["handoffs"],
+        "handoff_transfers": s["handoff_transfers"],
+        "handoff_fallbacks": s["handoff_fallbacks"],
+    }
+
+
+def run_disagg(args) -> dict:
+    """The disaggregation A/B at matched load: the SAME steady trace +
+    long-prefill burst replayed through (a) a disaggregated fleet —
+    dedicated prefill pool absorbing the burst, decode pool streaming
+    undisturbed, KV chains handed off over the wire — and (b) a
+    colocated fleet of the same total replica count, where the burst's
+    monolithic prefills stall whichever replicas take them. Each mode
+    also replays WITHOUT the burst for its own baseline, so the
+    reported signal is the interference RATIO (burst ITL p99 /
+    no-burst ITL p99) — self-normalized per mode, which is what makes
+    it comparable on a noisy CPU box."""
+    import time
+
+    from quintnet_tpu.fleet import ProcessFleet
+    from quintnet_tpu.fleet.retry import RetryPolicy
+
+    vocab = vocab_size(args)
+    spec = {"file": os.path.abspath(__file__), "func": "build_engine",
+            "kwargs": _disagg_engine_kwargs(args)}
+    n_total = args.prefill_replicas + args.decode_replicas
+    results = {}
+    for mode in ("disagg", "colocated"):
+        kw = (dict(pools={"prefill": args.prefill_replicas,
+                          "decode": args.decode_replicas})
+              if mode == "disagg" else dict(n_replicas=n_total))
+        fleet = ProcessFleet(
+            spec, policy="least_work", max_pending=args.max_pending,
+            max_dispatch=args.max_dispatch, heartbeat_s=0.05,
+            handoff_retry=RetryPolicy(base_s=0.02, cap_s=0.5,
+                                      max_attempts=3),
+            name_prefix="r", **kw)
+        try:
+            fleet.warmup()
+            # throwaway warm replay: first-use costs that are not the
+            # steady-state story (KV-import scatter compiles on the
+            # decode replicas, allocator warm-up) must not land inside
+            # a measured window — same discipline as serve_bench's
+            # warm-lifecycle-first A/B
+            import argparse as _ap
+
+            # capped at the run's own --max-new: the engines are sized
+            # for THAT window, and a longer warm request would be
+            # rejected as inadmissible (prompt+max_new > max_seq_len)
+            warm = _ap.Namespace(**{**vars(args), "steady": 2,
+                                    "max_new": min(4, args.max_new)})
+            _replay_itl(warm, fleet, vocab, burst=False,
+                        seed=args.seed + 7919)
+            for burst in (False, True):
+                results[(mode, burst)] = _replay_itl(
+                    args, fleet, vocab, burst=burst,
+                    seed=args.seed + (1 if burst else 0))
+        finally:
+            fleet.drain(timeout=args.timeout_s)
+
+    def ratio(mode):
+        base = results[(mode, False)]["itl_p99_s"]
+        loud = results[(mode, True)]["itl_p99_s"]
+        return round(loud / base, 4) if base > 0 else 0.0
+
+    def vs_colocated(burst):
+        d = results[("disagg", burst)]["itl_p99_s"]
+        c = results[("colocated", burst)]["itl_p99_s"]
+        return round(d / c, 4) if c > 0 else 0.0
+
+    tag = "tiny" if args.synthetic else "full"
+    d_burst, c_burst = results[("disagg", True)], \
+        results[("colocated", True)]
+    # Two complementary signals. The headline value is the
+    # disaggregated side's SELF-interference (burst p99 / its own
+    # no-burst p99) — the "burst must not move decode ITL" bound.
+    # The matched-load comparison vs colocated is the ABSOLUTE
+    # burst-time p99 ratio (burst_itl_p99_vs_colocated < 1 = win):
+    # on a shared-core box the self-ratios are not comparable across
+    # modes, because disaggregation also cleans up the NO-burst
+    # baseline (the prefill pool idles when nobody bursts —
+    # baseline_itl_p99_vs_colocated reports that win), which deflates
+    # the colocated ratio's denominator asymmetrically.
+    return {
+        "metric": f"fleet_disagg_{args.model}_{tag}_itl_interference",
+        "value": ratio("disagg"),
+        "unit": "ratio",
+        "vs_baseline": 1.0,
+        "rc": 0,
+        "extras": {
+            "colocated_interference": ratio("colocated"),
+            "burst_itl_p99_vs_colocated": vs_colocated(True),
+            "baseline_itl_p99_vs_colocated": vs_colocated(False),
+            "disagg_itl_p99_no_burst_s":
+                results[("disagg", False)]["itl_p99_s"],
+            "disagg_itl_p99_burst_s": d_burst["itl_p99_s"],
+            "colocated_itl_p99_no_burst_s":
+                results[("colocated", False)]["itl_p99_s"],
+            "colocated_itl_p99_burst_s": c_burst["itl_p99_s"],
+            "disagg_itl_p50_burst_s": d_burst["itl_p50_s"],
+            "colocated_itl_p50_burst_s": c_burst["itl_p50_s"],
+            "handoffs": d_burst["handoffs"],
+            "handoff_transfers": d_burst["handoff_transfers"],
+            "handoff_fallbacks": d_burst["handoff_fallbacks"],
+            "finished": d_burst["finished"],
+            "accepted": d_burst["accepted"],
+            # structural isolation (deterministic, CI-gated): every
+            # long prefill of the burst ran on the prefill pool; the
+            # decode pool prefilled warm-hit tails only
+            "disagg_pool_prefill_tokens":
+                d_burst["pool_prefill_tokens"],
+            "colocated_pool_prefill_tokens":
+                c_burst["pool_prefill_tokens"],
+            "kv_dtype": args.kv_dtype,
+            "colocated_finished": c_burst["finished"],
+            "colocated_accepted": c_burst["accepted"],
+            "prefill_replicas": args.prefill_replicas,
+            "decode_replicas": args.decode_replicas,
+            "steady": args.steady,
+            "burst_prompts": args.burst_prompts,
+            "burst_prompt_len": args.burst_prompt_len,
+            "max_new": args.max_new,
+            "slots": args.slots,
+            "model": args.model,
+            "synthetic": bool(args.synthetic),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2", choices=("gpt2", "llama"))
@@ -378,6 +660,38 @@ def main():
                          "armed kill becomes an abrupt process exit "
                          "and migration runs off the dispatcher's "
                          "write-ahead journal")
+    ap.add_argument("--disagg", action="store_true",
+                    help="TTFT-vs-ITL interference A/B: a "
+                         "disaggregated prefill/decode process fleet "
+                         "vs a colocated one of the same size, each "
+                         "replayed with and without a long-prefill "
+                         "burst; reports the decode-ITL-p99 "
+                         "interference ratio per mode")
+    ap.add_argument("--prefill-replicas", type=int, default=1)
+    ap.add_argument("--decode-replicas", type=int, default=2)
+    ap.add_argument("--steady", type=int, default=6,
+                    help="steady short-prompt decode requests per "
+                         "--disagg replay (the ITL probe population)")
+    ap.add_argument("--burst-prompts", type=int, default=3,
+                    help="long-prefill requests injected mid-decode "
+                         "on --disagg burst replays")
+    ap.add_argument("--burst-prompt-len", type=int, default=96)
+    ap.add_argument("--disagg-n-embd", type=int, default=None,
+                    help="widen the synthetic gpt2 for --disagg so a "
+                         "long prefill costs enough to measure")
+    ap.add_argument("--kv-dtype", default="int8",
+                    help="KV layout policy for the --disagg engines "
+                         "(int8 makes each handed-off chain ~4x "
+                         "smaller on the wire — PR 10's layout is "
+                         "half of what makes disaggregation cheap)")
+    ap.add_argument("--steady-gap-s", type=float, default=0.1,
+                    help="spacing between --disagg steady arrivals "
+                         "(keeps the prefill pool periodically busy "
+                         "in burst AND no-burst replays)")
+    ap.add_argument("--burst-delay-s", type=float, default=0.1,
+                    help="seconds into the steady decode at which the "
+                         "--disagg burst lands (early enough that the "
+                         "steady requests are still decoding)")
     ap.add_argument("--timeout-s", type=float, default=600.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
@@ -387,7 +701,10 @@ def main():
         args.burst = args.requests
 
     records = []
-    if args.process:
+    if args.disagg:
+        records.append(run_disagg(args))
+        print(json.dumps(records[-1]))
+    elif args.process:
         for policy in [p for p in args.policies.split(",") if p]:
             records.append(run_policy_process(args, policy))
             print(json.dumps(records[-1]))
