@@ -94,6 +94,20 @@ class AdmissionQueue:
             self._items = [i for i in self._items if i not in expired]
         return expired
 
+    def oldest_wait_s(self, now: Optional[float] = None) -> float:
+        """Wait age of the OLDEST queued item (0.0 when empty). Not
+        necessarily the head: migration re-queues push_front younger
+        work past older arrivals, so this scans ``submit_time`` across
+        the queue. The overload signal the pressure plane samples and
+        the front door's 429 Retry-After hints with — queue DEPTH says
+        how much is waiting, wait AGE says how badly."""
+        items = list(self._items)
+        if not items:
+            return 0.0
+        now = self.clock() if now is None else now
+        oldest = min(getattr(i, "submit_time", now) for i in items)
+        return max(now - oldest, 0.0)
+
     def peek_adapter_id(self) -> Optional[str]:
         """The queue head's LoRA binding (or None) — the dispatcher
         reads it before :meth:`pop` so the router can apply adapter

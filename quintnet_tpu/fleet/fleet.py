@@ -35,6 +35,7 @@ correctness rests on that equivalence.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -44,7 +45,8 @@ import numpy as np
 
 from quintnet_tpu.analysis import assert_compile_count as _assert_cc
 from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
-from quintnet_tpu.fleet.health import DEAD, CircuitBreaker
+from quintnet_tpu.fleet.health import (CLOSED, DEAD, HEALTHY,
+                                       CircuitBreaker)
 from quintnet_tpu.fleet.replica import Replica
 from quintnet_tpu.fleet.router import Router
 from quintnet_tpu.fleet.router import eligible as router_eligible
@@ -86,6 +88,20 @@ class FleetRequest:
         self.dispatched_phase: Optional[str] = None
         self.warm_replica: Optional[str] = None
         self.first_token_time: Optional[float] = None
+        # dispatcher-clock timestamp of the LATEST token — the SLO
+        # engine's inter-token-latency anchor (fleet/proc.py). Reset
+        # to None across a handoff or migration: the cross-replica
+        # gap is a TTFT-class cost charged to the handoff signals,
+        # not a decode-cadence violation
+        self.last_token_time: Optional[float] = None
+        # the thread fleet's SLO feed (obs/slo.py): ServeFleet binds
+        # its engine here at submit so :meth:`deliver` — which runs on
+        # the replica worker, the thread fleet's client-visible
+        # delivery point — observes TTFT/ITL. The process fleet leaves
+        # it None and observes at ITS delivery point, the dispatcher
+        # (fleet/proc.py _deliver_token): one observation per token
+        # either way, taken where the client actually sees it
+        self.slo = None
         self.finish_time: Optional[float] = None
         self.output: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
@@ -109,8 +125,16 @@ class FleetRequest:
         self.committed.append(int(token))
         if last:
             self.last_seen = True
-        if self.first_token_time is None:
+        first = self.first_token_time is None
+        if first:
             self.first_token_time = self._clock()
+        if self.slo is not None:
+            now = self._clock()
+            if first:
+                self.slo.observe("ttft", now - self.submit_time)
+            elif self.last_token_time is not None:
+                self.slo.observe("itl", now - self.last_token_time)
+            self.last_token_time = now
         if self.on_token is not None:
             try:
                 self.on_token(self.fid, token, last)
@@ -173,6 +197,14 @@ class FleetMetrics:
     handoff_transfers: int = 0
     handoff_retries: int = 0
     handoff_fallbacks: int = 0
+    # admission-queue pressure gauges, refreshed through the probe the
+    # owning fleet attaches (the metrics object cannot see the queue):
+    # depth says how much is waiting, oldest-wait age how badly —
+    # summary() carries both so /metrics and the signal bus read one
+    # ledger, not two
+    queue_depth: int = 0
+    queue_oldest_wait_s: float = 0.0
+    _queue_probe: Optional[Callable] = None
     # percentile sources, reservoir-bounded like the engine's
     # (serve/metrics.Reservoir): exact below the cap, uniform sampling
     # above — a long-lived front door stops leaking one float per
@@ -192,10 +224,16 @@ class FleetMetrics:
         return self.shed / max(self.submitted, 1)
 
     def summary(self) -> Dict:
+        if self._queue_probe is not None:
+            depth, age = self._queue_probe()
+            self.queue_depth = int(depth)
+            self.queue_oldest_wait_s = float(age)
         return {
             "submitted": self.submitted,
             "accepted": self.accepted,
             "finished": self.finished,
+            "queue_depth": self.queue_depth,
+            "queue_oldest_wait_s": round(self.queue_oldest_wait_s, 4),
             "shed": self.shed,
             "shed_queue_full": self.shed_queue_full,
             "shed_deadline": self.shed_deadline,
@@ -233,7 +271,7 @@ class ServeFleet:
                  chaos=None, clock: Callable[[], float] = time.monotonic,
                  name_prefix: str = "r", poll_s: float = 0.02,
                  obs: bool = False, crash_dir: Optional[str] = None,
-                 ring_capacity: int = 512):
+                 ring_capacity: int = 512, slo=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self._factory = engine_factory
@@ -247,11 +285,19 @@ class ServeFleet:
         # spans become an in-memory post-mortem (``last_crash``) and,
         # with ``crash_dir`` set, a crash-dump file. All of it is
         # inert: tracing on is token-bit-identical to tracing off.
-        self._obs = bool(obs)
+        # The SLO engine + signal bus (obs/slo.py, obs/signals.py)
+        # read the engine step rings, so ``slo=`` implies ``obs=True``.
+        self._obs = bool(obs) or slo is not None
         self.crash_dir = crash_dir
         self._ring_capacity = int(ring_capacity)
         self.tracer = None
         self.events = None
+        self.slo = None            # obs.SLOEngine once armed
+        self.signals = None        # obs.SignalBus once armed
+        self.planner = None        # always None here: rebalancing
+        #   moves replicas BETWEEN pools and the thread fleet has none
+        #   (ProcessFleet(pools=...) is the planner's home)
+        self._signal_next_t = 0.0
         if self._obs:
             from quintnet_tpu.obs import EventLog, Tracer
 
@@ -266,6 +312,9 @@ class ServeFleet:
         self._router = Router(policy)
         self._cv = threading.Condition()
         self._queue = AdmissionQueue(max_pending, clock=clock)
+        self.metrics._queue_probe = self._queue_gauges
+        if slo is not None:
+            self.arm_slo(slo)
         self._requests: Dict[int, FleetRequest] = {}
         self._fid_counter = 0
         self._open = 0                 # accepted, not yet finished/shed
@@ -380,11 +429,13 @@ class ServeFleet:
             self.metrics.submitted += 1
             if self._draining or self._closed:
                 self.metrics.shed_shutdown += 1
+                self._slo_observe("shed", 1.0)
                 raise Overloaded(
                     "shutdown", "fleet is draining; not accepting work")
             now = self.clock()
             if deadline_s is not None and deadline_s <= 0:
                 self.metrics.shed_deadline += 1
+                self._slo_observe("shed", 1.0)
                 raise Overloaded(
                     "deadline", f"deadline_s={deadline_s} already expired "
                     f"at submit")
@@ -399,6 +450,9 @@ class ServeFleet:
                           else now + float(deadline_s)),
                 on_token=on_token, submit_time=now, clock=self.clock,
                 adapter_id=adapter_id, trace_id=f"f{fid}")
+            freq.slo = self.slo    # TTFT/ITL observed at delivery
+            #   (FleetRequest.deliver — the thread fleet's client-
+            #   visible point; None when the engine is not armed)
             if self.tracer is not None:
                 self.tracer.event(freq.trace_id, "fleet_submit",
                                   fid=fid, prompt_len=int(prompt.size),
@@ -408,10 +462,12 @@ class ServeFleet:
                 self._queue.push(freq)
             except Overloaded:
                 self.metrics.shed_queue_full += 1
+                self._slo_observe("shed", 1.0)
                 raise
             self._requests[fid] = freq
             self._open += 1
             self.metrics.accepted += 1
+            self._slo_observe("shed", 0.0)
             self._cv.notify_all()
             return fid
 
@@ -463,6 +519,7 @@ class ServeFleet:
             freq.output = output
             freq.finish_time = self.clock()
             self.metrics.finished += 1
+            self._slo_observe("error", 0.0)
             if freq.first_token_time is not None:
                 self.metrics.ttfts.append(
                     freq.first_token_time - freq.submit_time)
@@ -493,6 +550,7 @@ class ServeFleet:
                     and error.reason == "deadline"):
                 self.metrics.shed_deadline += 1
             freq.error = error
+            self._slo_observe("error", 1.0)
             self._open -= 1
             freq.event.set()
             self._cv.notify_all()
@@ -525,6 +583,9 @@ class ServeFleet:
                                       "replica died during close")
                     continue
                 freq.migrations += 1
+                freq.last_token_time = None   # ITL re-anchors on the
+                #   survivor: the migration gap is a fault cost, not a
+                #   decode-cadence reading (see fleet/proc.py)
                 self.metrics.migrations += 1
                 self._emit("migration", fid=freq.fid,
                            trace_id=freq.trace_id,
@@ -561,6 +622,11 @@ class ServeFleet:
             "replica": rep.name, "reason": reason,
             "error": f"{type(error).__name__}: {error}",
             "ring": ring, "traces": traces, "requests": requests,
+            # last pool-pressure snapshot (obs/signals.py), when the
+            # signal plane is armed — same black-box field the process
+            # fleet freezes (fleet/proc.py)
+            "signals": (self.signals.snapshot()
+                        if self.signals is not None else {}),
         }
         if self.crash_dir is not None:
             self._pending_dumps.append(dict(
@@ -575,6 +641,10 @@ class ServeFleet:
         for spec in pending:
             path = write_crash_dump(self.crash_dir, **spec)
             self.crash_dumps.append(path)
+            # the writer keeps only the newest N files — drop ledger
+            # entries whose file was pruned so every path here loads
+            self.crash_dumps = [p for p in self.crash_dumps
+                                if os.path.exists(p)]
             self._emit("crash_dump", replica=spec["replica"],
                        path=path)
 
@@ -587,6 +657,7 @@ class ServeFleet:
             self.metrics.shed_deadline += 1
         else:
             self.metrics.shed_shutdown += 1
+        self._slo_observe("shed", 1.0)
         self._emit("shed", fid=freq.fid, trace_id=freq.trace_id,
                    reason=reason)
         freq.error = Overloaded(reason, message)
@@ -643,6 +714,7 @@ class ServeFleet:
                 if self._closed:
                     return
                 self._tend_replicas_locked()
+                self._tend_signals_locked(self.clock())
                 self._dispatch_locked()
                 pending, self._pending_dumps = self._pending_dumps, []
                 if not pending:
@@ -744,9 +816,95 @@ class ServeFleet:
                                       "breaker": self._breakers[r.name].state}
                              for r in self._replicas},
                 "queue_depth": len(self._queue),
+                "queue_oldest_wait_s": round(
+                    self._queue.oldest_wait_s(), 4),
                 "open_requests": self._open,
                 "draining": self._draining,
             }
+
+    def _queue_gauges(self):
+        """(depth, oldest wait age) for FleetMetrics' probe — and the
+        front door's Retry-After hint. Reads snapshot copies, so it is
+        safe from any thread without the fleet lock."""
+        return len(self._queue), self._queue.oldest_wait_s()
+
+    # ------------------------------------------------------------------
+    # SLO engine + signal plane (obs/slo.py, obs/signals.py)
+    # ------------------------------------------------------------------
+    def arm_slo(self, config) -> None:
+        """Arm the SLO engine + signal bus against this fleet's
+        dispatcher (``config``: :class:`~quintnet_tpu.obs.slo.
+        SLOConfig`). TTFT/ITL observe at token delivery, shed/error
+        rates at submit/finish, and the dispatcher samples queue/
+        occupancy/KV pressure each ``eval_interval_s``. No rebalance
+        planner here — the thread fleet has no pools to move replicas
+        between (see :meth:`ProcessFleet.arm_slo`). Requires the
+        flight recorder (``slo=`` at the constructor implies it) for
+        the step rings the occupancy signals read."""
+        from quintnet_tpu.obs import EventLog
+        from quintnet_tpu.obs.signals import SignalBus
+        from quintnet_tpu.obs.slo import SLOEngine
+        if not self._obs:
+            # silently arming would sample permanently-zero occupancy
+            # and KV pressure (the rings are only recorded when the
+            # flight recorder is on) — judgment over dead gauges
+            raise ValueError(
+                "arm_slo requires a fleet built with obs=True (or "
+                "slo= at the constructor): the occupancy/KV signals "
+                "read the per-replica step rings")
+        with self._cv:
+            if self.events is None:
+                self.events = EventLog(clock=self.clock)
+            self.slo = SLOEngine(config, clock=self.clock,
+                                 events=self.events)
+            self.signals = SignalBus(clock=self.clock)
+            self._signal_next_t = 0.0
+
+    def _slo_observe(self, stream: str, value: float) -> None:
+        if self.slo is not None:
+            self.slo.observe(stream, value)
+
+    def _tend_signals_locked(self, now: float) -> None:
+        """One signal-plane tick on the dispatcher thread: sample
+        pressure gauges from state already in this address space (the
+        admission queue, each engine's step ring, the breakers), then
+        re-evaluate the SLO engine. Host-side floats only; no device
+        sync, no mutation — inert by construction."""
+        if self.slo is None:
+            return
+        if now < self._signal_next_t:
+            return
+        self._signal_next_t = now + self.slo.config.eval_interval_s
+        bus = self.signals
+        bus.sample("queue_depth", float(len(self._queue)))
+        bus.sample("queue_oldest_wait_s", self._queue.oldest_wait_s())
+        running = slots = kv_used = kv_total = 0
+        open_breakers = 0
+        for rep in self._replicas:
+            if self._breakers[rep.name].state != CLOSED:
+                open_breakers += 1
+            if rep.state != HEALTHY:
+                # a dead worker's recorder still holds its last step
+                # record — stale occupancy/KV, not live pressure
+                continue
+            eng = rep.engine
+            slots += int(getattr(eng, "max_slots", 0) or 0)
+            recorder = getattr(eng, "recorder", None)
+            last = recorder.last() if recorder is not None else None
+            if last is None:
+                continue
+            running += int(last.get("running", 0))
+            kv_used += int(last.get("kv_blocks_used", 0))
+            kv_total += int(last.get("kv_blocks_total", 0))
+        bus.sample("occupancy", running / slots if slots else 0.0)
+        bus.sample("kv_pressure",
+                   kv_used / kv_total if kv_total else 0.0)
+        bus.sample("breakers_open", float(open_breakers))
+        self.slo.evaluate(now)
+
+    def queue_oldest_wait_s(self) -> float:
+        """Wait age of the oldest queued request (0.0 when empty)."""
+        return self._queue.oldest_wait_s()
 
     def reset_metrics(self) -> None:
         """Fresh ledgers fleet-wide (bench warmup boundary): fleet
@@ -755,6 +913,7 @@ class ServeFleet:
         after warmup (:meth:`arm_chaos`) counts REPLAY steps only."""
         with self._cv:
             self.metrics = FleetMetrics()
+            self.metrics._queue_probe = self._queue_gauges
             self._retired_metrics = []
             for rep in self._replicas:
                 rep.steps = 0
@@ -794,6 +953,8 @@ class ServeFleet:
         out["policy"] = self._router.policy
         out["replicas"] = per_replica
         out["engine"] = self.engine_summary()
+        if self.slo is not None:
+            out["slo"] = self.slo.status()
         return out
 
     def assert_compile_count(self, prefill: Optional[int] = None,

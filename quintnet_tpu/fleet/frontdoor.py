@@ -56,9 +56,20 @@ Endpoints:
 - ``GET /metrics`` — the SAME ledgers in Prometheus text exposition
   format (``text/plain; version=0.0.4``; quintnet_tpu/obs/prom.py):
   ``quintnet_fleet_*`` counters, ``quintnet_engine_*{replica="..."}``
-  per-replica series, ``quintnet_replica_up`` liveness — every
-  existing counter scrapeable as a time series. Kept separate from
+  per-replica series, ``quintnet_replica_up`` liveness plus
+  heartbeat-staleness and breaker-state gauges — and, when the SLO
+  engine / signal plane is armed (obs/slo.py, obs/signals.py), the
+  ``quintnet_slo_*`` burn-rate families and
+  ``quintnet_pool_pressure_*`` per-pool gauges. Every existing
+  counter scrapeable as a time series. Kept separate from
   ``/v1/metrics``: one path per format, both read-only.
+
+With an armed SLO engine, ``GET /healthz`` additionally carries
+``"slo": {"breaching": [...], "objectives": {...}}`` and a breach
+downgrades 200 ``"ok"`` to 200 ``"degraded"`` — the degraded body
+NAMES the breaching objectives; 429/503 ``Retry-After`` is raised to
+the admission queue's oldest-wait age when that exceeds the
+configured floor.
 
 Works identically over a thread :class:`ServeFleet` and a process
 :class:`ProcessFleet` — both expose submit/result/health with the
@@ -280,6 +291,19 @@ class FrontDoor:
                           for r in h["replicas"].values())
             h["status"] = ("ok" if serving and not h["draining"]
                            else "unavailable")
+        # SLO status (obs/slo.py, fleets with the engine armed): the
+        # body always names the breaching objectives and their burns,
+        # and a breach downgrades "ok" to "degraded" — the node still
+        # serves (a load balancer must NOT pull it for a latency
+        # contract slip), but the body says exactly which promise is
+        # burning budget and which pool to blame
+        slo = getattr(self.fleet, "slo", None)
+        if slo is not None:
+            status = slo.status()
+            h["slo"] = {"breaching": status["breaching"],
+                        "objectives": status["objectives"]}
+            if status["breaching"] and h["status"] == "ok":
+                h["status"] = "degraded"
         unavailable = h["status"] == "unavailable"
         await self._respond(
             writer, 503 if unavailable else 200, h,
@@ -287,7 +311,15 @@ class FrontDoor:
                      if unavailable else None))
 
     def _retry_after(self) -> str:
-        return str(int(math.ceil(self.retry_after_s)))
+        """Retry-After seconds: at least the configured floor, raised
+        to the oldest queued request's wait age when the fleet exposes
+        it — a client told to come back sooner than the queue is
+        already waiting would only bounce off the same 429."""
+        hint = self.retry_after_s
+        probe = getattr(self.fleet, "queue_oldest_wait_s", None)
+        if callable(probe):
+            hint = max(hint, probe())
+        return str(int(math.ceil(hint)))
 
     def _engine_summaries(self) -> Dict:
         """Per-replica engine summaries. For the process fleet this is
@@ -313,8 +345,13 @@ class FrontDoor:
         loop = asyncio.get_running_loop()
         engines = await loop.run_in_executor(None,
                                              self._engine_summaries)
-        text = render_exposition(self.fleet.metrics.summary(), engines,
-                                 health=self.fleet.health())
+        slo = getattr(self.fleet, "slo", None)
+        signals = getattr(self.fleet, "signals", None)
+        text = render_exposition(
+            self.fleet.metrics.summary(), engines,
+            health=self.fleet.health(),
+            slo=slo.status() if slo is not None else None,
+            pressure=signals.gauges() if signals is not None else None)
         data = text.encode("utf-8")
         head = ["HTTP/1.1 200 OK",
                 "Content-Type: text/plain; version=0.0.4; "

@@ -62,7 +62,7 @@ import numpy as np
 from quintnet_tpu.fleet import wire
 from quintnet_tpu.fleet.admission import AdmissionQueue, Overloaded
 from quintnet_tpu.fleet.fleet import FleetMetrics, FleetRequest
-from quintnet_tpu.fleet.health import (DEAD, HEALTHY, STALLED,
+from quintnet_tpu.fleet.health import (CLOSED, DEAD, HEALTHY, STALLED,
                                        STARTING, STOPPED, Backoff,
                                        CircuitBreaker, HeartbeatMonitor)
 from quintnet_tpu.fleet.retry import RetryPolicy
@@ -626,7 +626,8 @@ class ProcessFleet:
                  name_prefix: str = "p", poll_s: float = 0.02,
                  spawn_timeout_s: float = 300.0,
                  obs: bool = False, crash_dir: Optional[str] = None,
-                 ring_capacity: int = 512):
+                 ring_capacity: int = 512,
+                 slo=None, planner: Optional[Dict] = None):
         # disaggregated prefill/decode pools (DistServe/Splitwise):
         # ``pools={"prefill": P, "decode": D}`` splits the replicas
         # onto dedicated pools — prefill replicas run a prompt's
@@ -671,11 +672,19 @@ class ProcessFleet:
         # heartbeat-mirrored per-replica flight-recorder ring that
         # makes a SIGKILL'd child's last-known steps dumpable with
         # zero cooperation from the corpse.
-        self._obs = bool(obs)
+        # the SLO engine + pool-pressure signal plane (obs/slo.py,
+        # obs/signals.py) need the heartbeat-mirrored rings and the
+        # typed event log, so ``slo=`` implies ``obs=True``
+        self._obs = bool(obs) or slo is not None
         self.crash_dir = crash_dir
         self._ring_capacity = int(ring_capacity)
         self.tracer = None
         self.events = None
+        self.slo = None            # obs.SLOEngine once armed
+        self.signals = None        # obs.SignalBus once armed
+        self.planner = None        # obs.PoolRebalancePlanner (disagg)
+        self._planner_kwargs = dict(planner or {})
+        self._signal_next_t = 0.0
         if self._obs:
             from quintnet_tpu.obs import EventLog, Tracer
 
@@ -699,6 +708,9 @@ class ProcessFleet:
         self._router = Router(policy)
         self._cv = threading.Condition()
         self._queue = AdmissionQueue(max_pending, clock=clock)
+        self.metrics._queue_probe = self._queue_gauges
+        if slo is not None:
+            self.arm_slo(slo, **self._planner_kwargs)
         self._requests: Dict[int, FleetRequest] = {}
         self._fid_counter = 0
         self._open = 0
@@ -901,11 +913,13 @@ class ProcessFleet:
             self.metrics.submitted += 1
             if self._draining or self._closed:
                 self.metrics.shed_shutdown += 1
+                self._slo_observe("shed", 1.0)
                 raise Overloaded(
                     "shutdown", "fleet is draining; not accepting work")
             now = self.clock()
             if deadline_s is not None and deadline_s <= 0:
                 self.metrics.shed_deadline += 1
+                self._slo_observe("shed", 1.0)
                 raise Overloaded(
                     "deadline", f"deadline_s={deadline_s} already "
                     f"expired at submit")
@@ -917,6 +931,7 @@ class ProcessFleet:
                 # about to heal (prefill-pool loss never sheds: the
                 # decode pool absorbs prefill work instead)
                 self.metrics.shed_pool_down += 1
+                self._slo_observe("shed", 1.0)
                 self._emit("shed", fid=None, reason="pool_down")
                 raise Overloaded(
                     "pool_down",
@@ -935,6 +950,11 @@ class ProcessFleet:
                           else now + float(deadline_s)),
                 on_token=on_token, submit_time=now, clock=self.clock,
                 adapter_id=adapter_id, trace_id=f"f{fid}")
+            freq.slo = self.slo    # TTFT/ITL observed at delivery
+            #   (FleetRequest.deliver — fired from the reader thread
+            #   under the fleet lock, the client-visible point; the
+            #   anchor is reset across handoff/migration so a cross-
+            #   replica gap never reads as a decode-cadence violation)
             if self.tracer is not None:
                 self.tracer.event(freq.trace_id, "fleet_submit",
                                   fid=fid, prompt_len=int(prompt.size),
@@ -949,10 +969,12 @@ class ProcessFleet:
                 self._queue.push(freq)
             except Overloaded:
                 self.metrics.shed_queue_full += 1
+                self._slo_observe("shed", 1.0)
                 raise
             self._requests[fid] = freq
             self._open += 1
             self.metrics.accepted += 1
+            self._slo_observe("shed", 0.0)
             self._cv.notify_all()
             return fid
 
@@ -1123,6 +1145,7 @@ class ProcessFleet:
             [freq.prompt, np.asarray(freq.committed, np.int32)])
         freq.finish_time = self.clock()
         self.metrics.finished += 1
+        self._slo_observe("error", 0.0)
         if freq.first_token_time is not None:
             self.metrics.ttfts.append(
                 freq.first_token_time - freq.submit_time)
@@ -1218,6 +1241,7 @@ class ProcessFleet:
                        error=f"{type(error).__name__}: {error}")
 
         imported, dst = 0, None
+        handoff_t0 = self.clock()
         # the request's remaining deadline bounds the WHOLE transfer:
         # retrying past it wastes RPCs on a request that can only be
         # shed as expired at its next dispatch — fall back (a no-op
@@ -1276,7 +1300,17 @@ class ProcessFleet:
                 with self._cv:
                     self.metrics.handoff_fallbacks += 1
         finally:
+            if self.signals is not None:
+                # the transfer's realized wall (success or fallback) —
+                # a TTFT-class cost the pressure plane watches
+                self.signals.sample("handoff_latency_s",
+                                    self.clock() - handoff_t0)
             with self._cv:
+                # re-anchor the SLO engine's ITL chain: the gap from
+                # the prefill replica's first token to the decode
+                # replica's second spans the handoff, not the decode
+                # cadence
+                freq.last_token_time = None
                 if self._closed:
                     self._shed_locked(
                         freq, "shutdown",
@@ -1304,6 +1338,7 @@ class ProcessFleet:
                     and error.reason == "deadline"):
                 self.metrics.shed_deadline += 1
             freq.error = error
+            self._slo_observe("error", 1.0)
             self._open -= 1
             freq.event.set()
             self._cv.notify_all()
@@ -1387,6 +1422,11 @@ class ProcessFleet:
         self.last_crash = {
             "replica": rep.name, "reason": reason, "error": err,
             "ring": ring, "traces": traces, "requests": requests,
+            # the last pool-pressure snapshot rides the black box:
+            # "was the pool already saturated when p1 died" is a
+            # question the corpse cannot answer but the bus can
+            "signals": (self.signals.snapshot()
+                        if self.signals is not None else {}),
         }
         if self.crash_dir is not None:
             self._pending_dumps.append(dict(
@@ -1402,6 +1442,10 @@ class ProcessFleet:
         for spec in pending:
             path = write_crash_dump(self.crash_dir, **spec)
             self.crash_dumps.append(path)
+            # the writer keeps only the newest N files — drop ledger
+            # entries whose file was pruned so every path here loads
+            self.crash_dumps = [p for p in self.crash_dumps
+                                if os.path.exists(p)]
             self._emit("crash_dump", replica=spec["replica"],
                        path=path)
 
@@ -1423,6 +1467,8 @@ class ProcessFleet:
                                   "replica died during close")
                 continue
             freq.migrations += 1
+            freq.last_token_time = None   # ITL re-anchors on the
+            #                               survivor (see fleet.py)
             self.metrics.migrations += 1
             self._emit("migration", fid=freq.fid,
                        trace_id=freq.trace_id,
@@ -1471,9 +1517,150 @@ class ProcessFleet:
                 self._emit("pool_degraded" if down else "pool_recovered",
                            pool=pool)
 
+    # ------------------------------------------------------------------
+    # SLO engine + pool-pressure signal plane (obs/slo.py, obs/signals.py)
+    # ------------------------------------------------------------------
+    def arm_slo(self, config, **planner_kwargs) -> None:
+        """Arm the SLO engine, the signal bus and (disaggregated
+        fleets only) the observe-only rebalance planner against this
+        fleet's dispatcher. ``config`` is an
+        :class:`~quintnet_tpu.obs.slo.SLOConfig`; ``planner_kwargs``
+        go to :class:`~quintnet_tpu.obs.signals.PoolRebalancePlanner`
+        (cooldown, donor-occupancy gate). Can be called after
+        construction — the bench measures a baseline first and derives
+        its targets from it — but the fleet must have been built with
+        ``obs=True`` (or ``slo=`` at the constructor) for the
+        heartbeat-mirrored rings the occupancy signals read."""
+        from quintnet_tpu.obs import EventLog
+        from quintnet_tpu.obs.signals import (PoolRebalancePlanner,
+                                              SignalBus)
+        from quintnet_tpu.obs.slo import SLOEngine
+        if not self._obs:
+            # silently arming would sample permanently-zero occupancy
+            # and KV pressure (children only piggyback ring records
+            # when spawned with obs on) and the planner's donor gate
+            # would trivially pass — judgment over dead gauges
+            raise ValueError(
+                "arm_slo requires a fleet built with obs=True (or "
+                "slo= at the constructor): the occupancy/KV signals "
+                "read the heartbeat-mirrored step rings")
+        with self._cv:
+            if self.events is None:
+                self.events = EventLog(clock=self.clock)
+            self.slo = SLOEngine(config, clock=self.clock,
+                                 events=self.events)
+            self.signals = SignalBus(clock=self.clock)
+            self.planner = (PoolRebalancePlanner(
+                clock=self.clock, events=self.events, **planner_kwargs)
+                if self._disagg else None)
+            self._signal_next_t = 0.0
+
+    def _slo_observe(self, stream: str, value: float) -> None:
+        if self.slo is not None:
+            self.slo.observe(stream, value)
+
+    def _queue_gauges(self):
+        """(depth, oldest wait age) — FleetMetrics' probe and the
+        front door's Retry-After hint; snapshot reads, lock-free."""
+        return len(self._queue), self._queue.oldest_wait_s()
+
+    def queue_oldest_wait_s(self) -> float:
+        """Wait age of the oldest queued request (0.0 when empty)."""
+        return self._queue.oldest_wait_s()
+
+    def _tend_signals_locked(self, now: float) -> None:
+        """One signal-plane tick on the dispatcher thread (fleet lock
+        held): sample per-pool pressure onto the bus from state the
+        dispatcher ALREADY holds — the admission queue, the
+        heartbeat-mirrored step rings, breaker/heartbeat records, the
+        handoff ledger — then re-evaluate the SLO engine and let the
+        planner judge. Everything is host-side floats; nothing here
+        blocks, syncs a device, or mutates routing state (the planner
+        is observe-only by construction)."""
+        if self.slo is None:
+            return
+        if now < self._signal_next_t:
+            return
+        self._signal_next_t = now + self.slo.config.eval_interval_s
+        bus = self.signals
+        items = self._queue.items()
+
+        def oldest(its):
+            # per-pool SUBSETS only; the fleet-wide age reuses the
+            # queue's own accessor (getattr-tolerant where this is not)
+            if not its:
+                return 0.0
+            return max(0.0, now - min(i.submit_time for i in its))
+
+        bus.sample("queue_depth", float(len(items)))
+        bus.sample("queue_oldest_wait_s",
+                   self._queue.oldest_wait_s(now))
+        limits = self._limits or {}
+        max_slots = int(limits.get("max_slots") or 0)
+        budget = limits.get("prefill_chunk_budget")
+        for pool in sorted({r.pool for r in self._replicas}):
+            members = [r for r in self._replicas if r.pool == pool]
+            if self._disagg:
+                # phase-aware queue attribution: a request with no
+                # committed token waits on the prefill pool, one with
+                # a journal waits on decode
+                pending = [i for i in items
+                           if bool(i.committed) == (pool == "decode")]
+                bus.sample("queue_depth", float(len(pending)),
+                           pool=pool)
+                bus.sample("queue_oldest_wait_s", oldest(pending),
+                           pool=pool)
+            running = slots = kv_used = kv_total = 0
+            chunk_spent = chunk_steps = 0
+            hb_age = 0.0
+            open_breakers = 0
+            for r in members:
+                if self._breakers[r.name].state != CLOSED:
+                    open_breakers += 1
+                if r.state != HEALTHY:
+                    # a corpse's last-known ring record is forensics
+                    # (crash dumps), not live pressure: counting its
+                    # slots/running/KV would double-count work that
+                    # already migrated to a survivor and skew the
+                    # planner's donor-occupancy gate mid-outage
+                    continue
+                hb_age = max(hb_age, r.hb.age_s)
+                if max_slots:
+                    slots += max_slots
+                with r._ring_lock:
+                    last = r.ring[-1] if r.ring else None
+                if last is None:
+                    continue
+                running += int(last.get("running", 0))
+                kv_used += int(last.get("kv_blocks_used", 0))
+                kv_total += int(last.get("kv_blocks_total", 0))
+                if budget and last.get("prefill_chunks", 0) > 0:
+                    chunk_spent += int(last.get("prefill_tokens", 0))
+                    chunk_steps += 1
+            bus.sample("occupancy",
+                       running / slots if slots else 0.0, pool=pool)
+            bus.sample("kv_pressure",
+                       kv_used / kv_total if kv_total else 0.0,
+                       pool=pool)
+            if budget:
+                bus.sample("chunk_budget_saturation",
+                           chunk_spent / (chunk_steps * budget)
+                           if chunk_steps else 0.0, pool=pool)
+            bus.sample("heartbeat_age_s", hb_age, pool=pool)
+            bus.sample("breakers_open", float(open_breakers),
+                       pool=pool)
+        m = self.metrics
+        bus.sample("handoff_fallback_rate",
+                   m.handoff_fallbacks / m.handoffs if m.handoffs
+                   else 0.0)
+        status = self.slo.evaluate(now)
+        if self.planner is not None:
+            self.planner.plan(status, bus)
+
     def _tend_locked(self) -> None:
         now = self.clock()
         self._tend_pools_locked()
+        self._tend_signals_locked(now)
         for i, rep in enumerate(self._replicas):
             if rep.state == STARTING:
                 if not rep.proc.is_alive():
@@ -1523,6 +1710,7 @@ class ProcessFleet:
             self.metrics.shed_deadline += 1
         else:
             self.metrics.shed_shutdown += 1
+        self._slo_observe("shed", 1.0)
         self._emit("shed", fid=freq.fid, trace_id=freq.trace_id,
                    reason=reason)
         freq.error = Overloaded(reason, message)
@@ -1878,6 +2066,8 @@ class ProcessFleet:
                 "pools": pools,
                 "disaggregated": self._disagg,
                 "queue_depth": len(self._queue),
+                "queue_oldest_wait_s": round(
+                    self._queue.oldest_wait_s(), 4),
                 "open_requests": self._open,
                 "draining": self._draining,
             }
@@ -1887,6 +2077,7 @@ class ProcessFleet:
         each child engine's ServeMetrics and step counter."""
         with self._cv:
             self.metrics = FleetMetrics()
+            self.metrics._queue_probe = self._queue_gauges
             self._tokens_delivered = 0
         for rep in self._replicas:
             if rep.state == HEALTHY:
@@ -1943,6 +2134,8 @@ class ProcessFleet:
         out["tokens_delivered"] = self.tokens_delivered()
         out["engines"] = {name: s["metrics"]
                           for name, s in stats.items()}
+        if self.slo is not None:
+            out["slo"] = self.slo.status()
         return out
 
     def assert_compile_count(self, prefill: Optional[int] = None,

@@ -31,7 +31,17 @@ recorded:
   by the front door's ``GET /metrics``;
 - :mod:`crashdump` — the black box: on replica death/stall the
   dispatcher dumps the corpse's last-known step ring plus the affected
-  requests' spans to a post-mortem JSON file.
+  requests' spans (and the last pool-pressure snapshot) to a bounded
+  post-mortem JSON file;
+- :mod:`slo`      — the judgment layer: declarative objectives (TTFT
+  p99, ITL p99, error/shed rate) evaluated as SRE-style multi-window
+  burn rates with per-pool attribution, typed
+  ``slo_breach``/``slo_recovered`` lifecycle events;
+- :mod:`signals`  — the pool-pressure signal plane: EWMA-smoothed
+  per-pool gauges sampled on the dispatcher thread, plus the
+  OBSERVE-ONLY ``PoolRebalancePlanner`` emitting typed
+  ``rebalance_recommended`` events — the contract the elastic-sizing
+  autoscaler will actuate.
 
 The hard guarantee, engine-wide: **observation is inert**. Tracing on
 is token-BIT-identical to tracing off (greedy and sampled, all
@@ -45,12 +55,22 @@ from quintnet_tpu.obs.crashdump import load_crash_dump, write_crash_dump
 from quintnet_tpu.obs.events import EVENT_KINDS, EventLog
 from quintnet_tpu.obs.prom import parse_exposition, render_exposition
 from quintnet_tpu.obs.recorder import StepRecord, StepRecorder
+from quintnet_tpu.obs.signals import (SIGNALS, Ewma,
+                                      PoolRebalancePlanner, SignalBus)
+from quintnet_tpu.obs.slo import Objective, SLOConfig, SLOEngine
 from quintnet_tpu.obs.trace import SPAN_NAMES, Span, Tracer
 
 __all__ = [
     "EVENT_KINDS",
     "EventLog",
+    "Ewma",
+    "Objective",
+    "PoolRebalancePlanner",
+    "SIGNALS",
+    "SLOConfig",
+    "SLOEngine",
     "SPAN_NAMES",
+    "SignalBus",
     "Span",
     "StepRecord",
     "StepRecorder",

@@ -39,7 +39,9 @@ def write_crash_dump(dir_path: str, *, replica: str, reason: str,
                      traces: Optional[Dict[str, List[Dict]]] = None,
                      events: Optional[List[Dict]] = None,
                      requests: Optional[List[Dict]] = None,
-                     extra: Optional[Dict] = None) -> str:
+                     signals: Optional[Dict] = None,
+                     extra: Optional[Dict] = None,
+                     keep: Optional[int] = 16) -> str:
     """Write one post-mortem file; returns its path.
 
     ``reason`` is ``"death"`` or ``"stall"``; ``ring`` the replica's
@@ -47,7 +49,19 @@ def write_crash_dump(dir_path: str, *, replica: str, reason: str,
     requests' span snapshot (``Tracer.snapshot``); ``events`` the
     recent fleet lifecycle events; ``requests`` per-request summaries
     (fid, trace id, tokens committed, migrations) the dispatcher's
-    journal knows without any cooperation from the corpse."""
+    journal knows without any cooperation from the corpse; ``signals``
+    the dispatcher's last pool-pressure snapshot
+    (``SignalBus.snapshot()``) when the signal plane is armed.
+
+    ``keep`` bounds the directory: after writing, only the newest
+    ``keep`` ``crash_*.json`` files survive (a flapping replica must
+    not grow the crash dir without limit); ``keep=None`` disables
+    pruning."""
+    if keep is not None and int(keep) < 1:
+        # reject BEFORE writing: raising after the dump landed would
+        # leave the directory growing un-pruned on every crash — the
+        # exact condition the bound exists to prevent
+        raise ValueError(f"keep must be >= 1 or None, got {keep}")
     os.makedirs(dir_path, exist_ok=True)
     with _seq_lock:
         n = next(_seq)
@@ -65,13 +79,45 @@ def write_crash_dump(dir_path: str, *, replica: str, reason: str,
         "traces": {k: list(v) for k, v in (traces or {}).items()},
         "events": list(events or []),
         "requests": list(requests or []),
+        "signals": dict(signals or {}),
         "extra": dict(extra or {}),
     }
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1)
     os.replace(tmp, path)      # atomic: a reader never sees half a dump
+    if keep is not None:
+        _prune(dir_path, int(keep))
     return path
+
+
+def _prune(dir_path: str, keep: int) -> None:
+    """Keep the newest ``keep`` dump files (mtime order, name as the
+    tiebreak — the stamp+seq suffix is monotone within a process).
+    Concurrent writers racing a prune just lose already-deleted files,
+    which is fine — pruning is best-effort housekeeping. ``keep`` is
+    validated by the caller before the dump is written."""
+    try:
+        names = [n for n in os.listdir(dir_path)
+                 if n.startswith("crash_") and n.endswith(".json")]
+    except OSError:
+        return
+    if len(names) <= keep:
+        return
+
+    def _key(name: str):
+        try:
+            mtime = os.path.getmtime(os.path.join(dir_path, name))
+        except OSError:
+            mtime = 0.0
+        return (mtime, name)
+
+    names.sort(key=_key)
+    for name in names[:len(names) - keep]:
+        try:
+            os.remove(os.path.join(dir_path, name))
+        except OSError:
+            pass
 
 
 def load_crash_dump(path: str) -> Dict:

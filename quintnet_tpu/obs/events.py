@@ -45,6 +45,13 @@ EVENT_KINDS = frozenset({
     #                         local re-prefill serves instead
     "pool_degraded",        # a pool lost its last live replica
     "pool_recovered",       # a down pool is serving again
+    # SLO engine + rebalance planner (obs/slo.py, obs/signals.py)
+    "slo_breach",           # fast+slow burn windows both tripped
+    #                         (attrs: objective, pool, burn_fast/slow)
+    "slo_recovered",        # the fast window dropped back under the
+    #                         threshold for a breaching objective
+    "rebalance_recommended",  # observe-only planner output (attrs:
+    #                           direction, reason, burn — NO actuation)
 })
 
 

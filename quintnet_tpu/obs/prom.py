@@ -36,6 +36,7 @@ by actual parsing, not a regex squint.
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -60,7 +61,12 @@ def _metric_name(prefix: str, key: str) -> str:
 
 
 def _esc(v) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    """Label-value escaping per the text format: backslash first (or
+    it would re-escape the others), then quote and newline — a label
+    value with any of the three still renders as ONE well-formed
+    line."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _fmt_labels(labels: Optional[Dict[str, str]]) -> str:
@@ -88,6 +94,11 @@ class _Builder:
 
     def add(self, name: str, value, *, labels=None,
             mtype: str = "gauge", help_: str = "") -> None:
+        if not math.isfinite(float(value)):
+            # never serve NaN/Inf: Prometheus stores NaN as a real
+            # sample and it poisons every rate()/avg() downstream —
+            # an absent sample is honest, a non-finite one is a trap
+            return
         if name not in self._meta:
             self._order.append(name)
             self._meta[name] = (mtype, help_)
@@ -136,14 +147,59 @@ def _add_summary(b: _Builder, prefix: str, summary: Dict,
         # strings / nested non-percentile dicts: not exposition material
 
 
+def _add_slo(b: _Builder, status: Dict) -> None:
+    """The SLO engine's judgment (obs/slo.py ``status()``) as the
+    ``quintnet_slo_*`` families: per-objective burn rates (fast/slow
+    window label), the breach bit, target, and breach counter — all
+    labeled with the objective's pool attribution so a dashboard can
+    say WHICH pool is burning budget."""
+    for name, st in sorted(status.get("objectives", {}).items()):
+        labels = {"objective": name, "pool": st.get("pool", "any")}
+        for window in ("fast", "slow"):
+            b.add("quintnet_slo_burn_rate", st[f"burn_{window}"],
+                  labels=dict(labels, window=window),
+                  help_="error-budget spend speed over the window "
+                        "(1.0 = exactly on budget)")
+        b.add("quintnet_slo_breaching", 1 if st["breaching"] else 0,
+              labels=labels,
+              help_="1 while fast+slow burn windows are both tripped")
+        b.add("quintnet_slo_target", st["target"], labels=labels)
+        b.add("quintnet_slo_burn_threshold", st["burn_threshold"],
+              labels=labels)
+        b.add("quintnet_slo_breaches_total", st["breaches_total"],
+              labels=labels, mtype="counter",
+              help_="breach lifecycle events since start")
+
+
+def _add_pressure(b: _Builder, gauges: Dict[str, Dict[str, Dict]]
+                  ) -> None:
+    """The signal bus (obs/signals.py ``gauges()``) as
+    ``quintnet_pool_pressure_*`` families: one family per signal,
+    labeled by pool, EWMA-smoothed value (the raw last sample rides a
+    ``stat="last"`` twin)."""
+    for name, pools in sorted(gauges.items()):
+        metric = _metric_name("quintnet_pool_pressure", name)
+        for pool, g in sorted(pools.items()):
+            b.add(metric, g["ewma"],
+                  labels={"pool": pool, "stat": "ewma"},
+                  help_="dispatcher-sampled pool pressure signal "
+                        "(obs/signals.py)")
+            b.add(metric, g["last"], labels={"pool": pool,
+                                             "stat": "last"})
+
+
 def render_exposition(frontdoor_summary: Dict,
                       engine_summaries: Optional[Dict[str, Dict]] = None,
-                      *, health: Optional[Dict] = None) -> str:
+                      *, health: Optional[Dict] = None,
+                      slo: Optional[Dict] = None,
+                      pressure: Optional[Dict] = None) -> str:
     """The front door's ``GET /metrics`` body: fleet counters as
     ``quintnet_fleet_*``, each replica engine's summary as
-    ``quintnet_engine_*{replica="<name>"}``, and (when ``health`` is
-    given) per-replica liveness as ``quintnet_replica_up`` plus queue
-    depth gauges."""
+    ``quintnet_engine_*{replica="<name>"}``, (when ``health`` is
+    given) per-replica liveness/heartbeat/breaker gauges plus queue
+    depth, (when ``slo`` is given) the ``quintnet_slo_*`` burn-rate
+    families, and (when ``pressure`` is given) the
+    ``quintnet_pool_pressure_*`` signal-bus gauges."""
     b = _Builder()
     _add_summary(b, "quintnet_fleet", frontdoor_summary)
     for name, summary in sorted((engine_summaries or {}).items()):
@@ -155,9 +211,34 @@ def render_exposition(frontdoor_summary: Dict,
                   1 if r.get("state") == "healthy" else 0,
                   labels={"replica": name},
                   help_="1 while the replica is a dispatch candidate")
-        for key in ("queue_depth", "open_requests"):
-            if key in health:
+            # heartbeat staleness + breaker state were in health()
+            # but invisible to a scraper until now: the staleness
+            # gauge is the stall-detector's own input, the breaker a
+            # one-hot state family (the Prometheus enum idiom)
+            if "heartbeat_age_s" in r:
+                b.add("quintnet_replica_heartbeat_age_s",
+                      r["heartbeat_age_s"], labels={"replica": name},
+                      help_="seconds since the replica's last "
+                            "heartbeat (stall budget input)")
+            if r.get("breaker"):
+                for state in ("closed", "open", "half_open"):
+                    b.add("quintnet_replica_breaker_state",
+                          1 if r["breaker"] == state else 0,
+                          labels={"replica": name, "state": state},
+                          help_="circuit-breaker state, one-hot")
+        for key in ("queue_depth", "open_requests",
+                    "queue_oldest_wait_s"):
+            # summary() carries the queue gauges since the signal
+            # plane landed — only fall back to health() for fleets
+            # whose summary lacks them, never emit the same series
+            # twice (a duplicate name+labels line is off the format
+            # and a real scraper rejects the whole body)
+            if key in health and key not in (frontdoor_summary or {}):
                 b.add(_metric_name("quintnet_fleet", key), health[key])
+    if slo:
+        _add_slo(b, slo)
+    if pressure:
+        _add_pressure(b, pressure)
     return b.render()
 
 
@@ -167,13 +248,32 @@ _SAMPLE_RE = re.compile(
     r"\s+(?P<value>[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|\d*\.\d+"
     r"(?:[eE][-+]?\d+)?|[Nn]a[Nn]|[-+]?[Ii]nf))\s*$")
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESC_RE = re.compile(r"\\(.)")
+
+
+def _unesc(raw: str, lineno: int) -> str:
+    """Undo label-value escaping (the exact inverse of :func:`_esc`).
+    An escape sequence outside the format's vocabulary (``\\\\``,
+    ``\\"``, ``\\n``) is rejected — a renderer that emits one is off
+    the format, and this parser is the CI gate that says so."""
+    def sub(m):
+        c = m.group(1)
+        if c == "n":
+            return "\n"
+        if c in ('"', "\\"):
+            return c
+        raise ValueError(
+            f"line {lineno}: invalid escape \\{c} in label value")
+    return _UNESC_RE.sub(sub, raw)
 
 
 def parse_exposition(text: str) -> Dict[Tuple[str, Tuple], float]:
     """Strict parser of the text exposition format. Returns
-    ``{(name, ((label, value), ...)): float}``; raises ValueError on
-    any line that is neither a comment, blank, nor a well-formed
-    sample — the test-side proof that what /metrics serves IS the
+    ``{(name, ((label, value), ...)): float}`` with label values
+    UNescaped; raises ValueError on any line that is neither a
+    comment, blank, nor a well-formed sample — and on non-finite
+    sample values and malformed escapes, which the renderer never
+    emits — the test-side proof that what /metrics serves IS the
     format, not something shaped like it."""
     out: Dict[Tuple[str, Tuple], float] = {}
     typed: set = set()
@@ -194,10 +294,29 @@ def parse_exposition(text: str) -> Dict[Tuple[str, Tuple], float]:
             raise ValueError(
                 f"line {lineno} is not a valid exposition sample: "
                 f"{line!r}")
+        value = float(m.group("value"))
+        if not math.isfinite(value):
+            # the format itself allows NaN/Inf tokens, but OUR
+            # renderer never emits them (non-finite readings are
+            # dropped at the builder) — an exposition carrying one
+            # means a second, unguarded accounting path leaked in
+            raise ValueError(
+                f"line {lineno}: non-finite sample value "
+                f"{m.group('value')!r} (the renderer drops these; "
+                f"see _Builder.add)")
         labels: Tuple = ()
         if m.group("labels"):
-            labels = tuple(sorted(_LABEL_RE.findall(m.group("labels"))))
-        out[(m.group("name"), labels)] = float(m.group("value"))
+            labels = tuple(sorted(
+                (k, _unesc(v, lineno))
+                for k, v in _LABEL_RE.findall(m.group("labels"))))
+        key = (m.group("name"), labels)
+        if key in out:
+            # one line per unique name+labels is a format requirement;
+            # a duplicate means two accounting paths rendered the same
+            # series and Prometheus would reject the whole scrape
+            raise ValueError(
+                f"line {lineno}: duplicate sample for {key}")
+        out[key] = value
     return out
 
 

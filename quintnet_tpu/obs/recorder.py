@@ -35,7 +35,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 
 @dataclass
@@ -108,6 +108,13 @@ class StepRecorder:
         """The ring as JSON-able dicts, oldest first."""
         with self._lock:
             return [r.to_dict() for r in self._ring]
+
+    def last(self) -> Optional[Dict]:
+        """The freshest step record (or None before the first step) —
+        what the signal plane samples occupancy/KV pressure from
+        without copying the whole ring (obs/signals.py)."""
+        with self._lock:
+            return self._ring[-1].to_dict() if self._ring else None
 
     def drain_new(self, *, max_records: int = 64) -> List[Dict]:
         """Records appended since the last drain (at most

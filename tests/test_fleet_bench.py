@@ -22,6 +22,7 @@ import bench  # noqa: E402
 FLEET_METRIC = "fleet_gpt2_tiny_tokens_per_sec"
 PROC_METRIC = "fleet_proc_gpt2_tiny_tokens_per_sec"
 DISAGG_METRIC = "fleet_disagg_gpt2_tiny_itl_interference"
+SLO_METRIC = "fleet_slo_gpt2_tiny_burst_burn_peak"
 
 
 @pytest.mark.fast
@@ -262,3 +263,111 @@ def test_committed_process_artifact_proves_acceptance_scenario():
         assert ex["shed"] >= 1, policy
         assert 0 < ex["shed_rate"] < 1, policy
         assert ex["ttft_p99_s"] >= ex["ttft_p50_s"] > 0, policy
+
+
+@pytest.mark.fast
+def test_fleet_bench_slo_smoke_cli():
+    """A tiny --slo replay — 1 prefill + 1 decode process vs a
+    2-replica colocated fleet, objectives calibrated off the clean
+    replays, burst replayed under the armed SLO engine + signal bus —
+    runs end-to-end on CPU and emits a well-formed judgment record.
+    Breaches are NOT asserted here (at smoke scale the burst rarely
+    outruns the calibrated targets on a quiet box); the contract under
+    test is the machinery: calibration happened, the engine evaluated
+    without a NaN or a crash, the planner ledger is present, and
+    nothing was lost."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_bench.py"),
+         "--synthetic", "--slo", "--prefill-replicas", "1",
+         "--decode-replicas", "1", "--slots", "4", "--steady", "2",
+         "--steady-gap-s", "0.05", "--burst-prompts", "1",
+         "--burst-prompt-len", "24", "--max-new", "6",
+         "--num-blocks", "64", "--block-size", "8",
+         "--slo-recovery-wait", "2", "--timeout-s", "240"],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == SLO_METRIC
+    assert rec["rc"] == 0 and rec["unit"] == "x"
+    ex = rec["extras"]
+    for k in ("targets", "burn_threshold", "disagg_breached",
+              "disagg_breach_pools", "disagg_burn_fast_peak",
+              "colocated_breached", "colocated_burn_fast_peak",
+              "recommendations", "disagg_baseline_ttft_p99_s",
+              "colocated_baseline_itl_p99_s"):
+        assert k in ex, k
+    # the calibrated contract is real numbers, not NaN at low traffic
+    assert ex["targets"]["ttft_p99_s"] > 0
+    assert ex["targets"]["itl_p99_s"] > 0
+    for peak in (ex["disagg_burn_fast_peak"],
+                 ex["colocated_burn_fast_peak"]):
+        for v in peak.values():
+            assert v == v and v >= 0.0          # NaN-free, bounded below
+    # judged, not perturbed: nothing lost on either side
+    assert ex["finished"] == ex["accepted"]
+    assert ex["colocated_finished"] == ex["colocated_accepted"]
+    assert ex["handoff_fallbacks"] == 0
+
+
+@pytest.mark.fast
+def test_committed_slo_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=SLO_METRIC)
+    assert last is not None
+    assert last["stale"] is True
+    assert last["metric"] == SLO_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_committed_slo_artifact_proves_acceptance_scenario():
+    """artifacts/slo_r17.json documents the judgment-layer acceptance
+    replay (ISSUE 13): one objective set calibrated off the clean
+    replays, then the fleet_r16 interference burst under the armed SLO
+    engine. On the disaggregated side the burst trips the fast+slow
+    TTFT burn windows (both >= threshold — the SRE multi-window gate),
+    the breach event names the PREFILL pool, the observe-only planner
+    recommends converting a decode replica to prefill during the
+    breach and recommends the revert after, and the objective recovers
+    cleanly. The colocated fleet, judged against the SAME contract,
+    burns the ITL budget the disaggregated fleet holds — monolithic
+    prefills stall decode, the DistServe goodput argument as typed
+    events."""
+    recs = json.load(open(os.path.join(REPO, "artifacts",
+                                       "slo_r17.json")))
+    rec = next(r for r in recs if r.get("metric") == SLO_METRIC)
+    ex = rec["extras"]
+    assert rec["rc"] == 0
+    thresh = ex["burn_threshold"]
+    # the burst tripped the disaggregated TTFT objective: fast AND
+    # slow windows at/above threshold (the value is the fast peak)
+    assert rec["value"] >= thresh
+    assert "ttft_p99" in ex["disagg_breached"]
+    for burn in ex["disagg_breach_burns"]:
+        assert burn["burn_fast"] >= thresh
+        assert burn["burn_slow"] >= thresh
+    # attribution: a TTFT breach names the prefill pool
+    assert ex["disagg_breach_pools"]["ttft_p99"] == "prefill"
+    # the breach recovered once the burst drained (fast window clear)
+    assert "ttft_p99" in ex["disagg_recovered"]
+    assert ex["disagg_still_breaching"] == []
+    # the observe-only planner: decode->prefill during the breach,
+    # the revert after recovery — recommendations, no actuation
+    recs_ = ex["recommendations"]
+    assert any(r["direction"] == "decode_to_prefill"
+               and not r["revert"] for r in recs_)
+    assert any(r["revert"] for r in recs_)
+    # the DistServe verdict: judged against the SAME objective set,
+    # the colocated fleet breaches ITL where the disaggregated one
+    # holds (the dedicated decode pool never runs a monolithic
+    # prefill)
+    assert "itl_p99" in ex["colocated_breached"]
+    assert "itl_p99" not in ex["disagg_breached"]
+    assert (ex["colocated_burn_fast_peak"]["itl_p99"] >= thresh)
+    # judged, not perturbed: the replay itself lost nothing
+    assert ex["finished"] == ex["accepted"]
+    assert ex["colocated_finished"] == ex["colocated_accepted"]
+    assert ex["handoffs"] == ex["steady"]
+    assert ex["handoff_fallbacks"] == 0

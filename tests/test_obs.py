@@ -672,6 +672,161 @@ def test_log_once_keyed_by_logger(capsys):
     assert out.count(msg) == 2
 
 
+def test_exposition_label_escaping_round_trips():
+    """Label values carrying the format's three special characters —
+    backslash, double quote, newline — render as ONE well-formed line
+    each and parse back to the ORIGINAL value (backslash first in the
+    escaper, or it would re-escape the others)."""
+    nasty = {
+        "q": 'say "hi"',
+        "b": "back\\slash",
+        "n": "two\nlines",
+        "all": 'a\\b"c\nd',
+    }
+    fm = FleetMetrics()
+    fm.finished = 1
+    text = render_exposition(
+        fm.summary(),
+        {name: {"finished": 1} for name in nasty.values()})
+    for line in text.splitlines():
+        assert "\n" not in line                  # one line per sample
+    parsed = parse_exposition(text)
+    for raw in nasty.values():
+        assert sample(parsed, "quintnet_engine_finished",
+                      replica=raw) == 1.0        # round-tripped exact
+
+
+def test_exposition_parser_rejects_invalid_escape():
+    with pytest.raises(ValueError, match="invalid escape"):
+        parse_exposition('m{l="bad\\t"} 1\n')
+    # the three legal escapes parse
+    parsed = parse_exposition('m{l="a\\\\b\\"c\\nd"} 1\n')
+    assert sample(parsed, "m", l='a\\b"c\nd') == 1.0
+
+
+def test_exposition_drops_non_finite_and_parser_rejects_them():
+    """The renderer NEVER serves NaN/Inf (an absent sample is honest;
+    a NaN poisons every rate() downstream) — and the strict parser
+    treats a non-finite sample in OUR exposition as proof a second,
+    unguarded accounting path leaked in."""
+    fm = FleetMetrics()
+    fm.finished = 3
+    text = render_exposition(
+        fm.summary(),
+        {"r0": {"finished": 2.0, "bad_nan": float("nan"),
+                "bad_inf": float("inf"),
+                "bad_ninf": float("-inf")}})
+    parsed = parse_exposition(text)              # strict gate passes
+    assert sample(parsed, "quintnet_engine_finished", replica="r0") == 2.0
+    for name, _labels in parsed:
+        assert "bad_nan" not in name and "bad_inf" not in name
+    # the format ALLOWS NaN/Inf tokens; our parser rejects each form
+    for tok in ("NaN", "nan", "+Inf", "-Inf", "inf"):
+        with pytest.raises(ValueError, match="non-finite"):
+            parse_exposition(f"leaked_metric {tok}\n")
+
+
+def test_exposition_single_series_per_queue_gauge():
+    """summary() and health() both know the queue gauges since the
+    signal plane landed; the renderer must emit each series ONCE
+    (duplicate name+labels lines are off the format — Prometheus
+    rejects the whole scrape) and the strict parser is the gate that
+    catches a second accounting path leaking in."""
+    fm = FleetMetrics()
+    fm._queue_probe = lambda: (3, 1.5)
+    health = {"replicas": {}, "queue_depth": 4,
+              "queue_oldest_wait_s": 9.9, "open_requests": 2}
+    text = render_exposition(fm.summary(), health=health)
+    parsed = parse_exposition(text)              # raises on duplicates
+    # summary won: one series, the summary's value
+    assert sample(parsed, "quintnet_fleet_queue_depth") == 3.0
+    assert sample(parsed, "quintnet_fleet_queue_oldest_wait_s") == 1.5
+    # keys only health carries still render (the fallback)
+    assert sample(parsed, "quintnet_fleet_open_requests") == 2.0
+    # and the parser really does reject a duplicate series
+    with pytest.raises(ValueError, match="duplicate sample"):
+        parse_exposition("m 1\nm 2\n")
+    parse_exposition('m{a="x"} 1\nm{a="y"} 2\n')  # labels differ: fine
+
+
+def test_crash_dir_bounded_keeps_newest(tmp_path):
+    """A flapping replica must not grow crash_dir without limit: after
+    each write only the newest ``keep`` dumps survive (and keep=None
+    disables pruning)."""
+    paths = []
+    for i in range(7):
+        paths.append(write_crash_dump(
+            str(tmp_path), replica=f"p{i}", reason="death", keep=4))
+        os.utime(paths[-1], (i + 1.0, i + 1.0))  # monotone mtimes
+    names = sorted(os.listdir(tmp_path))
+    assert len(names) == 4
+    kept = {os.path.basename(p) for p in paths[-4:]}
+    assert set(names) == kept
+    # the newest dumps are the ones still loadable
+    for p in paths[-4:]:
+        assert load_crash_dump(p)["replica"] in {"p3", "p4", "p5", "p6"}
+    # keep=None: no pruning
+    for i in range(3):
+        write_crash_dump(str(tmp_path), replica="x", reason="stall",
+                         keep=None)
+    assert len(os.listdir(tmp_path)) == 7
+    # an invalid keep is rejected BEFORE the dump is written — a
+    # post-write raise would leave the dir growing un-pruned forever
+    with pytest.raises(ValueError, match="keep"):
+        write_crash_dump(str(tmp_path), replica="x", reason="stall",
+                         keep=0)
+    assert len(os.listdir(tmp_path)) == 7        # nothing landed
+
+
+def test_trace_view_renders_slo_events_as_global_markers(tmp_path):
+    """slo_breach / slo_recovered / rebalance_recommended lifecycle
+    events become instant markers on the "fleet events" track —
+    SLO-judgment kinds FULL-HEIGHT (scope "g") so they line up against
+    every other track, ordinary kinds thread-local ticks — and the CLI
+    renders a dump whose only payload is events."""
+    from tools.trace_view import chrome_trace, validate_chrome_trace
+    import tools.trace_view as trace_view
+
+    events = [
+        {"ts": 10.0, "seq": 1, "kind": "slo_breach",
+         "objective": "ttft_p99", "pool": "prefill",
+         "burn_fast": 4.2, "burn_slow": 3.0},
+        {"ts": 10.5, "seq": 2, "kind": "rebalance_recommended",
+         "direction": "decode_to_prefill", "revert": False},
+        {"ts": 12.0, "seq": 3, "kind": "slo_recovered",
+         "objective": "ttft_p99", "pool": "prefill"},
+        {"ts": 12.5, "seq": 4, "kind": "rebalance_recommended",
+         "direction": "prefill_to_decode", "revert": True},
+        {"ts": 11.0, "seq": 5, "kind": "replica_death",
+         "replica": "p1"},
+        {"not_an_event": True},                  # skipped, not guessed
+    ]
+    trace = chrome_trace(fleet_events=events)
+    assert validate_chrome_trace(trace) > 0
+    inst = {e["name"]: e for e in trace["traceEvents"]
+            if e["ph"] == "i"}
+    breach = inst["slo_breach ttft_p99 [prefill] 4.2x"]
+    assert breach["s"] == "g"                    # full-height marker
+    assert breach["args"]["burn_fast"] == 4.2
+    assert inst["rebalance decode_to_prefill"]["s"] == "g"
+    assert inst["rebalance prefill_to_decode (revert)"]["s"] == "g"
+    assert inst["slo_recovered"]["s"] == "g"
+    assert inst["replica_death"]["s"] == "t"     # ordinary tick
+    # timestamps re-based to the earliest event (t=10.0 -> 0us)
+    assert breach["ts"] == 0.0
+    assert inst["replica_death"]["ts"] == pytest.approx(1e6)
+    # the CLI path over an events-only dump (crash dumps embed the
+    # ring+traces too; a bare event ring must still render)
+    dump = tmp_path / "events.json"
+    dump.write_text(json.dumps({"events": events}))
+    out = tmp_path / "trace.json"
+    assert trace_view.main([str(dump), "-o", str(out)]) == 0
+    rendered = json.loads(out.read_text())
+    assert validate_chrome_trace(rendered) > 0
+    assert any(e.get("name", "").startswith("slo_breach")
+               for e in rendered["traceEvents"])
+
+
 def test_trace_id_rides_the_wire():
     p = RequestProgress(
         rid=1, prompt=np.arange(3, dtype=np.int32), generated=[7],

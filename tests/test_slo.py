@@ -1,0 +1,779 @@
+"""SLO engine + pool-pressure signal plane goldens (obs/slo.py,
+obs/signals.py + the fleet threading).
+
+The judgment layer's contract, pinned here:
+
+- **burn-rate math**: latency objectives burn at
+  ``frac(obs > target) / (1 - quantile)``, rate objectives at
+  ``mean / target``; empty windows burn 0.0 — zero traffic is
+  compliant, never NaN;
+- **multi-window breach state machine**: a breach requires BOTH the
+  fast and slow window at/over the threshold (a fast-only spike is
+  noise, a slow-only tail is old news); recovery is the FAST window
+  dropping back under — with typed ``slo_breach``/``slo_recovered``
+  events carrying per-pool attribution (TTFT -> prefill, ITL ->
+  decode), all under an injectable clock so no test sleeps;
+- **signal bus**: EWMA smoothing decays on CLOCK time (half-life),
+  history is bounded, ``gauges()``/``snapshot()`` are JSON-able;
+- **planner**: observe-only — recommendations fire only with a
+  one-pool breach + donor headroom, once per direction (hysteresis),
+  past the cooldown, and the recovery path recommends the REVERT;
+  it holds no fleet references and mutates nothing;
+- **inertness** (THE acceptance gate): a fleet with the SLO engine +
+  signal bus armed produces BIT-identical output to one without —
+  sampled, int8 KV, chunked prefill, under a chaos kill — with the
+  compile census unchanged;
+- the satellites: ``AdmissionQueue.oldest_wait_s`` (and its surfacing
+  in ``FleetMetrics.summary()`` + the front door's 429 Retry-After
+  hint), ``/healthz`` degraded-on-breach naming the objectives, and
+  ``GET /metrics`` serving ``quintnet_slo_*`` +
+  ``quintnet_pool_pressure_*`` through the strict-parser gate.
+"""
+
+import json
+import time
+import types
+
+import jax
+import numpy as np
+import pytest
+
+from quintnet_tpu.fleet import FrontDoor, ServeFleet
+from quintnet_tpu.fleet.admission import AdmissionQueue
+from quintnet_tpu.fleet.fleet import FleetMetrics
+from quintnet_tpu.ft.chaos import ChaosMonkey
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.obs import (EventLog, Objective, PoolRebalancePlanner,
+                              SignalBus, SLOConfig, SLOEngine,
+                              parse_exposition, render_exposition)
+from quintnet_tpu.obs.prom import sample
+from quintnet_tpu.obs.signals import Ewma
+from quintnet_tpu.obs.slo import LATENCY, RATE, burn_rate
+from quintnet_tpu.serve import ServeEngine, gpt2_family
+
+CFG = GPT2Config.tiny(n_layer=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _config(**kw):
+    kwargs = dict(fast_window_s=10.0, slow_window_s=60.0,
+                  burn_threshold=2.0)
+    kwargs.update(kw)
+    return SLOConfig.serving(ttft_p99_s=0.5, itl_p99_s=0.1,
+                             error_rate=0.01, shed_rate=0.05, **kwargs)
+
+
+# ---------------------------------------------------------------------
+# objective / config validation
+# ---------------------------------------------------------------------
+
+class TestDeclarations:
+    def test_serving_preset_attribution(self):
+        cfg = _config()
+        by = {o.name: o for o in cfg.objectives}
+        assert by["ttft_p99"].pool == "prefill"     # DistServe axes
+        assert by["itl_p99"].pool == "decode"
+        assert by["error_rate"].pool == "any"
+        assert by["shed_rate"].kind == RATE
+        assert by["ttft_p99"].kind == LATENCY
+        # pass only what you promise
+        one = SLOConfig.serving(ttft_p99_s=1.0)
+        assert [o.name for o in one.objectives] == ["ttft_p99"]
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            Objective("x", stream="s", kind="latencey", target=1.0)
+        with pytest.raises(ValueError, match="target"):
+            Objective("x", stream="s", kind=LATENCY, target=0.0)
+        with pytest.raises(ValueError, match="fraction"):
+            Objective("x", stream="s", kind=RATE, target=1.5)
+        with pytest.raises(ValueError, match="quantile"):
+            Objective("x", stream="s", kind=LATENCY, target=1.0,
+                      quantile=1.0)
+        with pytest.raises(ValueError, match="burn_threshold"):
+            Objective("x", stream="s", kind=LATENCY, target=1.0,
+                      burn_threshold=-1.0)
+
+    def test_config_validation(self):
+        ok = Objective("x", stream="s", kind=LATENCY, target=1.0)
+        with pytest.raises(ValueError, match="at least one"):
+            SLOConfig(objectives=())
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOConfig(objectives=(ok, ok))
+        with pytest.raises(ValueError, match="fast_window_s"):
+            SLOConfig(objectives=(ok,), fast_window_s=60.0,
+                      slow_window_s=60.0)
+        with pytest.raises(ValueError, match="max_samples"):
+            SLOConfig(objectives=(ok,), max_samples=2)
+
+
+# ---------------------------------------------------------------------
+# burn-rate math
+# ---------------------------------------------------------------------
+
+class TestBurnRate:
+    def test_latency_burn_is_bad_fraction_over_budget(self):
+        o = Objective("ttft", stream="ttft", kind=LATENCY, target=1.0,
+                      quantile=0.99)
+        # 2 of 10 over target: frac 0.2 against a 0.01 budget = 20x
+        vals = [0.5] * 8 + [2.0, 3.0]
+        assert burn_rate(o, vals) == pytest.approx(20.0)
+        # exactly at target is NOT a violation (promise is <=)
+        assert burn_rate(o, [1.0] * 10) == 0.0
+        # all good burns 0, all bad burns 1/(1-q)
+        assert burn_rate(o, [0.1]) == 0.0
+        assert burn_rate(o, [9.0]) == pytest.approx(100.0)
+
+    def test_rate_burn_is_mean_over_target(self):
+        o = Objective("err", stream="error", kind=RATE, target=0.01)
+        assert burn_rate(o, [0.0] * 99 + [1.0]) == pytest.approx(1.0)
+        assert burn_rate(o, [1.0, 0.0, 0.0, 0.0]) == pytest.approx(25.0)
+        assert burn_rate(o, [0.0] * 10) == 0.0
+
+    def test_empty_window_burns_zero_never_nan(self):
+        for o in _config().objectives:
+            b = burn_rate(o, [])
+            assert b == 0.0 and np.isfinite(b)
+
+
+# ---------------------------------------------------------------------
+# the multi-window breach state machine (injectable clock, no sleeps)
+# ---------------------------------------------------------------------
+
+class TestBreachStateMachine:
+    def _engine(self, **kw):
+        clk = _Clock()
+        log = EventLog(clock=clk)
+        eng = SLOEngine(_config(**kw), clock=clk, events=log)
+        return eng, clk, log
+
+    def test_fast_spike_alone_is_not_a_breach(self):
+        eng, clk, log = self._engine()
+        # old good traffic fills the slow window; then a fresh spike
+        for _ in range(50):
+            eng.observe("ttft", 0.1)
+            eng.observe("ttft", 0.1)
+            clk.tick(1.0)
+        eng.observe("ttft", 5.0)                 # one fresh bad obs
+        st = eng.evaluate()
+        ttft = st["objectives"]["ttft_p99"]
+        assert ttft["burn_fast"] >= 2.0          # fast window IS hot
+        assert ttft["burn_slow"] < 2.0           # slow window is not
+        assert not ttft["breaching"]
+        assert log.snapshot(kind="slo_breach") == []
+
+    def test_breach_needs_both_windows_and_recovery_is_fast_window(self):
+        eng, clk, log = self._engine()
+        # sustained bad traffic: both windows burn -> breach edge
+        for _ in range(20):
+            eng.observe("ttft", 5.0)
+            clk.tick(0.2)
+        st = eng.evaluate()
+        ttft = st["objectives"]["ttft_p99"]
+        assert ttft["breaching"]
+        assert ttft["burn_fast"] >= 2.0 and ttft["burn_slow"] >= 2.0
+        assert st["breaching"] == ["ttft_p99"]
+        breaches = log.snapshot(kind="slo_breach")
+        assert len(breaches) == 1                # ONE edge, no re-spam
+        assert breaches[0]["objective"] == "ttft_p99"
+        assert breaches[0]["pool"] == "prefill"  # attribution
+        assert breaches[0]["burn_fast"] >= 2.0
+        assert breaches[0]["burn_slow"] >= 2.0
+
+        # still breaching while the fast window holds the bad samples
+        assert eng.evaluate()["objectives"]["ttft_p99"]["breaching"]
+        assert len(log.snapshot(kind="slo_breach")) == 1
+
+        # slide PAST the fast window: fast empties (burns 0) while the
+        # slow window still remembers -> recovery, attributed the same
+        clk.tick(11.0)
+        st = eng.evaluate()
+        ttft = st["objectives"]["ttft_p99"]
+        assert not ttft["breaching"]
+        assert ttft["burn_fast"] == 0.0
+        assert ttft["burn_slow"] >= 2.0          # memory, not judgment
+        rec = log.snapshot(kind="slo_recovered")
+        assert len(rec) == 1 and rec[0]["pool"] == "prefill"
+        assert ttft["breaches_total"] == 1
+        # peak fast burn survives recovery (the bench reports it)
+        assert ttft["burn_fast_peak"] >= 2.0
+
+    def test_itl_breach_names_the_decode_pool(self):
+        eng, clk, log = self._engine()
+        for _ in range(20):
+            eng.observe("itl", 1.0)
+            clk.tick(0.2)
+        eng.evaluate()
+        b = log.snapshot(kind="slo_breach")
+        assert [e["pool"] for e in b] == ["decode"]
+
+    def test_rate_objective_breach_and_per_objective_threshold(self):
+        clk = _Clock()
+        cfg = SLOConfig(objectives=(
+            Objective("shed_rate", stream="shed", kind=RATE,
+                      target=0.05, burn_threshold=4.0),),
+            fast_window_s=10.0, slow_window_s=60.0, burn_threshold=2.0)
+        eng = SLOEngine(cfg, clock=clk)
+        # mean 0.5 against target 0.05 = 10x: over the 4.0 override
+        for v in [1.0, 0.0] * 10:
+            eng.observe("shed", v)
+            clk.tick(0.3)
+        st = eng.evaluate()["objectives"]["shed_rate"]
+        assert st["burn_threshold"] == 4.0
+        assert st["breaching"]
+
+    def test_zero_traffic_is_compliant_and_nan_free(self):
+        eng, clk, _log = self._engine()
+        for _ in range(3):
+            clk.tick(100.0)
+            st = eng.evaluate()
+            assert st["breaching"] == []
+            for o in st["objectives"].values():
+                assert o["burn_fast"] == 0.0 and o["burn_slow"] == 0.0
+                assert np.isfinite(o["burn_fast"])
+        json.dumps(st)                           # JSON-able as-is
+
+    def test_unbound_stream_ignored_and_memory_bounded(self):
+        eng, clk, _log = self._engine(max_samples=16)
+        eng.observe("no_such_stream", 1.0)       # no objective binds it
+        for _ in range(1000):
+            eng.observe("ttft", 0.1)
+        st = eng.evaluate()
+        assert st["objectives"]["ttft_p99"]["n_slow"] <= 16
+        assert clk.t == 0.0
+
+
+# ---------------------------------------------------------------------
+# signal plane primitives
+# ---------------------------------------------------------------------
+
+class TestSignalBus:
+    def test_ewma_halflife_decays_on_clock_time(self):
+        e = Ewma(halflife_s=2.0)
+        assert e.update(0.0, 10.0) == 10.0       # first sample seeds
+        # one half-life later the old value keeps HALF its weight
+        assert e.update(2.0, 0.0) == pytest.approx(5.0)
+        # zero elapsed clock = zero decay: the new sample has no weight
+        assert e.update(2.0, 100.0) == pytest.approx(5.0)
+        with pytest.raises(ValueError, match="halflife_s"):
+            Ewma(halflife_s=0.0)
+
+    def test_bus_smoothing_history_and_pools(self):
+        clk = _Clock()
+        bus = SignalBus(clock=clk, halflife_s=1.0, history=4)
+        assert bus.value("occupancy") is None    # never invents
+        bus.sample("occupancy", 1.0, pool="prefill")
+        clk.tick(1.0)
+        bus.sample("occupancy", 0.0, pool="prefill")
+        assert bus.value("occupancy", "prefill") == pytest.approx(0.5)
+        assert bus.value("occupancy", "prefill",
+                         smoothed=False) == 0.0
+        # pools are independent series
+        bus.sample("occupancy", 0.25, pool="decode")
+        assert bus.value("occupancy", "decode") == 0.25
+        # bounded history
+        for i in range(10):
+            clk.tick(1.0)
+            bus.sample("queue_depth", float(i))
+        assert len(bus.history("queue_depth")) == 4
+        g = bus.gauges()
+        assert g["occupancy"]["prefill"]["n"] == 2
+        assert g["queue_depth"]["fleet"]["last"] == 9.0
+        json.dumps(bus.snapshot())               # crash-dump payload
+
+    def test_bus_validation(self):
+        with pytest.raises(ValueError, match="history"):
+            SignalBus(history=0)
+
+
+class TestPlanner:
+    def _setup(self, *, occupancy=0.2, cooldown_s=5.0, **kw):
+        clk = _Clock(100.0)
+        log = EventLog(clock=clk)
+        bus = SignalBus(clock=clk)
+        bus.sample("occupancy", occupancy, pool="decode")
+        bus.sample("occupancy", 0.9, pool="prefill")
+        planner = PoolRebalancePlanner(clock=clk, events=log,
+                                       cooldown_s=cooldown_s, **kw)
+        return planner, clk, log, bus
+
+    @staticmethod
+    def _status(breach=(), fast_window=60.0):
+        objectives = {
+            "ttft_p99": {"pool": "prefill", "breaching":
+                         "ttft_p99" in breach, "burn_fast": 4.2,
+                         "burn_slow": 3.0},
+            "itl_p99": {"pool": "decode", "breaching":
+                        "itl_p99" in breach, "burn_fast": 2.5,
+                        "burn_slow": 2.1},
+        }
+        return {"objectives": objectives,
+                "breaching": sorted(breach),
+                "fast_window_s": fast_window}
+
+    def test_prefill_breach_recommends_decode_to_prefill(self):
+        planner, _clk, log, bus = self._setup(occupancy=0.2)
+        rec = planner.plan(self._status(breach=("ttft_p99",)), bus)
+        assert rec is not None and not rec["revert"]
+        assert rec["direction"] == "decode_to_prefill"
+        assert rec["from_pool"] == "decode" and rec["to_pool"] == "prefill"
+        assert rec["objective"] == "ttft_p99"
+        assert rec["burn_fast"] == 4.2
+        assert rec["donor_occupancy"] == pytest.approx(0.2)
+        # the reason reads like the issue's example: direction, burn,
+        # donor headroom, duration hint
+        assert "decode replica to prefill" in rec["reason"]
+        assert "4.2x" in rec["reason"]
+        ev = log.snapshot(kind="rebalance_recommended")
+        assert len(ev) == 1 and ev[0]["direction"] == "decode_to_prefill"
+        assert planner.outstanding == "decode_to_prefill"
+
+    def test_hysteresis_one_outstanding_direction(self):
+        planner, clk, log, bus = self._setup(cooldown_s=0.0)
+        st = self._status(breach=("ttft_p99",))
+        assert planner.plan(st, bus) is not None
+        for _ in range(5):                       # sustained breach
+            clk.tick(10.0)
+            assert planner.plan(st, bus) is None
+        assert len(log.snapshot(kind="rebalance_recommended")) == 1
+
+    def test_no_recommendation_without_donor_headroom(self):
+        planner, _clk, log, bus = self._setup(occupancy=0.9)
+        assert planner.plan(self._status(breach=("ttft_p99",)),
+                            bus) is None
+        # an unsampled donor gauge is also NOT headroom
+        planner2, _c, _l, _b = self._setup()
+        empty = SignalBus()
+        assert planner2.plan(self._status(breach=("ttft_p99",)),
+                             empty) is None
+        assert log.snapshot(kind="rebalance_recommended") == []
+
+    def test_both_pools_breaching_recommends_nothing(self):
+        planner, _clk, _log, bus = self._setup(occupancy=0.1)
+        assert planner.plan(
+            self._status(breach=("ttft_p99", "itl_p99")), bus) is None
+
+    def test_decode_breach_recommends_prefill_to_decode(self):
+        planner, clk, _log, bus = self._setup()
+        clk.tick(20.0)          # let the busy-prefill EWMA decay out
+        bus.sample("occupancy", 0.1, pool="prefill")
+        rec = planner.plan(self._status(breach=("itl_p99",)), bus)
+        assert rec["direction"] == "prefill_to_decode"
+        assert rec["objective"] == "itl_p99"
+
+    def test_cooldown_gates_the_next_recommendation(self):
+        planner, clk, _log, bus = self._setup(cooldown_s=5.0)
+        assert planner.plan(self._status(breach=("ttft_p99",)),
+                            bus) is not None
+        clk.tick(1.0)                            # breach recovered fast
+        assert planner.plan(self._status(), bus) is None  # cooling
+        clk.tick(5.0)
+        rec = planner.plan(self._status(), bus)  # now the revert fires
+        assert rec["revert"] is True
+
+    def test_recovery_recommends_the_revert_exactly_once(self):
+        planner, clk, log, bus = self._setup(cooldown_s=0.0)
+        planner.plan(self._status(breach=("ttft_p99",)), bus)
+        clk.tick(1.0)
+        rec = planner.plan(self._status(), bus)
+        assert rec["revert"] is True
+        assert rec["direction"] == "prefill_to_decode"  # put it back
+        assert rec["objective"] is None
+        assert "revert" in rec["reason"]
+        assert planner.outstanding is None
+        # nothing outstanding -> quiet from here on
+        clk.tick(1.0)
+        assert planner.plan(self._status(), bus) is None
+        ev = log.snapshot(kind="rebalance_recommended")
+        assert [e["revert"] for e in ev] == [False, True]
+        # bounded ledger
+        assert len(planner.recommendations) == 2
+        json.dumps(list(planner.recommendations))
+
+    def test_opposite_direction_nets_out_no_double_revert(self):
+        """A conversion in force, then the OTHER pool breaches before
+        recovery: the reverse recommendation nets the ledger back to
+        baseline — no separate revert follows once both pools clear
+        (otherwise a replaying autoscaler ends lopsided)."""
+        planner, clk, log, bus = self._setup(cooldown_s=0.0,
+                                             occupancy=0.2)
+        planner.plan(self._status(breach=("ttft_p99",)), bus)
+        assert planner.outstanding == "decode_to_prefill"
+        clk.tick(20.0)          # let the busy-prefill EWMA decay out
+        bus.sample("occupancy", 0.1, pool="prefill")
+        rec = planner.plan(self._status(breach=("itl_p99",)), bus)
+        assert rec is not None and rec["revert"] is False
+        assert rec["direction"] == "prefill_to_decode"
+        assert planner.outstanding is None       # netted to baseline
+        clk.tick(20.0)
+        assert planner.plan(self._status(), bus) is None  # no revert
+        dirs = [(e["direction"], e["revert"])
+                for e in log.snapshot(kind="rebalance_recommended")]
+        assert dirs == [("decode_to_prefill", False),
+                        ("prefill_to_decode", False)]
+
+    def test_planner_validation(self):
+        with pytest.raises(ValueError, match="cooldown_s"):
+            PoolRebalancePlanner(cooldown_s=-1.0)
+        with pytest.raises(ValueError, match="donor_occupancy_below"):
+            PoolRebalancePlanner(donor_occupancy_below=0.0)
+
+
+# ---------------------------------------------------------------------
+# satellites: queue wait age -> summary() + Retry-After
+# ---------------------------------------------------------------------
+
+class TestQueueWaitAge:
+    def test_oldest_wait_scans_past_push_front(self):
+        clk = _Clock(10.0)
+        q = AdmissionQueue(8, clock=clk)
+        assert q.oldest_wait_s() == 0.0          # empty -> 0, not NaN
+        q.push(types.SimpleNamespace(submit_time=10.0, deadline=None))
+        clk.tick(5.0)
+        q.push(types.SimpleNamespace(submit_time=15.0, deadline=None))
+        assert q.oldest_wait_s() == pytest.approx(5.0)
+        # a migration re-queue can put YOUNGER work at the head — the
+        # age scans submit_time, it does not trust FIFO order
+        q.push_front([types.SimpleNamespace(submit_time=14.0,
+                                            deadline=None)])
+        assert q.oldest_wait_s() == pytest.approx(5.0)
+
+    def test_fleet_metrics_summary_carries_queue_gauges(self):
+        fm = FleetMetrics()
+        s = fm.summary()                         # probe-less: zeros
+        assert s["queue_depth"] == 0
+        assert s["queue_oldest_wait_s"] == 0.0
+        fm._queue_probe = lambda: (3, 1.25)
+        s = fm.summary()
+        assert s["queue_depth"] == 3
+        assert s["queue_oldest_wait_s"] == 1.25
+
+    def test_retry_after_raised_to_oldest_wait(self):
+        fleet = types.SimpleNamespace(
+            queue_oldest_wait_s=lambda: 7.3)
+        fd = FrontDoor(fleet, retry_after_s=1.0)
+        assert fd._retry_after() == "8"          # ceil(7.3) > floor
+        fleet.queue_oldest_wait_s = lambda: 0.0
+        assert fd._retry_after() == "1"          # floor holds
+        # fleets without the probe keep the configured floor
+        fd2 = FrontDoor(types.SimpleNamespace(), retry_after_s=2.0)
+        assert fd2._retry_after() == "2"
+
+
+# ---------------------------------------------------------------------
+# Prometheus families through the strict-parser gate
+# ---------------------------------------------------------------------
+
+class TestExposition:
+    def test_slo_and_pressure_families_parse_strict(self):
+        clk = _Clock()
+        eng = SLOEngine(_config(), clock=clk)
+        for _ in range(20):
+            eng.observe("ttft", 5.0)
+            clk.tick(0.2)
+        bus = SignalBus(clock=clk)
+        bus.sample("queue_depth", 3.0)
+        bus.sample("occupancy", 0.5, pool="decode")
+        text = render_exposition(FleetMetrics().summary(),
+                                 slo=eng.evaluate(),
+                                 pressure=bus.gauges())
+        parsed = parse_exposition(text)
+        assert sample(parsed, "quintnet_slo_burn_rate",
+                      objective="ttft_p99", pool="prefill",
+                      window="fast") >= 2.0
+        assert sample(parsed, "quintnet_slo_breaching",
+                      objective="ttft_p99", pool="prefill") == 1.0
+        assert sample(parsed, "quintnet_slo_breaching",
+                      objective="itl_p99", pool="decode") == 0.0
+        assert sample(parsed, "quintnet_slo_target",
+                      objective="ttft_p99", pool="prefill") == 0.5
+        assert sample(parsed, "quintnet_slo_breaches_total",
+                      objective="ttft_p99", pool="prefill") == 1.0
+        assert sample(parsed, "quintnet_pool_pressure_queue_depth",
+                      pool="fleet", stat="ewma") == 3.0
+        assert sample(parsed, "quintnet_pool_pressure_occupancy",
+                      pool="decode", stat="last") == 0.5
+
+    def test_heartbeat_and_breaker_gauges(self):
+        """The invisible-today satellite: HeartbeatMonitor.age_s and
+        breaker state render as per-replica gauges (breaker one-hot,
+        the Prometheus enum idiom)."""
+        health = {"replicas": {
+            "p0": {"state": "healthy", "heartbeat_age_s": 0.04,
+                   "breaker": "closed"},
+            "p1": {"state": "dead", "heartbeat_age_s": 9.5,
+                   "breaker": "open"},
+        }, "queue_depth": 2, "queue_oldest_wait_s": 1.5,
+            "open_requests": 1}
+        parsed = parse_exposition(render_exposition(
+            FleetMetrics().summary(), health=health))
+        assert sample(parsed, "quintnet_replica_heartbeat_age_s",
+                      replica="p0") == 0.04
+        assert sample(parsed, "quintnet_replica_heartbeat_age_s",
+                      replica="p1") == 9.5
+        assert sample(parsed, "quintnet_replica_breaker_state",
+                      replica="p0", state="closed") == 1.0
+        assert sample(parsed, "quintnet_replica_breaker_state",
+                      replica="p0", state="open") == 0.0
+        assert sample(parsed, "quintnet_replica_breaker_state",
+                      replica="p1", state="open") == 1.0
+        assert sample(parsed, "quintnet_replica_breaker_state",
+                      replica="p1", state="half_open") == 0.0
+        # the queue gauges render from summary() (single series; the
+        # health copy is only a fallback for summaries without them)
+        fm = FleetMetrics()
+        fm._queue_probe = lambda: (2, 1.5)
+        parsed = parse_exposition(render_exposition(
+            fm.summary(), health=health))
+        assert sample(parsed,
+                      "quintnet_fleet_queue_oldest_wait_s") == 1.5
+
+
+# ---------------------------------------------------------------------
+# the armed thread fleet: observation, surfaces, inertness
+# ---------------------------------------------------------------------
+
+def _factory(params, **kw):
+    kwargs = dict(max_slots=2, block_size=4, num_blocks=24,
+                  max_seq_len=40)
+    kwargs.update(kw)
+
+    def factory():
+        return ServeEngine(gpt2_family(CFG), params, **kwargs)
+
+    return factory
+
+
+def _wait_until(pred, *, timeout=60.0, msg=""):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for: {msg}")
+        time.sleep(0.01)
+
+
+class TestArmedFleet:
+    def test_fleet_observes_and_samples(self, params, rng):
+        """A thread fleet with ``slo=`` at the constructor: TTFT/ITL
+        observed at token delivery, shed/error at the edges, the bus
+        sampled on the dispatcher thread, and ``summary()`` carries
+        the judgment."""
+        cfg = SLOConfig.serving(ttft_p99_s=60.0, itl_p99_s=60.0,
+                                error_rate=0.5, shed_rate=0.5,
+                                eval_interval_s=0.01)
+        fleet = ServeFleet(_factory(params), n_replicas=2, slo=cfg)
+        try:
+            assert fleet.slo is not None and fleet.signals is not None
+            assert fleet.planner is None         # no pools to move
+            prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                                  np.int32) for _ in range(3)]
+            fids = [fleet.submit(p, 8) for p in prompts]
+            [fleet.result(f, timeout=300) for f in fids]
+            st = fleet.slo.status()
+            obj = st["objectives"]
+            assert obj["ttft_p99"]["n_slow"] == 3    # one per request
+            # per request: token 1 anchors (ttft), tokens 2..8 are gaps
+            assert obj["itl_p99"]["n_slow"] == 3 * (8 - 1)
+            assert obj["error_rate"]["n_slow"] == 3  # finishes, 0.0
+            assert obj["shed_rate"]["n_slow"] == 3   # accepts, 0.0
+            assert st["breaching"] == []
+            # the dispatcher sampled the bus (eval_interval 10ms)
+            _wait_until(lambda: fleet.signals.value("queue_depth")
+                        is not None, msg="bus sampled")
+            assert fleet.signals.value("occupancy") is not None
+            assert fleet.signals.value("kv_pressure") is not None
+            assert fleet.signals.value("breakers_open") == 0.0
+            assert fleet.summary()["slo"]["breaching"] == []
+        finally:
+            fleet.close()
+
+    def test_itl_not_polluted_by_migration(self, params, rng):
+        """A chaos kill mid-decode: the cross-replica gap is a fault
+        cost, not a decode-cadence reading — the ITL stream must not
+        breach a tight objective because of the migration stall."""
+        cfg = SLOConfig.serving(itl_p99_s=60.0, eval_interval_s=0.01)
+        fleet = ServeFleet(
+            _factory(params), n_replicas=2, slo=cfg,
+            chaos=ChaosMonkey(kill_at_step=3, mode="raise",
+                              target="r0"))
+        try:
+            prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                                  np.int32) for _ in range(4)]
+            fids = [fleet.submit(p, 12) for p in prompts]
+            [fleet.result(f, timeout=300) for f in fids]
+            assert fleet.metrics.replica_deaths == 1
+            m = fleet.metrics.migrations
+            assert m >= 1
+            # every delivered token fed EITHER ttft or itl — except
+            # each migrated request's post-migration re-anchor token
+            # (a request migrated before its first token re-anchors
+            # nothing: its first survivor token is still TTFT)
+            st = fleet.slo.status()["objectives"]["itl_p99"]
+            assert 4 * 12 - 4 - m <= st["n_slow"] <= 4 * 12 - 4
+            # and the migration stall never read as a decode gap
+            assert st["breaching"] is False
+        finally:
+            fleet.close()
+
+    def test_healthz_degraded_names_breaching_objectives(self, params,
+                                                         rng):
+        """/healthz with an armed engine: 200 "ok" while compliant; a
+        breach downgrades to 200 "degraded" with the objectives named
+        (a latency slip must NOT pull the node from the LB); /metrics
+        serves the families through the strict parser."""
+        import http.client
+
+        cfg = SLOConfig.serving(ttft_p99_s=0.001, eval_interval_s=0.01)
+        fleet = ServeFleet(_factory(params), n_replicas=1, slo=cfg)
+        try:
+            fleet.generate(
+                [np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                            np.int32)], max_new_tokens=4, timeout=300)
+            with FrontDoor(fleet) as fd:
+                def get(path):
+                    conn = http.client.HTTPConnection(
+                        fd.host, fd.port, timeout=60)
+                    conn.request("GET", path)
+                    r = conn.getresponse()
+                    body = r.read()
+                    conn.close()
+                    return r, body
+
+                # the 1ms TTFT objective is already breached by the
+                # real request above (sustained: fast AND slow window)
+                _wait_until(lambda: fleet.slo.breaching(),
+                            msg="ttft breach")
+                r, body = get("/healthz")
+                h = json.loads(body)
+                assert r.status == 200           # still serving!
+                assert h["status"] == "degraded"
+                assert h["slo"]["breaching"] == ["ttft_p99"]
+                assert h["slo"]["objectives"]["ttft_p99"]["pool"] == \
+                    "prefill"
+
+                r, body = get("/metrics")
+                parsed = parse_exposition(body.decode())
+                assert sample(parsed, "quintnet_slo_breaching",
+                              objective="ttft_p99",
+                              pool="prefill") == 1.0
+                assert any(n.startswith("quintnet_pool_pressure_")
+                           for n, _l in parsed)
+        finally:
+            fleet.close()
+
+
+class TestInertness:
+    @pytest.mark.parametrize("combo", [
+        dict(spec=True, kv_dtype="int8", temperature=0.8, top_k=5),
+        dict(chunked_prefill=True, prefill_len=16, kv_dtype="int8",
+             temperature=0.8, top_k=5),
+    ], ids=["spec+int8+sampled", "chunked+int8+sampled"])
+    def test_slo_armed_fleet_is_bit_identical_census_unchanged(
+            self, params, rng, combo):
+        """THE acceptance golden, half one: SLO engine + signal bus
+        armed vs nothing armed — sampled, int8 KV, with speculation
+        and chunked prefill each composed — every output
+        bit-identical AND the per-replica compile census unchanged
+        (judgment adds zero programs)."""
+        prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (t,)),
+                              np.int32) for t in (5, 7, 30, 6)]
+        keys = [jax.random.key(60 + i) for i in range(4)]
+        outs, census = {}, {}
+        for armed in (False, True):
+            slo = (SLOConfig.serving(ttft_p99_s=0.001, itl_p99_s=0.001,
+                                     shed_rate=0.01,
+                                     eval_interval_s=0.005)
+                   if armed else None)           # breach-hot on purpose
+            fleet = ServeFleet(
+                _factory(params, **combo),
+                n_replicas=2, policy="round_robin", slo=slo)
+            try:
+                fids = [fleet.submit(p, 10, key=k)
+                        for p, k in zip(prompts, keys)]
+                outs[armed] = [fleet.result(f, timeout=300)
+                               for f in fids]
+                census[armed] = sorted(
+                    tuple(sorted(r.engine.compile_stats().items()))
+                    for r in fleet.replicas)
+                if armed:                        # it really judged
+                    assert fleet.slo.breaching()
+            finally:
+                fleet.close()
+        for a, b in zip(outs[False], outs[True]):
+            np.testing.assert_array_equal(a, b)
+        assert census[False] == census[True]
+
+    def test_slo_armed_fleet_inert_under_chaos_kill(self, params, rng):
+        """Half two: the same contract under a mid-run chaos kill —
+        the migration path with a breach-hot engine judging throughout
+        is still bit-identical to the unarmed fleet."""
+        prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                              np.int32) for _ in range(4)]
+        keys = [jax.random.key(80 + i) for i in range(4)]
+        outs = {}
+        for armed in (False, True):
+            slo = (SLOConfig.serving(ttft_p99_s=0.001, itl_p99_s=0.001,
+                                     eval_interval_s=0.005)
+                   if armed else None)
+            fleet = ServeFleet(
+                _factory(params, kv_dtype="int8", temperature=0.8,
+                         top_k=5),
+                n_replicas=2, slo=slo,
+                chaos=ChaosMonkey(kill_at_step=3, mode="raise",
+                                  target="r0"))
+            try:
+                fids = [fleet.submit(p, 12, key=k)
+                        for p, k in zip(prompts, keys)]
+                outs[armed] = [fleet.result(f, timeout=300)
+                               for f in fids]
+                assert fleet.metrics.replica_deaths == 1
+                if armed:
+                    assert fleet.slo.breaching()
+                    assert fleet.signals.value("occupancy") is not None
+            finally:
+                fleet.close()
+        for a, b in zip(outs[False], outs[True]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_crash_dump_carries_signal_snapshot(self, params, rng,
+                                                tmp_path):
+        """The black box gains the bus: a chaos-killed replica's dump
+        file embeds the dispatcher's last pool-pressure snapshot."""
+        from quintnet_tpu.obs import load_crash_dump
+
+        cfg = SLOConfig.serving(ttft_p99_s=60.0, eval_interval_s=0.005)
+        fleet = ServeFleet(
+            _factory(params), n_replicas=2, slo=cfg,
+            crash_dir=str(tmp_path),
+            chaos=ChaosMonkey(kill_at_step=3, mode="raise",
+                              target="r0"))
+        try:
+            prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (5,)),
+                                  np.int32) for _ in range(4)]
+            fids = [fleet.submit(p, 12) for p in prompts]
+            [fleet.result(f, timeout=300) for f in fids]
+            _wait_until(lambda: len(fleet.crash_dumps) == 1,
+                        msg="crash dump flushed")
+            dump = load_crash_dump(fleet.crash_dumps[0])
+            sig = dump["signals"]
+            assert sig, "signal snapshot missing from the dump"
+            assert "gauges" in sig and "sampled_at" in sig
+            assert "queue_depth" in sig["gauges"]
+        finally:
+            fleet.close()

@@ -42,6 +42,20 @@ directly comparable on shared cores). Structural isolation —
 prefill must land on the prefill pool (DistServe/Splitwise;
 artifacts/fleet_r16.json).
 
+``--slo`` replays the SAME interference trace with the judgment layer
+armed (quintnet_tpu/obs/slo.py + signals.py): one shared objective
+set is CALIBRATED off the clean no-burst replays — each signal's BEST
+baseline across the two modes, x mult (TTFT p99 <= mult x baseline;
+relative, so the contract travels across machines) — then both modes
+replay the burst under the armed SLO engine + signal bus (+ the
+observe-only rebalance planner on the disaggregated side). The record
+is the typed-event story: the burst trips the fast+slow TTFT burn
+windows, the breach names the prefill pool, the planner recommends
+decode→prefill and the revert after recovery — and the colocated
+fleet ALSO burns the ITL budget the disaggregated one holds, which is
+the DistServe goodput argument as events instead of a human reading
+fleet_r16.json (artifacts/slo_r17.json).
+
 Modes:
   python tools/fleet_bench.py --synthetic                # tiny, CPU-ok
   python tools/fleet_bench.py --synthetic --requests 6 \
@@ -51,6 +65,8 @@ Modes:
       --out artifacts/fleet_r12.json                     # process fleet
   python tools/fleet_bench.py --synthetic --disagg \
       --out artifacts/fleet_r16.json                     # interference A/B
+  python tools/fleet_bench.py --synthetic --slo \
+      --out artifacts/slo_r17.json                       # SLO replay
 
 ``--out FILE`` appends the records to an artifacts JSON list
 (bench.last_known_result scans them — same staleness story as the
@@ -487,6 +503,8 @@ def _replay_itl(args, fleet, vocab: int, *, burst: bool,
                       if gaps else 0.0),
         "itl_p50_s": (round(float(np.percentile(gaps, 50)), 5)
                       if gaps else 0.0),
+        "ttft_p99_s": s["ttft_s"]["p99"],
+        "ttft_p50_s": s["ttft_s"]["p50"],
         "first_gap_max_s": (round(max(first_gaps), 5)
                             if first_gaps else 0.0),
         "gaps": len(gaps),
@@ -621,6 +639,222 @@ def run_disagg(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# --slo: the judgment layer replayed over the fleet_r16 interference trace
+# ---------------------------------------------------------------------------
+
+
+def _slo_capture(fleet) -> dict:
+    """One mode's SLO story after an armed replay: which objectives
+    breached / recovered (from the typed event stream — edges, not
+    polling), the burn peaks, and the planner's recommendation ledger
+    (disaggregated fleets only)."""
+    status = fleet.slo.status()
+    events = fleet.events.snapshot()
+
+    def of_kind(kind):
+        return [e for e in events if e["kind"] == kind]
+
+    breaches = of_kind("slo_breach")
+    out = {
+        "breached": sorted({e["objective"] for e in breaches}),
+        "breach_pools": {e["objective"]: e["pool"] for e in breaches},
+        "recovered": sorted({e["objective"]
+                             for e in of_kind("slo_recovered")}),
+        "burn_fast_peak": {name: st["burn_fast_peak"]
+                           for name, st in status["objectives"].items()},
+        "breach_burns": [{"objective": e["objective"],
+                          "burn_fast": e["burn_fast"],
+                          "burn_slow": e["burn_slow"]}
+                         for e in breaches],
+        "still_breaching": status["breaching"],
+    }
+    if fleet.planner is not None:
+        out["recommendations"] = [
+            {k: r.get(k) for k in ("direction", "from_pool", "to_pool",
+                                   "revert", "objective", "reason")}
+            for r in fleet.planner.recommendations]
+    return out
+
+
+def run_slo(args) -> dict:
+    """The SLO engine + signal plane over the SAME interference trace
+    as --disagg (fleet_r16): each mode first replays WITHOUT the burst
+    unarmed, then WITH the burst under the armed engine. The clean
+    replays calibrate ONE shared objective set — each signal's BEST
+    clean baseline across the two modes, x mult (absolute targets
+    would bake in one machine's speed) — the tightest contract this
+    box can promise at all; both modes are then judged against the
+    SAME promise, which is the DistServe goodput framing.
+
+    The acceptance story this records: on the DISAGGREGATED side the
+    long-prefill burst trips the fast+slow TTFT burn windows, the
+    breach names the prefill pool, the observe-only planner recommends
+    converting a decode replica to prefill while the breach holds and
+    recommends the REVERT after it recovers; ITL holds — the decode
+    pool never runs a monolithic prefill. On the COLOCATED side the
+    same burst ALSO burns the ITL budget — the monolithic prefills
+    stall decode, a breach no rebalance can fix — which is the
+    DistServe goodput argument as a typed event stream instead of a
+    human reading fleet_r16.json."""
+    import time
+
+    from quintnet_tpu.fleet import ProcessFleet
+    from quintnet_tpu.fleet.retry import RetryPolicy
+    from quintnet_tpu.obs import SLOConfig
+
+    if args.max_new < 4:
+        # the ITL ledger excludes each request's first 2 gaps (handoff
+        # transient) — shorter runs leave NO steady gaps, calibrate an
+        # itl_p99 target of 0.0, and Objective rejects target <= 0
+        raise SystemExit("--slo needs --max-new >= 4: shorter runs "
+                         "record no steady ITL gaps to calibrate the "
+                         "itl_p99 objective from")
+    vocab = vocab_size(args)
+    spec = {"file": os.path.abspath(__file__), "func": "build_engine",
+            "kwargs": _disagg_engine_kwargs(args)}
+    n_total = args.prefill_replicas + args.decode_replicas
+    results = {}
+    fleets = {}
+    try:
+        # phase 1 — both fleets up, warm, and replayed WITHOUT the
+        # burst, unarmed: the clean baselines. The shared objective
+        # set takes each signal's BEST clean baseline across the two
+        # modes (x mult) — the tightest contract this box can promise
+        # at all. That is what makes the verdict meaningful: TTFT
+        # calibrates off the colocated side (no handoff in the first
+        # token's path), ITL off the disaggregated side (a dedicated
+        # decode pool nothing ever prefills on), and the burst replay
+        # then shows which deployment can HOLD the combined promise.
+        for mode in ("disagg", "colocated"):
+            kw = (dict(pools={"prefill": args.prefill_replicas,
+                              "decode": args.decode_replicas})
+                  if mode == "disagg" else dict(n_replicas=n_total))
+            fleet = fleets[mode] = ProcessFleet(
+                spec, policy="least_work", max_pending=args.max_pending,
+                max_dispatch=args.max_dispatch, heartbeat_s=0.05,
+                handoff_retry=RetryPolicy(base_s=0.02, cap_s=0.5,
+                                          max_attempts=3),
+                name_prefix="r", obs=True, **kw)
+            fleet.warmup()
+            import argparse as _ap
+
+            warm = _ap.Namespace(**{**vars(args), "steady": 2,
+                                    "max_new": min(4, args.max_new)})
+            _replay_itl(warm, fleet, vocab, burst=False,
+                        seed=args.seed + 7919)
+            base = _replay_itl(args, fleet, vocab, burst=False,
+                               seed=args.seed)
+            results[mode] = {"baseline": base}
+        targets = {
+            "ttft_p99_s": round(args.slo_ttft_mult * min(
+                results[m]["baseline"]["ttft_p99_s"]
+                for m in results), 5),
+            "itl_p99_s": round(args.slo_itl_mult * min(
+                results[m]["baseline"]["itl_p99_s"]
+                for m in results), 5),
+        }
+        bad = {k: v for k, v in targets.items() if v <= 0}
+        if bad:
+            raise SystemExit(f"clean-replay calibration produced "
+                             f"non-positive targets {bad} — the "
+                             f"baseline recorded no samples for "
+                             f"these signals; raise --steady/--max-new")
+        # phase 2 — arm the SAME objectives on both fleets and replay
+        # WITH the burst (the idle fleet just heartbeats while the
+        # other replays; replays stay sequential so the two modes
+        # never compete for cores mid-measurement)
+        for mode in ("disagg", "colocated"):
+            fleet = fleets[mode]
+            fleet.arm_slo(
+                SLOConfig.serving(
+                    ttft_p99_s=targets["ttft_p99_s"],
+                    itl_p99_s=targets["itl_p99_s"],
+                    fast_window_s=args.slo_fast_window,
+                    slow_window_s=args.slo_slow_window,
+                    burn_threshold=args.slo_burn_threshold,
+                    eval_interval_s=args.slo_eval_interval),
+                cooldown_s=args.slo_cooldown,
+                donor_occupancy_below=args.slo_donor_occ)
+            burst = _replay_itl(args, fleet, vocab, burst=True,
+                                seed=args.seed + 1)
+            # post-burst: the dispatcher keeps evaluating on its own
+            # tick — wait for the fast window to clear (recovery) and,
+            # on the disaggregated side, for the planner's revert
+            deadline = time.monotonic() + args.slo_recovery_wait
+            while time.monotonic() < deadline:  # qtcheck: ok[QT106]
+                recovered = not fleet.slo.status()["breaching"]
+                reverted = (fleet.planner is None
+                            or any(r["revert"] for r in
+                                   fleet.planner.recommendations))
+                if recovered and reverted:
+                    break
+                time.sleep(0.05)
+            results[mode].update(burst=burst, slo=_slo_capture(fleet))
+    finally:
+        for fleet in fleets.values():
+            fleet.drain(timeout=args.timeout_s)
+
+    d, c = results["disagg"], results["colocated"]
+    recs = d["slo"]["recommendations"]
+    tag = "tiny" if args.synthetic else "full"
+    # the headline value: how hard the burst burned the TTFT budget on
+    # the disaggregated side's fast window (>= threshold = tripped)
+    return {
+        "metric": f"fleet_slo_{args.model}_{tag}_burst_burn_peak",
+        "value": d["slo"]["burn_fast_peak"].get("ttft_p99", 0.0),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "rc": 0,
+        "extras": {
+            "targets": targets,
+            "burn_threshold": args.slo_burn_threshold,
+            "fast_window_s": args.slo_fast_window,
+            "slow_window_s": args.slo_slow_window,
+            "disagg_baseline_ttft_p99_s": d["baseline"]["ttft_p99_s"],
+            "disagg_baseline_itl_p99_s": d["baseline"]["itl_p99_s"],
+            "colocated_baseline_ttft_p99_s":
+                c["baseline"]["ttft_p99_s"],
+            "colocated_baseline_itl_p99_s": c["baseline"]["itl_p99_s"],
+            "disagg_breached": d["slo"]["breached"],
+            "disagg_breach_pools": d["slo"]["breach_pools"],
+            "disagg_recovered": d["slo"]["recovered"],
+            "disagg_still_breaching": d["slo"]["still_breaching"],
+            "disagg_breach_burns": d["slo"]["breach_burns"],
+            "disagg_burn_fast_peak": d["slo"]["burn_fast_peak"],
+            "recommendations": recs,
+            "colocated_breached": c["slo"]["breached"],
+            "colocated_breach_pools": c["slo"]["breach_pools"],
+            "colocated_burn_fast_peak": c["slo"]["burn_fast_peak"],
+            "disagg_itl_p99_burst_s": d["burst"]["itl_p99_s"],
+            "colocated_itl_p99_burst_s": c["burst"]["itl_p99_s"],
+            "disagg_ttft_p99_burst_s": d["burst"]["ttft_p99_s"],
+            "colocated_ttft_p99_burst_s": c["burst"]["ttft_p99_s"],
+            "handoffs": d["burst"]["handoffs"],
+            "handoff_fallbacks": d["burst"]["handoff_fallbacks"],
+            "finished": d["burst"]["finished"],
+            "accepted": d["burst"]["accepted"],
+            "colocated_finished": c["burst"]["finished"],
+            "colocated_accepted": c["burst"]["accepted"],
+            "ttft_mult": args.slo_ttft_mult,
+            "itl_mult": args.slo_itl_mult,
+            "donor_occupancy_below": args.slo_donor_occ,
+            "cooldown_s": args.slo_cooldown,
+            "kv_dtype": args.kv_dtype,
+            "n_embd": args.disagg_n_embd,
+            "prefill_replicas": args.prefill_replicas,
+            "decode_replicas": args.decode_replicas,
+            "steady": args.steady,
+            "burst_prompts": args.burst_prompts,
+            "burst_prompt_len": args.burst_prompt_len,
+            "max_new": args.max_new,
+            "slots": args.slots,
+            "model": args.model,
+            "synthetic": bool(args.synthetic),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2", choices=("gpt2", "llama"))
@@ -684,6 +918,37 @@ def main():
                          "(int8 makes each handed-off chain ~4x "
                          "smaller on the wire — PR 10's layout is "
                          "half of what makes disaggregation cheap)")
+    ap.add_argument("--slo", action="store_true",
+                    help="replay the --disagg interference trace with "
+                         "the SLO engine + signal plane armed "
+                         "(obs/slo.py, obs/signals.py): objectives "
+                         "calibrated off the best clean no-burst "
+                         "baseline, burn windows + breach events + "
+                         "observe-only rebalance recommendations "
+                         "recorded for BOTH modes")
+    ap.add_argument("--slo-ttft-mult", type=float, default=3.0,
+                    help="TTFT p99 objective = mult x the best "
+                         "mode's no-burst baseline p99 (relative, so "
+                         "the contract travels across machines)")
+    ap.add_argument("--slo-itl-mult", type=float, default=1.5,
+                    help="ITL p99 objective = mult x the best "
+                         "mode's no-burst baseline p99")
+    ap.add_argument("--slo-fast-window", type=float, default=1.5,
+                    help="fast burn window (responsiveness + recovery)")
+    ap.add_argument("--slo-slow-window", type=float, default=6.0,
+                    help="slow burn window (the anti-flap gate)")
+    ap.add_argument("--slo-burn-threshold", type=float, default=2.0)
+    ap.add_argument("--slo-eval-interval", type=float, default=0.05)
+    ap.add_argument("--slo-cooldown", type=float, default=1.0,
+                    help="planner cooldown between recommendations")
+    ap.add_argument("--slo-donor-occ", type=float, default=0.85,
+                    help="planner donor-occupancy gate: only recommend "
+                         "taking a replica from a pool whose EWMA "
+                         "occupancy is below this")
+    ap.add_argument("--slo-recovery-wait", type=float, default=15.0,
+                    help="post-burst grace for the fast window to "
+                         "clear and the planner to recommend the "
+                         "revert")
     ap.add_argument("--steady-gap-s", type=float, default=0.1,
                     help="spacing between --disagg steady arrivals "
                          "(keeps the prefill pool periodically busy "
@@ -701,7 +966,10 @@ def main():
         args.burst = args.requests
 
     records = []
-    if args.disagg:
+    if args.slo:
+        records.append(run_slo(args))
+        print(json.dumps(records[-1]))
+    elif args.disagg:
         records.append(run_disagg(args))
         print(json.dumps(records[-1]))
     elif args.process:

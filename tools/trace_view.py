@@ -19,7 +19,15 @@ Mapping (the Chrome trace-event format, JSON Array/Object flavor):
 - each request SPAN becomes an async begin/end pair ("ph": "b"/"e",
   id = trace id) on the "requests" track, instants (t1 == t0) become
   instant events ("ph": "i") — one row per request from queue to
-  finish, migrations included (the id stitches cross-process spans).
+  finish, migrations included (the id stitches cross-process spans);
+- each fleet LIFECYCLE EVENT (obs/events.py — crash dumps embed the
+  recent ring) becomes an instant marker ("ph": "i") on the "fleet
+  events" track. SLO-judgment events (``slo_breach`` /
+  ``slo_recovered`` / ``rebalance_recommended``, obs/slo.py +
+  obs/signals.py) are scoped GLOBAL ("s": "g") so Perfetto draws a
+  full-height line: "the fast+slow burn windows tripped HERE" and
+  "the planner recommended decode→prefill HERE" line up visually
+  against the step slices that caused them.
 
 Timestamps are microseconds (the format's unit), re-based to the
 earliest event so Perfetto opens at t=0 instead of hours into a
@@ -48,23 +56,36 @@ _US = 1e6
 PID = 1
 TID_STEPS = 1
 TID_REQUESTS = 2
+TID_EVENTS = 3
+
+# fleet events drawn as FULL-HEIGHT markers ("s": "g"): the SLO
+# judgment layer's output, which the reader wants to line up against
+# every track at once. Everything else stays a thread-local tick.
+_GLOBAL_EVENT_KINDS = frozenset({
+    "slo_breach", "slo_recovered", "rebalance_recommended",
+})
 
 
-def _base_ts(ring: List[Dict], traces: Dict[str, List[Dict]]) -> float:
+def _base_ts(ring: List[Dict], traces: Dict[str, List[Dict]],
+             fleet_events: Optional[List[Dict]] = None) -> float:
     ts = [r["t0"] for r in ring]
     ts += [s["t0"] for spans in traces.values() for s in spans]
+    ts += [e["ts"] for e in (fleet_events or []) if "ts" in e]
     return min(ts) if ts else 0.0
 
 
 def chrome_trace(ring: Optional[List[Dict]] = None,
                  traces: Optional[Dict[str, List[Dict]]] = None,
+                 fleet_events: Optional[List[Dict]] = None,
                  *, label: str = "quintnet-serve") -> Dict:
     """Build the Chrome trace-event JSON object (see module
     docstring). ``ring``: StepRecorder.snapshot(); ``traces``:
-    Tracer.snapshot()."""
+    Tracer.snapshot(); ``fleet_events``: EventLog.snapshot() (what a
+    crash dump's ``events`` field carries)."""
     ring = ring or []
     traces = traces or {}
-    t_base = _base_ts(ring, traces)
+    fleet_events = fleet_events or []
+    t_base = _base_ts(ring, traces, fleet_events)
     events: List[Dict] = [
         {"ph": "M", "pid": PID, "name": "process_name",
          "args": {"name": label}},
@@ -72,6 +93,8 @@ def chrome_trace(ring: Optional[List[Dict]] = None,
          "args": {"name": "engine steps"}},
         {"ph": "M", "pid": PID, "tid": TID_REQUESTS,
          "name": "thread_name", "args": {"name": "requests"}},
+        {"ph": "M", "pid": PID, "tid": TID_EVENTS,
+         "name": "thread_name", "args": {"name": "fleet events"}},
     ]
     for rec in ring:
         args = {k: v for k, v in rec.items()
@@ -100,6 +123,28 @@ def chrome_trace(ring: Optional[List[Dict]] = None,
                 # instant: scope "t" (thread) keeps it a tick mark
                 events.append({"name": s["name"], "ph": "i", "s": "t",
                                "ts": t0, **common})
+    for e in fleet_events:
+        if "ts" not in e or "kind" not in e:
+            continue        # not an EventLog record; skip, don't guess
+        kind = e["kind"]
+        name = kind
+        args = {k: v for k, v in e.items()
+                if k not in ("ts", "seq", "kind")}
+        if kind == "slo_breach":
+            # the marker label carries the judgment: which objective,
+            # which pool, how hard it is burning
+            name = (f"slo_breach {args.get('objective', '?')} "
+                    f"[{args.get('pool', '?')}] "
+                    f"{args.get('burn_fast', 0):.1f}x")
+        elif kind == "rebalance_recommended":
+            name = (f"rebalance {args.get('direction', '?')}"
+                    + (" (revert)" if args.get("revert") else ""))
+        events.append({
+            "name": name, "cat": "fleet", "ph": "i",
+            "s": "g" if kind in _GLOBAL_EVENT_KINDS else "t",
+            "ts": (e["ts"] - t_base) * _US,
+            "pid": PID, "tid": TID_EVENTS, "args": args,
+        })
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"source": label}}
 
@@ -159,10 +204,12 @@ def _load_dump(path: str) -> Dict:
         payload = json.load(f)
     if not isinstance(payload, dict):
         raise SystemExit(f"{path}: expected a JSON object")
-    if "ring" not in payload and "traces" not in payload:
+    if ("ring" not in payload and "traces" not in payload
+            and "events" not in payload):
         raise SystemExit(
-            f"{path}: no 'ring' or 'traces' — not a crash dump or obs "
-            f"dump (tools/serve_bench.py --trace-out writes one)")
+            f"{path}: no 'ring', 'traces' or 'events' — not a crash "
+            f"dump or obs dump (tools/serve_bench.py --trace-out "
+            f"writes one)")
     return payload
 
 
@@ -179,7 +226,7 @@ def main(argv=None) -> int:
     payload = _load_dump(args.dump)
     label = payload.get("replica") or "quintnet-serve"
     trace = chrome_trace(payload.get("ring"), payload.get("traces"),
-                         label=label)
+                         payload.get("events"), label=label)
     validate_chrome_trace(trace)
     text = json.dumps(trace, indent=1)
     if args.out:
