@@ -22,6 +22,7 @@ from quintnet_tpu.analysis.jaxpr_audit import (
     collective_census,
     donation_report,
     dtype_report,
+    gathered_view_gathers,
 )
 from quintnet_tpu.analysis.lint import (
     RULES,
@@ -45,6 +46,7 @@ __all__ = [
     "collective_census",
     "donation_report",
     "dtype_report",
+    "gathered_view_gathers",
     "RULES",
     "Violation",
     "compare_baseline",

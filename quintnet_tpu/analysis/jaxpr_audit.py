@@ -330,3 +330,65 @@ def donation_report(jitted: Callable, *args, **kwargs) -> DonationReport:
             dtype=str(aval.dtype), bytes=nbytes,
             donated=donated, aliasable=aliasable[i]))
     return DonationReport(args=rows)
+
+
+# ---------------------------------------------------------------------------
+# gathered-view audit (fused paged attention, ops/paged_attention.py)
+
+
+def _walk_skip_kernels(jaxpr, visit) -> None:
+    """Walk every eqn (scan/cond/pjit bodies included) EXCEPT inside
+    ``pallas_call`` kernels: kernel-internal memory ops act on VMEM
+    blocks by construction, which is exactly the property the
+    gathered-view audit exists to distinguish from HBM traffic."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            continue
+        visit(eqn)
+        for sub in _subjaxprs(eqn.params):
+            _walk_skip_kernels(_as_open(sub), visit)
+
+
+def gathered_view_gathers(fn: Callable, *args, num_blocks: int,
+                          table_width: int, **kwargs) -> int:
+    """Count the XLA ``gather`` eqns that materialize a FULL
+    block-table row view: operand 0 is a pool-shaped array (leading
+    dim == ``num_blocks``) and the output carries a ``table_width``
+    dim — the `paged_gather`/`paged_gather_scales` signature, the HBM
+    round-trip the fused Pallas kernels exist to delete.
+
+    The count is structural (one per eqn occurrence; a scan body
+    counts once, not per trip), and the table dim is positional: a
+    pool gather indexed by an [.., W]-wide table slice lands W at
+    OUTPUT DIM 1 ([rows, W, slots-or-heads, ...]), so only dim 1 is
+    compared — a head/feature dim that happens to equal
+    ``table_width`` cannot alias. An ``attn_kernel="xla"`` serving
+    program shows >= 2 per layer (k + v, plus both scale gathers under
+    a scaled KV policy); an ``attn_kernel="pallas"`` program must show
+    ZERO — its only pool gathers are the touched-block windows of
+    ``paged_quant_window_update``, whose table dim is the requant
+    span. CALLER CONTRACT: audit a program whose requant span is
+    strictly below ``table_width`` (decode's span is 1; for prefill
+    pick a bucket well under the row length) — a run covering the
+    whole row must legitimately touch every block it wrote.
+    ``pallas_call`` interiors are skipped — VMEM block moves are the
+    kernel doing its job."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    found = 0
+
+    def visit(eqn):
+        nonlocal found
+        if eqn.primitive.name != "gather":
+            return
+        op = eqn.invars[0]
+        if not (hasattr(op, "aval") and hasattr(op.aval, "shape")):
+            return
+        shape = tuple(op.aval.shape)
+        if not shape or shape[0] != num_blocks:
+            return
+        out = tuple(eqn.outvars[0].aval.shape)
+        if len(out) >= 2 and out[1] == table_width:
+            found += 1
+
+    _walk_skip_kernels(closed.jaxpr, visit)
+    return found

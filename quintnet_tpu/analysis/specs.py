@@ -297,6 +297,24 @@ def kv_layout_policies() -> Tuple[str, ...]:
     return ("f32", "bf16", "int8", "fake_quant")
 
 
+def attn_kernels() -> Tuple[str, ...]:
+    """THE canonical serving attention-backend ladder
+    (ops/paged_attention.py): ``xla`` is the gathered-view reference
+    oracle, ``pallas`` the fused block-table-walking kernel. Pinned
+    here for the same reason the policy ladder is: the backend must
+    NOT change any census or bound — per backend the engine compiles
+    exactly the same sentinel set, and every ``expected_serve_*``
+    census above holds verbatim (the kernel lives strictly inside the
+    per-layer attention; the RowParallel psums, the vocab-parallel
+    collectives, and the sp ring all sit outside it, and a
+    ``pallas_call`` carries no collectives at all). What DOES differ
+    is structural and audited separately:
+    ``jaxpr_audit.gathered_view_gathers`` must be > 0 for xla programs
+    and exactly 0 for pallas ones (tests/test_qtcheck.py,
+    tests/test_serve_bench.py)."""
+    return ("xla", "pallas")
+
+
 def lora_rank_buckets(max_rank: int, *, floor: int = 4) -> Tuple[int, ...]:
     """THE canonical adapter-rank ladder for multi-tenant LoRA serving
     (serve/adapters.py): powers of two from ``floor`` up to (and capped

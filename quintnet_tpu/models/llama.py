@@ -441,7 +441,8 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
                               block_tables=None,
                               block_size: Optional[int] = None,
                               lora=None, lora_scale=None,
-                              kv_scales=None, policy=None):
+                              kv_scales=None, policy=None,
+                              attn_kernel: str = "xla"):
     """Chunked prefill over the paged pool (the serve engine's
     prefix-cached path): x [1, P, D] tail hidden states at absolute
     ``positions`` [P], caches are flat pool views
@@ -454,9 +455,11 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
     positions. ``lora``/``lora_scale``: this layer's packed per-slot
     adapters (serving multi-LoRA). ``kv_scales``/``policy``: scaled KV
     layout (serve/kv_quant.py) — dequantized gathered view, quantize on
-    scatter. Returns (x, (kc, vc[, k_scale, v_scale]))."""
-    from quintnet_tpu.nn.attention import (_quant_span, paged_gather,
-                                           paged_gather_dequant,
+    scatter. Returns (x, (kc, vc[, k_scale, v_scale])).
+    ``attn_kernel="pallas"``: the fused block-table-walking kernel
+    (ops/paged_attention.py) — same contract as
+    nn/attention.mha_prefill_paged's dispatch."""
+    from quintnet_tpu.nn.attention import (_gather_kv, _quant_span,
                                            paged_prefill_update,
                                            paged_quant_update)
 
@@ -465,41 +468,66 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
                         lora=attn_lora, lora_scale=lora_scale)
-    if kv_scales is None:
-        kc, vc = paged_prefill_update(kc, vc, k[0], v[0], positions,
-                                      tail_len,
-                                      block_tables=block_tables,
-                                      block_size=block_size)
-        kg = paged_gather(kc, block_tables[None], block_size=block_size)
-        vg = paged_gather(vc, block_tables[None], block_size=block_size)
-        pools = (kc, vc)
-    else:
-        ks, vs = kv_scales
+    if attn_kernel == "pallas":
         tables = block_tables[None]
-        kg = paged_gather_dequant(policy, kc, ks, tables,
-                                  block_size=block_size)
-        vg = paged_gather_dequant(policy, vc, vs, tables,
-                                  block_size=block_size)
-        span = _quant_span(positions.shape[0], block_size,
-                           block_tables.shape[0])
-        pos2 = positions[None, :]
-        lens = jnp.reshape(tail_len, (1,))
-        kc, ks, kg = paged_quant_update(
-            policy, kc, ks, kg, k, pos2, lens, block_tables=tables,
-            block_size=block_size, max_blocks=span)
-        vc, vs, vg = paged_quant_update(
-            policy, vc, vs, vg, v, pos2, lens, block_tables=tables,
-            block_size=block_size, max_blocks=span)
-        pools = (kc, vc, ks, vs)
-    rep = q.shape[1] // kg.shape[1]
-    kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
-    valid = (jnp.arange(kf.shape[2])[None, :]
-             <= positions[:, None])[None, None]          # [1,1,P,M*bs]
-    scores = (jnp.einsum("bhqd,bhtd->bhqt", q, kf).astype(jnp.float32)
-              / math.sqrt(cfg.head_dim))
-    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
-    o = jnp.einsum("bhqt,bhtd->bhqd",
-                   jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
+        if kv_scales is None:
+            from quintnet_tpu.ops.paged_attention import paged_attention
+
+            kc, vc = paged_prefill_update(kc, vc, k[0], v[0], positions,
+                                          tail_len,
+                                          block_tables=block_tables,
+                                          block_size=block_size)
+            o = paged_attention(q, kc, vc, tables, positions[:1],
+                                block_size=block_size)
+            pools = (kc, vc)
+        else:
+            from quintnet_tpu.nn.attention import _paged_attention_scaled
+
+            ks, vs = kv_scales
+            o, kc, vc, ks, vs = _paged_attention_scaled(
+                policy, kc, vc, ks, vs, q, k, v, positions[None, :],
+                jnp.reshape(tail_len, (1,)), tables,
+                block_size=block_size,
+                max_blocks=_quant_span(positions.shape[0], block_size,
+                                       block_tables.shape[0]))
+            pools = (kc, vc, ks, vs)
+    else:
+        if kv_scales is None:
+            kc, vc = paged_prefill_update(kc, vc, k[0], v[0], positions,
+                                          tail_len,
+                                          block_tables=block_tables,
+                                          block_size=block_size)
+            kg, vg = _gather_kv(kc, vc, None, policy,
+                                block_tables[None],
+                                block_size=block_size)
+            pools = (kc, vc)
+        else:
+            ks, vs = kv_scales
+            tables = block_tables[None]
+            kg, vg = _gather_kv(kc, vc, (ks, vs), policy, tables,
+                                block_size=block_size)
+            span = _quant_span(positions.shape[0], block_size,
+                               block_tables.shape[0])
+            pos2 = positions[None, :]
+            lens = jnp.reshape(tail_len, (1,))
+            kc, ks, kg = paged_quant_update(
+                policy, kc, ks, kg, k, pos2, lens, block_tables=tables,
+                block_size=block_size, max_blocks=span)
+            vc, vs, vg = paged_quant_update(
+                policy, vc, vs, vg, v, pos2, lens, block_tables=tables,
+                block_size=block_size, max_blocks=span)
+            pools = (kc, vc, ks, vs)
+        rep = q.shape[1] // kg.shape[1]
+        kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
+        valid = (jnp.arange(kf.shape[2])[None, :]
+                 <= positions[:, None])[None, None]      # [1,1,P,M*bs]
+        scores = (jnp.einsum("bhqd,bhtd->bhqt", q,
+                             kf).astype(jnp.float32)
+                  / math.sqrt(cfg.head_dim))
+        scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+        o = jnp.einsum("bhqt,bhtd->bhqd",
+                       jax.nn.softmax(scores,
+                                      axis=-1).astype(q.dtype), vf)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
                             lora=attn_lora, lora_scale=lora_scale)
     x, _aux = llama_mlp_residual(
@@ -545,7 +573,8 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
                              block_tables=None,
                              block_size: Optional[int] = None,
                              lora=None, lora_scale=None,
-                             kv_scales=None, policy=None):
+                             kv_scales=None, policy=None,
+                             attn_kernel: str = "xla"):
     """Batched draft-verify block step over the paged pool (the serve
     engine's speculative-decode scoring path, serve/spec.py): x
     [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
@@ -558,9 +587,10 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
     per row. ``cos``/``sin`` [S, 1, P, hd] must be built from the SAME
     absolute positions. ``lora``/``lora_scale``: this layer's packed
     per-slot adapters. ``kv_scales``/``policy``: scaled KV layout
-    (serve/kv_quant.py). Returns (x, (kc, vc[, k_scale, v_scale]))."""
-    from quintnet_tpu.nn.attention import (_quant_span, paged_gather,
-                                           paged_gather_dequant,
+    (serve/kv_quant.py). Returns (x, (kc, vc[, k_scale, v_scale])).
+    ``attn_kernel="pallas"``: the fused block-table-walking kernel
+    (ops/paged_attention.py), batched over rows."""
+    from quintnet_tpu.nn.attention import (_gather_kv, _quant_span,
                                            paged_quant_update,
                                            paged_verify_update)
 
@@ -569,39 +599,62 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
     a_in = rms_norm_apply(p["ln1"], x, eps=cfg.rms_eps)
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
                         lora=attn_lora, lora_scale=lora_scale)
-    if kv_scales is None:
-        kc, vc = paged_verify_update(kc, vc, k, v, positions, tail_lens,
-                                     block_tables=block_tables,
-                                     block_size=block_size)
-        kg = paged_gather(kc, block_tables, block_size=block_size)
-        vg = paged_gather(vc, block_tables, block_size=block_size)
-        pools = (kc, vc)
+    if attn_kernel == "pallas":
+        if kv_scales is None:
+            from quintnet_tpu.ops.paged_attention import paged_attention
+
+            kc, vc = paged_verify_update(kc, vc, k, v, positions,
+                                         tail_lens,
+                                         block_tables=block_tables,
+                                         block_size=block_size)
+            o = paged_attention(q, kc, vc, block_tables,
+                                positions[:, 0], block_size=block_size)
+            pools = (kc, vc)
+        else:
+            from quintnet_tpu.nn.attention import _paged_attention_scaled
+
+            ks, vs = kv_scales
+            o, kc, vc, ks, vs = _paged_attention_scaled(
+                policy, kc, vc, ks, vs, q, k, v, positions, tail_lens,
+                block_tables, block_size=block_size,
+                max_blocks=_quant_span(positions.shape[1], block_size,
+                                       block_tables.shape[1]))
+            pools = (kc, vc, ks, vs)
     else:
-        ks, vs = kv_scales
-        kg = paged_gather_dequant(policy, kc, ks, block_tables,
-                                  block_size=block_size)
-        vg = paged_gather_dequant(policy, vc, vs, block_tables,
-                                  block_size=block_size)
-        span = _quant_span(positions.shape[1], block_size,
-                           block_tables.shape[1])
-        kc, ks, kg = paged_quant_update(
-            policy, kc, ks, kg, k, positions, tail_lens,
-            block_tables=block_tables, block_size=block_size,
-            max_blocks=span)
-        vc, vs, vg = paged_quant_update(
-            policy, vc, vs, vg, v, positions, tail_lens,
-            block_tables=block_tables, block_size=block_size,
-            max_blocks=span)
-        pools = (kc, vc, ks, vs)
-    rep = q.shape[1] // kg.shape[1]
-    kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
-    valid = (jnp.arange(kf.shape[2])[None, None, :]
-             <= positions[:, :, None])[:, None]       # [S, 1, P, M*bs]
-    scores = (jnp.einsum("bhqd,bhtd->bhqt", q, kf).astype(jnp.float32)
-              / math.sqrt(cfg.head_dim))
-    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
-    o = jnp.einsum("bhqt,bhtd->bhqd",
-                   jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
+        if kv_scales is None:
+            kc, vc = paged_verify_update(kc, vc, k, v, positions,
+                                         tail_lens,
+                                         block_tables=block_tables,
+                                         block_size=block_size)
+            kg, vg = _gather_kv(kc, vc, None, policy, block_tables,
+                                block_size=block_size)
+            pools = (kc, vc)
+        else:
+            ks, vs = kv_scales
+            kg, vg = _gather_kv(kc, vc, (ks, vs), policy, block_tables,
+                                block_size=block_size)
+            span = _quant_span(positions.shape[1], block_size,
+                               block_tables.shape[1])
+            kc, ks, kg = paged_quant_update(
+                policy, kc, ks, kg, k, positions, tail_lens,
+                block_tables=block_tables, block_size=block_size,
+                max_blocks=span)
+            vc, vs, vg = paged_quant_update(
+                policy, vc, vs, vg, v, positions, tail_lens,
+                block_tables=block_tables, block_size=block_size,
+                max_blocks=span)
+            pools = (kc, vc, ks, vs)
+        rep = q.shape[1] // kg.shape[1]
+        kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
+        valid = (jnp.arange(kf.shape[2])[None, None, :]
+                 <= positions[:, :, None])[:, None]   # [S, 1, P, M*bs]
+        scores = (jnp.einsum("bhqd,bhtd->bhqt", q,
+                             kf).astype(jnp.float32)
+                  / math.sqrt(cfg.head_dim))
+        scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+        o = jnp.einsum("bhqt,bhtd->bhqd",
+                       jax.nn.softmax(scores,
+                                      axis=-1).astype(q.dtype), vf)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
                             lora=attn_lora, lora_scale=lora_scale)
     x, _aux = llama_mlp_residual(
@@ -615,7 +668,8 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
                        tp_axis: Optional[str] = None,
                        block_tables=None, block_size: Optional[int] = None,
                        lora=None, lora_scale=None,
-                       kv_scales=None, policy=None):
+                       kv_scales=None, policy=None,
+                       attn_kernel: str = "xla"):
     """One cached token: x [B, 1, D], caches [B, Hkv(/tp), T, hd] ->
     (x, updated caches). Masked attention over cache[:pos].
 
@@ -634,11 +688,16 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
     q, k, v = llama_qkv(p["attn"], a_in, cfg, cos, sin, tp=tp,
                         lora=attn_lora, lora_scale=lora_scale)
     pools = None
+    kf = None
     if block_tables is None:
         if kv_scales is not None:
             raise ValueError(
                 "scaled KV layout policies exist only for the paged "
                 "pool (block_tables is required)")
+        if attn_kernel != "xla":
+            raise ValueError(
+                "attn_kernel='pallas' exists only for the paged pool "
+                "(block_tables is required)")
         kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos,
                                              axis=2)
         vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos,
@@ -646,27 +705,46 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
         rep = q.shape[1] // kc.shape[1]
         kf, vf = repeat_kv(kc, rep), repeat_kv(vc, rep)
         valid = jnp.arange(kf.shape[2])[None, None, None, :] <= pos
+    elif attn_kernel == "pallas":
+        if kv_scales is None:
+            from quintnet_tpu.nn.attention import paged_cache_update
+            from quintnet_tpu.ops.paged_attention import paged_attention
+
+            kc, vc = paged_cache_update(
+                kc, vc, k[:, :, 0].astype(kc.dtype),
+                v[:, :, 0].astype(vc.dtype), pos,
+                block_tables=block_tables, block_size=block_size)
+            o = paged_attention(q, kc, vc, block_tables, pos,
+                                block_size=block_size)
+        else:
+            from quintnet_tpu.nn.attention import _paged_attention_scaled
+
+            ks, vs = kv_scales
+            o, kc, vc, ks, vs = _paged_attention_scaled(
+                policy, kc, vc, ks, vs, q, k, v, pos[:, None],
+                jnp.ones(pos.shape, jnp.int32), block_tables,
+                block_size=block_size, max_blocks=1)
+            pools = (kc, vc, ks, vs)
     elif kv_scales is None:
-        from quintnet_tpu.nn.attention import paged_cache_update, paged_gather
+        from quintnet_tpu.nn.attention import (_gather_kv,
+                                               paged_cache_update)
 
         kc, vc = paged_cache_update(
             kc, vc, k[:, :, 0].astype(kc.dtype), v[:, :, 0].astype(vc.dtype),
             pos, block_tables=block_tables, block_size=block_size)
-        kg = paged_gather(kc, block_tables, block_size=block_size)
-        vg = paged_gather(vc, block_tables, block_size=block_size)
+        kg, vg = _gather_kv(kc, vc, None, policy, block_tables,
+                            block_size=block_size)
         rep = q.shape[1] // kg.shape[1]
         kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
         valid = (jnp.arange(kf.shape[2])[None, :]
                  <= pos[:, None])[:, None, None, :]
     else:
-        from quintnet_tpu.nn.attention import (paged_gather_dequant,
+        from quintnet_tpu.nn.attention import (_gather_kv,
                                                paged_quant_update)
 
         ks, vs = kv_scales
-        kg = paged_gather_dequant(policy, kc, ks, block_tables,
-                                  block_size=block_size)
-        vg = paged_gather_dequant(policy, vc, vs, block_tables,
-                                  block_size=block_size)
+        kg, vg = _gather_kv(kc, vc, (ks, vs), policy, block_tables,
+                            block_size=block_size)
         ones = jnp.ones(pos.shape, jnp.int32)
         kc, ks, kg = paged_quant_update(
             policy, kc, ks, kg, k, pos[:, None], ones,
@@ -681,11 +759,14 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
         kf, vf = repeat_kv(kg, rep), repeat_kv(vg, rep)
         valid = (jnp.arange(kf.shape[2])[None, :]
                  <= pos[:, None])[:, None, None, :]
-    scores = (jnp.einsum("bhqd,bhtd->bhqt", q, kf).astype(jnp.float32)
-              / math.sqrt(cfg.head_dim))
-    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
-    o = jnp.einsum("bhqt,bhtd->bhqd",
-                   jax.nn.softmax(scores, axis=-1).astype(q.dtype), vf)
+    if kf is not None:
+        scores = (jnp.einsum("bhqd,bhtd->bhqt", q,
+                             kf).astype(jnp.float32)
+                  / math.sqrt(cfg.head_dim))
+        scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+        o = jnp.einsum("bhqt,bhtd->bhqd",
+                       jax.nn.softmax(scores,
+                                      axis=-1).astype(q.dtype), vf)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
                             lora=attn_lora, lora_scale=lora_scale)
     x, _aux = llama_mlp_residual(

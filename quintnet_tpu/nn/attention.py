@@ -262,6 +262,56 @@ def paged_gather_dequant(policy, cache, scales, block_tables, *,
                                   block_size=block_size))
 
 
+def _gather_kv(k_cache, v_cache, kv_scales, policy, block_tables, *,
+               block_size: int):
+    """THE paired gathered-view read every paged attention entry point
+    shares (prefill / ring / verify / decode had four verbatim copies):
+    gather both pools' rows position-ordered and — under a scaled
+    layout policy — dequantize with their block scales
+    (:func:`paged_gather_dequant`; ``kv_scales=None`` is the plain
+    :func:`paged_gather` pair). Also the single seam the fused-kernel
+    dispatch (``attn_kernel="pallas"``, ops/paged_attention.py) plugs
+    into INSTEAD of — the Pallas path never calls this."""
+    ks, vs = kv_scales if kv_scales is not None else (None, None)
+    k_all = paged_gather_dequant(policy, k_cache, ks, block_tables,
+                                 block_size=block_size)
+    v_all = paged_gather_dequant(policy, v_cache, vs, block_tables,
+                                 block_size=block_size)
+    return k_all, v_all
+
+
+def _paged_attention_scaled(policy, k_cache, v_cache, ks, vs, q, k, v,
+                            positions, lens, block_tables, *,
+                            block_size: int, max_blocks: int):
+    """The scaled-policy fused-kernel step every pallas branch shares
+    (gpt2 + llama, decode/verify/prefill — six call sites, one calling
+    convention): score the exact f32 fresh run against the PRE-write
+    pool (ops/paged_attention.paged_attention with the fresh-kv
+    override — the oracle's post-insert view), then requantize only
+    the run's touched blocks, k and v symmetrically
+    (paged_quant_window_update — pool bytes byte-identical to the
+    gathered-view oracle's). ``positions`` [S, P] contiguous runs;
+    ``lens`` [S]. Returns (o, k_cache, v_cache, ks, vs) — a future
+    kernel-convention change (the Flash-Decoding evolution) edits
+    exactly here."""
+    from quintnet_tpu.ops.paged_attention import (
+        paged_attention, paged_quant_window_update)
+
+    o = paged_attention(q, k_cache, v_cache, block_tables,
+                        positions[:, 0], block_size=block_size,
+                        kv_scales=(ks, vs), policy=policy,
+                        fresh_kv=(k, v))
+    k_cache, ks = paged_quant_window_update(
+        policy, k_cache, ks, k, positions, lens,
+        block_tables=block_tables, block_size=block_size,
+        max_blocks=max_blocks)
+    v_cache, vs = paged_quant_window_update(
+        policy, v_cache, vs, v, positions, lens,
+        block_tables=block_tables, block_size=block_size,
+        max_blocks=max_blocks)
+    return o, k_cache, v_cache, ks, vs
+
+
 def paged_requant_scatter(policy, cache, scales, row_view, block_tables,
                           first_blk, last_pos, *, block_size: int,
                           max_blocks: int):
@@ -384,7 +434,8 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                       num_heads: int, tp_axis: Optional[str] = None,
                       block_tables=None, block_size: Optional[int] = None,
                       lora=None, lora_scale=None,
-                      kv_scales=None, policy=None):
+                      kv_scales=None, policy=None,
+                      attn_kernel: str = "xla"):
     """Chunked prefill over the paged pool: attention for ONE request's
     uncached tail, reading the cached prefix from pool blocks.
 
@@ -411,7 +462,15 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
     policy reads the row via gather + DEQUANT, inserts the tail into
     the f32 view, runs the identical score math, and quantizes the
     touched blocks back on scatter; the return grows to
-    (y, k_cache, v_cache, k_scale, v_scale)."""
+    (y, k_cache, v_cache, k_scale, v_scale).
+
+    ``attn_kernel``: "xla" (default) is the gathered-view math above;
+    "pallas" routes the attention through the fused block-table-walking
+    kernel (ops/paged_attention.py) — same mask, same softmax sequence,
+    bit-parity-pinned against this path — and under a scaled policy the
+    pool write requantizes only the touched blocks
+    (paged_quant_window_update) so the [H, M*bs, Dh] gathered view is
+    never materialized."""
     qkv = linear_apply(p["qkv"], x)  # [1, P, 3*D_local]
     if lora is not None and "qkv" in lora:
         qkv = qkv + lora_delta(x, lora["qkv"], lora_scale)
@@ -419,41 +478,62 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
-    if kv_scales is None:
-        k_cache, v_cache = paged_prefill_update(
-            k_cache, v_cache, k[0], v[0], positions, tail_len,
-            block_tables=block_tables, block_size=block_size)
-        k_all = paged_gather(k_cache, block_tables[None],
-                             block_size=block_size)   # [1, H, M*bs, Dh]
-        v_all = paged_gather(v_cache, block_tables[None],
-                             block_size=block_size)
-    else:
-        ks, vs = kv_scales
+    ks = vs = None
+    if attn_kernel == "pallas":
         tables = block_tables[None]
-        k_all = paged_gather_dequant(policy, k_cache, ks, tables,
-                                     block_size=block_size)
-        v_all = paged_gather_dequant(policy, v_cache, vs, tables,
-                                     block_size=block_size)
-        span = _quant_span(positions.shape[0], block_size,
-                           block_tables.shape[0])
-        pos2 = positions[None, :]
-        lens = jnp.reshape(tail_len, (1,))
-        k_cache, ks, k_all = paged_quant_update(
-            policy, k_cache, ks, k_all, k, pos2, lens,
-            block_tables=tables, block_size=block_size, max_blocks=span)
-        v_cache, vs, v_all = paged_quant_update(
-            policy, v_cache, vs, v_all, v, pos2, lens,
-            block_tables=tables, block_size=block_size, max_blocks=span)
-    valid = (jnp.arange(k_all.shape[2])[None, :]
-             <= positions[:, None])               # [P, M*bs]
+        if kv_scales is None:
+            from quintnet_tpu.ops.paged_attention import paged_attention
 
-    dh = q.shape[-1]
-    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_all).astype(jnp.float32)
-    scores = scores / math.sqrt(dh)
-    scores = jnp.where(valid[None, None], scores,
-                       jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
+            k_cache, v_cache = paged_prefill_update(
+                k_cache, v_cache, k[0], v[0], positions, tail_len,
+                block_tables=block_tables, block_size=block_size)
+            o = paged_attention(q, k_cache, v_cache, tables,
+                                positions[:1], block_size=block_size)
+        else:
+            ks, vs = kv_scales
+            o, k_cache, v_cache, ks, vs = _paged_attention_scaled(
+                policy, k_cache, v_cache, ks, vs, q, k, v,
+                positions[None, :], jnp.reshape(tail_len, (1,)),
+                tables, block_size=block_size,
+                max_blocks=_quant_span(positions.shape[0], block_size,
+                                       block_tables.shape[0]))
+    else:
+        if kv_scales is None:
+            k_cache, v_cache = paged_prefill_update(
+                k_cache, v_cache, k[0], v[0], positions, tail_len,
+                block_tables=block_tables, block_size=block_size)
+            k_all, v_all = _gather_kv(
+                k_cache, v_cache, None, policy, block_tables[None],
+                block_size=block_size)            # [1, H, M*bs, Dh]
+        else:
+            ks, vs = kv_scales
+            tables = block_tables[None]
+            k_all, v_all = _gather_kv(k_cache, v_cache, (ks, vs),
+                                      policy, tables,
+                                      block_size=block_size)
+            span = _quant_span(positions.shape[0], block_size,
+                               block_tables.shape[0])
+            pos2 = positions[None, :]
+            lens = jnp.reshape(tail_len, (1,))
+            k_cache, ks, k_all = paged_quant_update(
+                policy, k_cache, ks, k_all, k, pos2, lens,
+                block_tables=tables, block_size=block_size,
+                max_blocks=span)
+            v_cache, vs, v_all = paged_quant_update(
+                policy, v_cache, vs, v_all, v, pos2, lens,
+                block_tables=tables, block_size=block_size,
+                max_blocks=span)
+        valid = (jnp.arange(k_all.shape[2])[None, :]
+                 <= positions[:, None])               # [P, M*bs]
+
+        dh = q.shape[-1]
+        scores = jnp.einsum("bhsd,bhtd->bhst", q,
+                            k_all).astype(jnp.float32)
+        scores = scores / math.sqrt(dh)
+        scores = jnp.where(valid[None, None], scores,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
@@ -545,10 +625,9 @@ def ring_paged_prefill(q, k, v, start, t0, k_cache, v_cache, *,
     ks = vs = None
     if kv_scales is not None:
         ks, vs = kv_scales
-    k_pool = paged_gather_dequant(policy, k_cache, ks, block_tables[None],
-                                  block_size=block_size)
-    v_pool = paged_gather_dequant(policy, v_cache, vs, block_tables[None],
-                                  block_size=block_size)
+    k_pool, v_pool = _gather_kv(k_cache, v_cache, kv_scales, policy,
+                                block_tables[None],
+                                block_size=block_size)
     pool_mask = jnp.broadcast_to(
         jnp.arange(k_pool.shape[2])[None, :] < start,
         (pl, k_pool.shape[2]))
@@ -676,7 +755,8 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
                      num_heads: int, tp_axis: Optional[str] = None,
                      block_tables=None, block_size: Optional[int] = None,
                      lora=None, lora_scale=None,
-                     kv_scales=None, policy=None):
+                     kv_scales=None, policy=None,
+                     attn_kernel: str = "xla"):
     """Batched draft-verify attention over the paged pool: EVERY slot
     scores a short run of tokens (its last sampled token + up to k
     drafted continuations) against its own cached row in ONE forward —
@@ -697,7 +777,9 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
     Returns (y [S, P, D], k_cache, v_cache). ``num_heads`` is LOCAL
     heads under ``tp_axis`` (head-sharded pool + RowParallel psum).
     ``lora``/``lora_scale``: per-slot packed adapters, exactly as in
-    :func:`mha_decode`."""
+    :func:`mha_decode`. ``attn_kernel="pallas"``: the fused
+    block-table-walking kernel instead of the gathered view (exactly
+    :func:`mha_prefill_paged`'s contract, batched over rows)."""
     qkv = linear_apply(p["qkv"], x)  # [S, P, 3*D_local]
     if lora is not None and "qkv" in lora:
         qkv = qkv + lora_delta(x, lora["qkv"], lora_scale)
@@ -705,37 +787,58 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
-    if kv_scales is None:
-        k_cache, v_cache = paged_verify_update(
-            k_cache, v_cache, k, v, positions, tail_lens,
-            block_tables=block_tables, block_size=block_size)
-        k_all = paged_gather(k_cache, block_tables, block_size=block_size)
-        v_all = paged_gather(v_cache, block_tables, block_size=block_size)
-    else:
-        ks, vs = kv_scales
-        k_all = paged_gather_dequant(policy, k_cache, ks, block_tables,
-                                     block_size=block_size)
-        v_all = paged_gather_dequant(policy, v_cache, vs, block_tables,
-                                     block_size=block_size)
-        span = _quant_span(positions.shape[1], block_size,
-                           block_tables.shape[1])
-        k_cache, ks, k_all = paged_quant_update(
-            policy, k_cache, ks, k_all, k, positions, tail_lens,
-            block_tables=block_tables, block_size=block_size,
-            max_blocks=span)
-        v_cache, vs, v_all = paged_quant_update(
-            policy, v_cache, vs, v_all, v, positions, tail_lens,
-            block_tables=block_tables, block_size=block_size,
-            max_blocks=span)
-    valid = (jnp.arange(k_all.shape[2])[None, None, :]
-             <= positions[:, :, None])                # [S, P, T]
+    ks = vs = None
+    if attn_kernel == "pallas":
+        if kv_scales is None:
+            from quintnet_tpu.ops.paged_attention import paged_attention
 
-    dh = q.shape[-1]
-    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_all).astype(jnp.float32)
-    scores = scores / math.sqrt(dh)
-    scores = jnp.where(valid[:, None], scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
+            k_cache, v_cache = paged_verify_update(
+                k_cache, v_cache, k, v, positions, tail_lens,
+                block_tables=block_tables, block_size=block_size)
+            o = paged_attention(q, k_cache, v_cache, block_tables,
+                                positions[:, 0], block_size=block_size)
+        else:
+            ks, vs = kv_scales
+            o, k_cache, v_cache, ks, vs = _paged_attention_scaled(
+                policy, k_cache, v_cache, ks, vs, q, k, v,
+                positions, tail_lens, block_tables,
+                block_size=block_size,
+                max_blocks=_quant_span(positions.shape[1], block_size,
+                                       block_tables.shape[1]))
+    else:
+        if kv_scales is None:
+            k_cache, v_cache = paged_verify_update(
+                k_cache, v_cache, k, v, positions, tail_lens,
+                block_tables=block_tables, block_size=block_size)
+            k_all, v_all = _gather_kv(k_cache, v_cache, None, policy,
+                                      block_tables,
+                                      block_size=block_size)
+        else:
+            ks, vs = kv_scales
+            k_all, v_all = _gather_kv(k_cache, v_cache, (ks, vs),
+                                      policy, block_tables,
+                                      block_size=block_size)
+            span = _quant_span(positions.shape[1], block_size,
+                               block_tables.shape[1])
+            k_cache, ks, k_all = paged_quant_update(
+                policy, k_cache, ks, k_all, k, positions, tail_lens,
+                block_tables=block_tables, block_size=block_size,
+                max_blocks=span)
+            v_cache, vs, v_all = paged_quant_update(
+                policy, v_cache, vs, v_all, v, positions, tail_lens,
+                block_tables=block_tables, block_size=block_size,
+                max_blocks=span)
+        valid = (jnp.arange(k_all.shape[2])[None, None, :]
+                 <= positions[:, :, None])                # [S, P, T]
+
+        dh = q.shape[-1]
+        scores = jnp.einsum("bhsd,bhtd->bhst", q,
+                            k_all).astype(jnp.float32)
+        scores = scores / math.sqrt(dh)
+        scores = jnp.where(valid[:, None], scores,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])
@@ -754,7 +857,8 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                tp_axis: Optional[str] = None,
                block_tables=None, block_size: Optional[int] = None,
                lora=None, lora_scale=None,
-               kv_scales=None, policy=None):
+               kv_scales=None, policy=None,
+               attn_kernel: str = "xla"):
     """Single-token cached attention. Returns (y, k_cache, v_cache).
 
     Dense (single-request fast path, ``block_tables=None``): x [B, 1, D],
@@ -786,7 +890,12 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
     ``lora``/``lora_scale``: per-slot packed adapters (multi-tenant
     LoRA serving, serve/adapters.py) — row s applies ITS adapter's
     low-rank delta on the qkv and proj matmuls (nn/layers.lora_delta);
-    zero-adapter rows are base-model rows exactly."""
+    zero-adapter rows are base-model rows exactly.
+
+    ``attn_kernel="pallas"`` (paged path only): the fused
+    block-table-walking kernel (ops/paged_attention.py) instead of the
+    gathered-view math — bit-parity-pinned, never materializes the
+    [B, H, M*bs, Dh] view."""
     qkv = linear_apply(p["qkv"], x)  # [B, 1, 3D]
     if lora is not None and "qkv" in lora:
         qkv = qkv + lora_delta(x, lora["qkv"], lora_scale)
@@ -794,22 +903,43 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
     q = rearrange(q, "b s (h d) -> b h s d", h=num_heads)
     k = rearrange(k, "b s (h d) -> b h s d", h=num_heads)
     v = rearrange(v, "b s (h d) -> b h s d", h=num_heads)
+    ks = vs = None
     if block_tables is None:
         if kv_scales is not None:
             raise ValueError(
                 "scaled KV layout policies exist only for the paged "
                 "pool (block_tables is required)")
+        if attn_kernel != "xla":
+            raise ValueError(
+                "attn_kernel='pallas' exists only for the paged pool "
+                "(block_tables is required)")
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
         k_all, v_all = k_cache, v_cache
         valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, :]  # [1, T]
+    elif attn_kernel == "pallas":
+        if kv_scales is None:
+            from quintnet_tpu.ops.paged_attention import paged_attention
+
+            k_cache, v_cache = paged_cache_update(
+                k_cache, v_cache, k[:, :, 0], v[:, :, 0], pos,
+                block_tables=block_tables, block_size=block_size)
+            o = paged_attention(q, k_cache, v_cache, block_tables, pos,
+                                block_size=block_size)
+        else:
+            ks, vs = kv_scales
+            o, k_cache, v_cache, ks, vs = _paged_attention_scaled(
+                policy, k_cache, v_cache, ks, vs, q, k, v,
+                pos[:, None], jnp.ones(pos.shape, jnp.int32),
+                block_tables, block_size=block_size, max_blocks=1)
+        k_all = None
     elif kv_scales is None:
         # pool layout is [slot, H, Dh]: k here is [B, H, 1, Dh]
         k_cache, v_cache = paged_cache_update(
             k_cache, v_cache, k[:, :, 0], v[:, :, 0], pos,
             block_tables=block_tables, block_size=block_size)
-        k_all = paged_gather(k_cache, block_tables, block_size=block_size)
-        v_all = paged_gather(v_cache, block_tables, block_size=block_size)
+        k_all, v_all = _gather_kv(k_cache, v_cache, None, policy,
+                                  block_tables, block_size=block_size)
         valid = jnp.arange(k_all.shape[2])[None, :] <= pos[:, None]
     else:
         # scaled layout (serve/kv_quant.py): dequantized gathered view,
@@ -817,10 +947,8 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
         # back — inactive rows (pos 0, null table) round-trip the null
         # block, which nobody reads
         ks, vs = kv_scales
-        k_all = paged_gather_dequant(policy, k_cache, ks, block_tables,
-                                     block_size=block_size)
-        v_all = paged_gather_dequant(policy, v_cache, vs, block_tables,
-                                     block_size=block_size)
+        k_all, v_all = _gather_kv(k_cache, v_cache, (ks, vs), policy,
+                                  block_tables, block_size=block_size)
         ones = jnp.ones(pos.shape, jnp.int32)
         k_cache, ks, k_all = paged_quant_update(
             policy, k_cache, ks, k_all, k, pos[:, None], ones,
@@ -832,13 +960,15 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
             max_blocks=1)
         valid = jnp.arange(k_all.shape[2])[None, :] <= pos[:, None]
 
-    dh = q.shape[-1]
-    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_all).astype(jnp.float32)
-    scores = scores / math.sqrt(dh)
-    scores = jnp.where(valid[:, None, None, :], scores,
-                       jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
+    if k_all is not None:
+        dh = q.shape[-1]
+        scores = jnp.einsum("bhsd,bhtd->bhst", q,
+                            k_all).astype(jnp.float32)
+        scores = scores / math.sqrt(dh)
+        scores = jnp.where(valid[:, None, None, :], scores,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
 
     o = rearrange(o, "b h s d -> b s (h d)")
     y = jnp.dot(o, p["proj"]["w"])

@@ -265,7 +265,8 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                         block_tables=None,
                         block_size: Optional[int] = None,
                         lora=None, lora_scale=None,
-                        kv_scales=None, policy=None):
+                        kv_scales=None, policy=None,
+                        attn_kernel: str = "xla"):
     """Chunked-prefill block step over the paged pool (nn/attention.py
     mha_prefill_paged): x [1, P, D] tail hidden states at absolute
     ``positions``, caches are flat pool views — the serve engine's
@@ -280,7 +281,7 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
         positions, tail_len, num_heads=num_heads, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size,
         lora=attn_lora, lora_scale=lora_scale,
-        kv_scales=kv_scales, policy=policy)
+        kv_scales=kv_scales, policy=policy, attn_kernel=attn_kernel)
     x = x + out[0]
     return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
                        tp_axis=tp_axis,
@@ -320,7 +321,8 @@ def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
                        block_tables=None,
                        block_size: Optional[int] = None,
                        lora=None, lora_scale=None,
-                       kv_scales=None, policy=None):
+                       kv_scales=None, policy=None,
+                       attn_kernel: str = "xla"):
     """Batched draft-verify block step (nn/attention.mha_verify_paged):
     x [S, P, D] per-slot token runs at absolute ``positions`` [S, P],
     caches are flat pool views — the serve engine's speculative-decode
@@ -334,7 +336,7 @@ def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
         positions, tail_lens, num_heads=num_heads, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size,
         lora=attn_lora, lora_scale=lora_scale,
-        kv_scales=kv_scales, policy=policy)
+        kv_scales=kv_scales, policy=policy, attn_kernel=attn_kernel)
     x = x + out[0]
     return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
                        tp_axis=tp_axis,
@@ -348,7 +350,8 @@ def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                  tp_axis: Optional[str] = None,
                  block_tables=None, block_size: Optional[int] = None,
                  lora=None, lora_scale=None,
-                 kv_scales=None, policy=None):
+                 kv_scales=None, policy=None,
+                 attn_kernel: str = "xla"):
     """Single-token cached block step (nn/attention.py mha_decode).
 
     With ``block_tables``/``block_size`` the caches are paged-pool flat
@@ -364,7 +367,7 @@ def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
         num_heads=num_heads, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size,
         lora=attn_lora, lora_scale=lora_scale,
-        kv_scales=kv_scales, policy=policy)
+        kv_scales=kv_scales, policy=policy, attn_kernel=attn_kernel)
     x = x + out[0]
     return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
                        tp_axis=tp_axis,

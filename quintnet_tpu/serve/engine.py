@@ -196,6 +196,7 @@ class ServeEngine:
                  chunked_prefill: bool = False,
                  prefill_chunk_budget: Optional[int] = None,
                  kv_dtype=None,
+                 attn_kernel: str = "xla",
                  logger=None, log_every: int = 0,
                  clock=time.monotonic,
                  tracer=None, recorder=None):
@@ -245,6 +246,30 @@ class ServeEngine:
                 mesh is not None and tp_axis not in mesh.shape):
             # sp-only mesh: params/pool replicated, no tp collectives
             self.tp_axis = None
+        # attention backend (ops/paged_attention.py): "xla" is the
+        # gathered-view reference oracle (default — also the fallback
+        # story where Pallas is unavailable), "pallas" the fused
+        # block-table-walking kernel, bit-parity-pinned against it
+        # (tests/test_paged_attention.py). Same program ladder, same
+        # compile bounds, same collective census either way.
+        if attn_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown attn_kernel {attn_kernel!r}; expected 'xla' "
+                f"or 'pallas'")
+        if attn_kernel == "pallas":
+            from quintnet_tpu.ops.paged_attention import _HAVE_PLTPU
+
+            if not _HAVE_PLTPU:
+                raise RuntimeError(
+                    "attn_kernel='pallas' needs "
+                    "jax.experimental.pallas.tpu, which this jax "
+                    "install does not provide — use attn_kernel='xla'")
+        if attn_kernel == "pallas" and self.sp_axis is not None:
+            raise NotImplementedError(
+                "attn_kernel='pallas' does not yet compose with "
+                "sequence-parallel prefill (the ring path is XLA-only)"
+                " — drop sp_axis or use attn_kernel='xla'")
+        self.attn_kernel = attn_kernel
         self.logger = logger
         self.log_every = int(log_every)
         self.clock = clock
@@ -514,6 +539,7 @@ class ServeEngine:
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
         sp_axis = self.sp_axis
+        attn_kernel = self.attn_kernel
         use_lora = self.adapters is not None
         policy = self.kv_policy
         scaled = policy.scaled
@@ -558,7 +584,7 @@ class ServeEngine:
                     params, k_pool, v_pool, ids, start, t0, table_row,
                     bs, tp_axis=tp_axis, lora=lora,
                     lora_scale=lora_scale, kv_scales=kv_scales,
-                    policy=policy)
+                    policy=policy, attn_kernel=attn_kernel)
             else:
                 # sequence-parallel chunk: ids arrives as this rank's
                 # [1, P/sp] slice (the shard_map below splits dim 1);
@@ -582,6 +608,7 @@ class ServeEngine:
     def _build_decode(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        attn_kernel = self.attn_kernel
         use_lora = self.adapters is not None
         policy = self.kv_policy
         scaled = policy.scaled
@@ -595,7 +622,7 @@ class ServeEngine:
                 params, k_pool, v_pool, tok, pos, tables, bs,
                 tp_axis=tp_axis, lora=lora, lora_scale=lora_scale,
                 kv_scales=(k_scale, v_scale) if scaled else None,
-                policy=policy)
+                policy=policy, attn_kernel=attn_kernel)
             logits, pools = out[0], out[1:]
             keys = jax.random.wrap_key_data(key_data)
             pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
@@ -619,6 +646,7 @@ class ServeEngine:
         is bit-identical to plain decoding (greedy AND sampled)."""
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        attn_kernel = self.attn_kernel
         use_lora = self.adapters is not None
         policy = self.kv_policy
         scaled = policy.scaled
@@ -633,7 +661,7 @@ class ServeEngine:
                 bs, tp_axis=tp_axis, lora=lora,
                 lora_scale=lora_scale,
                 kv_scales=(k_scale, v_scale) if scaled else None,
-                policy=policy)
+                policy=policy, attn_kernel=attn_kernel)
             logits, pools = out[0], out[1:]               # [S, P, V]
             P = ids.shape[1]
 

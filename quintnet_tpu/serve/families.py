@@ -50,6 +50,15 @@ block bodies dequantize inside the gathered view and quantize on
 scatter; ``kv_scales=None`` (the passthrough policies) is
 byte-identical to the pre-policy programs.
 
+Attention backend (ops/paged_attention.py): every contract
+additionally takes ``attn_kernel="xla"`` — "xla" is the gathered-view
+math above (the reference oracle), "pallas" routes each block's paged
+attention through the fused block-table-walking kernel
+(bit-parity-pinned, tests/test_paged_attention.py). The contract
+surface, collective census, and compile-count bounds are identical for
+both backends; the sp path stays XLA-only (the engine rejects the
+combination).
+
 Multi-tenant LoRA (serve/adapters.py): every contract additionally
 takes ``lora=None, lora_scale=None`` — a nested pytree of PACKED
 per-slot adapter factors, one ``{"a": [L, S_or_1, in, r], "b": [L,
@@ -150,7 +159,7 @@ def gpt2_family(cfg) -> Family:
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
                      block_size, tp_axis=None, lora=None, lora_scale=None,
-                     kv_scales=None, policy=None):
+                     kv_scales=None, policy=None, attn_kernel="xla"):
         B, P = ids.shape
         emb = params["embedding"]
         positions = start + jnp.arange(P, dtype=jnp.int32)
@@ -169,7 +178,7 @@ def gpt2_family(cfg) -> Family:
                 act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
                 block_tables=table_row, block_size=block_size,
                 lora=lr, lora_scale=lora_scale,
-                kv_scales=sc, policy=policy)
+                kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
             return out[0], out[1:]
 
         h, pools = lax.scan(
@@ -180,7 +189,7 @@ def gpt2_family(cfg) -> Family:
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
                tp_axis=None, lora=None, lora_scale=None,
-               kv_scales=None, policy=None):
+               kv_scales=None, policy=None, attn_kernel="xla"):
         emb = params["embedding"]
         x = (_embed_tok(emb, tok[:, None], cfg, tp_axis)
              + jnp.take(emb["wpe"], pos, axis=0)[:, None, :])
@@ -194,7 +203,8 @@ def gpt2_family(cfg) -> Family:
                                tp_axis=tp_axis, block_tables=tables,
                                block_size=block_size,
                                lora=lr, lora_scale=lora_scale,
-                               kv_scales=sc, policy=policy)
+                               kv_scales=sc, policy=policy,
+                               attn_kernel=attn_kernel)
             return out[0], out[1:]
 
         h, pools = lax.scan(
@@ -204,7 +214,7 @@ def gpt2_family(cfg) -> Family:
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
                block_size, tp_axis=None, lora=None, lora_scale=None,
-               kv_scales=None, policy=None):
+               kv_scales=None, policy=None, attn_kernel="xla"):
         S, P = ids.shape
         emb = params["embedding"]
         positions = (starts[:, None]
@@ -222,7 +232,7 @@ def gpt2_family(cfg) -> Family:
                 act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
                 block_tables=tables, block_size=block_size,
                 lora=lr, lora_scale=lora_scale,
-                kv_scales=sc, policy=policy)
+                kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
             return out[0], out[1:]
 
         h, pools = lax.scan(
@@ -301,7 +311,7 @@ def llama_family(cfg) -> Family:
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
                      block_size, tp_axis=None, lora=None, lora_scale=None,
-                     kv_scales=None, policy=None):
+                     kv_scales=None, policy=None, attn_kernel="xla"):
         B, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)
         positions = start + jnp.arange(P, dtype=jnp.int32)
@@ -315,7 +325,7 @@ def llama_family(cfg) -> Family:
                 blk, x, kc, vc, positions, tail_len, cfg, cos, sin,
                 tp_axis=tp_axis, block_tables=table_row,
                 block_size=block_size, lora=lr, lora_scale=lora_scale,
-                kv_scales=sc, policy=policy)
+                kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
             return x, pools
 
         h, pools = lax.scan(
@@ -327,7 +337,7 @@ def llama_family(cfg) -> Family:
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
                tp_axis=None, lora=None, lora_scale=None,
-               kv_scales=None, policy=None):
+               kv_scales=None, policy=None, attn_kernel="xla"):
         x = _embed(params, tok[:, None], cfg, tp_axis)        # [S, 1, D]
         cos, sin = llama_rope_tables(pos, cfg)                # [S, hd]
         cos, sin = cos[:, None, None, :], sin[:, None, None, :]
@@ -339,7 +349,7 @@ def llama_family(cfg) -> Family:
                 blk, h, kc, vc, pos, cfg, cos, sin, tp_axis=tp_axis,
                 block_tables=tables, block_size=block_size,
                 lora=lr, lora_scale=lora_scale,
-                kv_scales=sc, policy=policy)
+                kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
             return h, pools
 
         h, pools = lax.scan(
@@ -349,7 +359,7 @@ def llama_family(cfg) -> Family:
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
                block_size, tp_axis=None, lora=None, lora_scale=None,
-               kv_scales=None, policy=None):
+               kv_scales=None, policy=None, attn_kernel="xla"):
         S, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)                 # [S, P, D]
         positions = (starts[:, None]
@@ -364,7 +374,7 @@ def llama_family(cfg) -> Family:
                 blk, x, kc, vc, positions, tail_lens, cfg, cos, sin,
                 tp_axis=tp_axis, block_tables=tables,
                 block_size=block_size, lora=lr, lora_scale=lora_scale,
-                kv_scales=sc, policy=policy)
+                kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
             return x, pools
 
         h, pools = lax.scan(

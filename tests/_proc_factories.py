@@ -21,7 +21,8 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                     n_positions=None, prefill_len=None,
                     chunked_prefill: bool = False,
                     prefill_chunk_budget=None,
-                    kv_dtype=None, prefix_cache: bool = True):
+                    kv_dtype=None, prefix_cache: bool = True,
+                    attn_kernel: str = "xla"):
     from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
     from quintnet_tpu.serve import ServeEngine, gpt2_family
 
@@ -35,5 +36,5 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                        chunked_prefill=chunked_prefill,
                        prefill_chunk_budget=prefill_chunk_budget,
                        kv_dtype=kv_dtype, prefix_cache=prefix_cache,
-                       temperature=temperature,
+                       attn_kernel=attn_kernel, temperature=temperature,
                        top_k=top_k, eos_token_id=eos_token_id)

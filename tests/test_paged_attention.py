@@ -1,0 +1,488 @@
+"""Fused paged-attention Pallas kernels (ops/paged_attention.py) vs
+the XLA gathered-view oracle.
+
+The contract ladder:
+
+1. **Kernel parity matrix** — the real nn/attention entry points
+   (``mha_decode`` / ``mha_verify_paged`` / ``mha_prefill_paged``) run
+   once per backend from identical pool state, across every
+   ``kv_layout_policies`` entry x verify bucket widths x chunked
+   prefill offsets, in CPU interpret mode: outputs BIT-exact for
+   f32/fake_quant, within the pinned tolerance for bf16/int8 (the
+   observed diff is 0.0 — the kernel mirrors the oracle's op
+   sequence — but only the passthrough-f32 and identity-scale cases
+   are *guaranteed* exact by construction, so the quantized dtypes pin
+   a bound instead of a bit pattern), and POOL BYTES + SCALES exactly
+   equal everywhere (the write paths are one math).
+2. **GQA** — the same matrix through the llama blocks (4 query heads
+   on 2 kv heads): the kernel resolves the repeat in its index maps.
+3. **Engine goldens** — ``ServeEngine(attn_kernel="pallas")`` serves
+   prefix-cache, speculative-decode, chunked-prefill, preemption and
+   tp=2 traffic TOKEN-IDENTICAL to ``attn_kernel="xla"``, greedy and
+   sampled, f32 and int8, gpt2 and llama.
+4. **Structural win** — the jaxpr auditor
+   (analysis.gathered_view_gathers) proves the pallas programs issue
+   ZERO full-row block-table gathers where the xla ones issue 2-4 per
+   layer; compile counts and sentinels are unchanged per backend.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quintnet_tpu.analysis import gathered_view_gathers
+from quintnet_tpu.analysis.specs import attn_kernels, kv_layout_policies
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.serve import ServeEngine, SpecConfig, gpt2_family
+from quintnet_tpu.serve.kv_quant import make_policy
+
+CFG = GPT2Config.tiny(n_layer=2)
+
+# quantized-dtype tolerance: the kernel mirrors the oracle op for op,
+# so the OBSERVED diff is 0.0; the pin leaves headroom only for
+# platform-lowering drift in ops that are not exact by construction
+QUANT_ATOL = 1e-6
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+# ---------------------------------------------------------------------
+# 1. kernel parity matrix through the real mha entry points
+# ---------------------------------------------------------------------
+
+H, D, BS, M, NB = 2, 8, 4, 6, 20        # geometry: M collides with no
+S = 3                                   # other dim (auditor contract)
+
+
+def _mha_params(key):
+    from quintnet_tpu.nn.attention import mha_init
+
+    return mha_init(key, H * D)
+
+
+def _pool(policy):
+    k = jnp.zeros((NB * BS, H, D), policy.store_dtype)
+    v = jnp.zeros((NB * BS, H, D), policy.store_dtype)
+    if policy.scaled:
+        return [k, v, jnp.ones((NB, H), jnp.float32),
+                jnp.ones((NB, H), jnp.float32)]
+    return [k, v, None, None]
+
+
+def _scales(pool):
+    return (pool[2], pool[3]) if pool[2] is not None else None
+
+
+def _tables():
+    # disjoint per-row tables; block 0 stays the null block
+    return jnp.asarray([[1 + s * M + m for m in range(M)]
+                        for s in range(S)], jnp.int32)
+
+
+def _assert_pools_match(pa, pb, policy, tables):
+    """Pool bytes + scales bit-equal on every REAL block (the null
+    block legitimately collects both backends' masked-pad scatters)."""
+    real = np.asarray(tables).reshape(-1)
+    for a, b in zip(pa[:2], pb[:2]):
+        ra = np.asarray(a).reshape(NB, BS, H, D)[real]
+        rb = np.asarray(b).reshape(NB, BS, H, D)[real]
+        np.testing.assert_array_equal(ra, rb)
+    if policy.scaled:
+        for a, b in zip(pa[2:], pb[2:]):
+            np.testing.assert_array_equal(np.asarray(a)[real],
+                                          np.asarray(b)[real])
+
+
+def _assert_out(ya, yb, policy):
+    ya, yb = np.asarray(ya), np.asarray(yb)
+    if policy.name in ("f32", "fake_quant"):
+        np.testing.assert_array_equal(ya, yb)
+    else:
+        np.testing.assert_allclose(ya, yb, atol=QUANT_ATOL, rtol=0)
+
+
+class TestMhaParityMatrix:
+    """Each scenario runs the SAME op sequence per backend from the
+    same initial pool, twice back to back (history accumulates across
+    the calls, covering requant-on-top-of-requant)."""
+
+    @pytest.fixture(scope="class")
+    def attn(self):
+        return _mha_params(jax.random.key(1))
+
+    def _run_verify(self, attn, policy, kernel, P, steps=2):
+        from quintnet_tpu.nn.attention import mha_verify_paged
+
+        rng = np.random.default_rng(7)
+        pool = _pool(policy)
+        tables = _tables()
+        starts = np.asarray([5, 0, 11], np.int32)
+        outs = []
+        for it in range(steps):
+            x = jnp.asarray(rng.standard_normal((S, P, H * D)),
+                            jnp.float32)
+            positions = jnp.asarray(starts)[:, None] + jnp.arange(
+                P, dtype=jnp.int32)[None, :]
+            tail_lens = jnp.asarray([P, max(P - 1, 1), P], jnp.int32)
+            kv = _scales(pool)
+            out = jax.jit(
+                lambda x, kp, vp, ks, vs: mha_verify_paged(
+                    attn, x, kp, vp, positions, tail_lens,
+                    num_heads=H, block_tables=tables, block_size=BS,
+                    kv_scales=(ks, vs) if ks is not None else None,
+                    policy=policy if kv is not None else None,
+                    attn_kernel=kernel)
+            )(x, pool[0], pool[1], pool[2], pool[3])
+            outs.append(out[0])
+            pool = list(out[1:]) + ([None, None] if kv is None else [])
+            starts = starts + np.asarray(tail_lens)
+        return outs, pool
+
+    @pytest.mark.parametrize("policy_name", kv_layout_policies())
+    @pytest.mark.parametrize("P", (1, 3, 5))
+    def test_verify_and_decode_widths(self, attn, policy_name, P):
+        """P=1 IS the decode shape; 3/5 are the verify buckets + 1."""
+        policy = make_policy(policy_name)
+        ya, pa = self._run_verify(attn, policy, "xla", P)
+        yb, pb = self._run_verify(attn, policy, "pallas", P)
+        for a, b in zip(ya, yb):
+            _assert_out(a, b, policy)
+        _assert_pools_match(pa, pb, policy, _tables())
+
+    def _run_prefill(self, attn, policy, kernel):
+        """Chunked prefill: one row, two chunks at dynamic offsets
+        (start 0 then 8) through the SAME bucket width — the
+        prefix-cache tail shape."""
+        from quintnet_tpu.nn.attention import mha_prefill_paged
+
+        rng = np.random.default_rng(9)
+        pool = _pool(policy)
+        tables = _tables()[0]
+        P = 8
+        outs = []
+        for start, tail in ((0, 8), (8, 5)):
+            x = jnp.asarray(rng.standard_normal((1, P, H * D)),
+                            jnp.float32)
+            positions = start + jnp.arange(P, dtype=jnp.int32)
+            kv = _scales(pool)
+            out = jax.jit(
+                lambda x, kp, vp, ks, vs: mha_prefill_paged(
+                    attn, x, kp, vp, positions, jnp.int32(tail),
+                    num_heads=H, block_tables=tables, block_size=BS,
+                    kv_scales=(ks, vs) if ks is not None else None,
+                    policy=policy if kv is not None else None,
+                    attn_kernel=kernel)
+            )(x, pool[0], pool[1], pool[2], pool[3])
+            outs.append(out[0])
+            pool = list(out[1:]) + ([None, None] if kv is None else [])
+        return outs, pool
+
+    @pytest.mark.parametrize("policy_name", kv_layout_policies())
+    def test_chunked_prefill_offsets(self, attn, policy_name):
+        policy = make_policy(policy_name)
+        ya, pa = self._run_prefill(attn, policy, "xla")
+        yb, pb = self._run_prefill(attn, policy, "pallas")
+        for a, b in zip(ya, yb):
+            _assert_out(a, b, policy)
+        _assert_pools_match(pa, pb, policy, _tables())
+
+
+# ---------------------------------------------------------------------
+# 2. GQA through the llama block (4 query heads on 2 kv heads)
+# ---------------------------------------------------------------------
+
+class TestGQAParity:
+    @pytest.mark.parametrize("policy_name", ("f32", "int8"))
+    @pytest.mark.parametrize("P", (1, 3))
+    def test_llama_verify_gqa(self, policy_name, P):
+        from quintnet_tpu.models.llama import (LlamaConfig, llama_init,
+                                               llama_block_verify_paged,
+                                               llama_rope_tables)
+
+        cfg = LlamaConfig.tiny()
+        assert cfg.n_heads != cfg.n_kv_heads  # the point of this test
+        policy = make_policy(policy_name)
+        params = llama_init(jax.random.key(2), cfg)
+        blk = jax.tree.map(lambda a: a[0], params["blocks"])
+        hkv, hd = cfg.n_kv_heads, cfg.head_dim
+        pool = [jnp.zeros((NB * BS, hkv, hd), policy.store_dtype),
+                jnp.zeros((NB * BS, hkv, hd), policy.store_dtype)]
+        if policy.scaled:
+            pool += [jnp.ones((NB, hkv), jnp.float32),
+                     jnp.ones((NB, hkv), jnp.float32)]
+        else:
+            pool += [None, None]
+        tables = _tables()
+        rng = np.random.default_rng(3)
+        starts = np.asarray([5, 0, 11], np.int32)
+        results = {}
+        for kernel in attn_kernels():
+            p = [jnp.array(a) if a is not None else None for a in pool]
+            outs = []
+            st = starts.copy()
+            rng2 = np.random.default_rng(3)
+            for it in range(2):
+                x = jnp.asarray(rng2.standard_normal((S, P, cfg.dim)),
+                                jnp.float32)
+                positions = (jnp.asarray(st)[:, None]
+                             + jnp.arange(P, dtype=jnp.int32)[None, :])
+                tails = jnp.asarray([P, max(P - 1, 1), P], jnp.int32)
+                cos, sin = llama_rope_tables(positions, cfg)
+                cos, sin = cos[:, None], sin[:, None]
+                kv = (p[2], p[3]) if p[2] is not None else None
+                out = jax.jit(
+                    lambda x, kp, vp, ks, vs: llama_block_verify_paged(
+                        blk, x, kp, vp, positions, tails, cfg, cos,
+                        sin, block_tables=tables, block_size=BS,
+                        kv_scales=(ks, vs) if ks is not None else None,
+                        policy=policy if kv is not None else None,
+                        attn_kernel=kernel)
+                )(x, p[0], p[1], p[2], p[3])
+                outs.append(out[0])
+                p = list(out[1]) + ([None, None] if kv is None else [])
+                st = st + np.asarray(tails)
+            results[kernel] = (outs, p)
+        (ya, pa), (yb, pb) = results["xla"], results["pallas"]
+        for a, b in zip(ya, yb):
+            _assert_out(a, b, policy)
+        real = np.asarray(tables).reshape(-1)
+        for a, b in zip(pa[:2], pb[:2]):
+            np.testing.assert_array_equal(
+                np.asarray(a).reshape(NB, BS, hkv, hd)[real],
+                np.asarray(b).reshape(NB, BS, hkv, hd)[real])
+
+
+# ---------------------------------------------------------------------
+# 3. engine goldens: pallas serves token-identical to xla
+# ---------------------------------------------------------------------
+
+def _engine(params, kernel, family=None, fam_params=None, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 48)
+    kw.setdefault("max_seq_len", 32)
+    return ServeEngine(family or gpt2_family(CFG),
+                       fam_params if fam_params is not None else params,
+                       attn_kernel=kernel, **kw)
+
+
+def _serve(eng, prompts, max_new, *, arrivals=None):
+    arrivals = arrivals or [0] * len(prompts)
+    keys = [jax.random.key(100 + i) for i in range(len(prompts))]
+    rids, submitted, step = {}, 0, 0
+    while submitted < len(prompts) or eng.has_work:
+        while (submitted < len(prompts)
+               and arrivals[submitted] <= step):
+            rids[submitted] = eng.submit(prompts[submitted], max_new,
+                                         key=keys[submitted])
+            submitted += 1
+        eng.step()
+        step += 1
+        assert step < 1000
+    return [np.asarray(eng.result(rids[i])) for i in range(len(prompts))]
+
+
+def _ab(params, prompts, max_new, *, arrivals=None, **kw):
+    a = _serve(_engine(params, "xla", **kw), prompts, max_new,
+               arrivals=arrivals)
+    b = _serve(_engine(params, "pallas", **kw), prompts, max_new,
+               arrivals=arrivals)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    return a
+
+
+class TestEngineGoldens:
+    @pytest.fixture(scope="class")
+    def prompts(self):
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, CFG.vocab_size, (9,)).astype(np.int32)
+        mixed = [np.asarray(rng.integers(0, CFG.vocab_size, (n,)),
+                            np.int32) for n in (5, 12, 3)]
+        shared_tails = [np.concatenate(
+            [shared, rng.integers(0, CFG.vocab_size, (t,)
+                                  ).astype(np.int32)]) for t in (3, 5)]
+        return mixed + shared_tails
+
+    def test_greedy_prefix_cache_f32(self, params, prompts):
+        _ab(params, prompts, 8, arrivals=[0, 1, 2, 4, 6])
+
+    def test_sampled_spec_int8(self, params, prompts):
+        _ab(params, prompts, 8, arrivals=[0, 0, 2, 3, 5],
+            kv_dtype="int8", temperature=0.8,
+            spec=SpecConfig(max_draft=4))
+
+    def test_chunked_prefill_fake_quant(self, params):
+        rng = np.random.default_rng(13)
+        long = np.asarray(rng.integers(0, CFG.vocab_size, (20,)),
+                          np.int32)
+        short = np.asarray(rng.integers(0, CFG.vocab_size, (4,)),
+                           np.int32)
+        _ab(params, [long, short], 6, kv_dtype="fake_quant",
+            prefill_len=8, chunked_prefill=True, prefill_chunk_budget=8,
+            max_seq_len=32)
+
+    def test_preemption_pressure_int8(self, params, prompts):
+        # pool sized to force growth + preemption mid-trace
+        _ab(params, prompts, 8, arrivals=[0, 0, 0, 1, 1],
+            kv_dtype="int8", num_blocks=14, max_slots=3)
+
+    def test_llama_gqa_engine_int8(self):
+        from quintnet_tpu.models.llama import LlamaConfig, llama_init
+        from quintnet_tpu.serve import llama_family
+
+        cfg = LlamaConfig.tiny()
+        lp = llama_init(jax.random.key(4), cfg)
+        rng = np.random.default_rng(17)
+        prompts = [np.asarray(rng.integers(0, cfg.vocab_size, (n,)),
+                              np.int32) for n in (5, 9)]
+        _ab(None, prompts, 6, family=llama_family(cfg), fam_params=lp,
+            kv_dtype="int8", max_slots=2)
+
+    def test_tp2_fake_quant(self, params, prompts):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        _ab(params, prompts[:3], 6, kv_dtype="fake_quant", mesh=mesh,
+            max_slots=2)
+
+
+# ---------------------------------------------------------------------
+# 4. structural win + validation + import surface
+# ---------------------------------------------------------------------
+
+class TestStructure:
+    def _args(self, eng, params, which, bucket=None):
+        caches = eng.pool.caches()
+        if which == "decode":
+            return (params, *caches, jnp.asarray(eng._tok),
+                    jnp.asarray(eng._pos), jnp.asarray(eng._tables),
+                    jnp.asarray(eng._key_data))
+        if which == "verify":
+            S = eng.max_slots
+            ids = np.zeros((S, bucket + 1), np.int32)
+            return (params, *caches, jnp.asarray(ids),
+                    jnp.asarray(eng._pos),
+                    jnp.asarray(np.ones(S, np.int32)),
+                    jnp.asarray(eng._tables), jnp.asarray(eng._key_data))
+        ids = np.zeros((1, bucket), np.int32)
+        row = np.zeros((eng.table_width,), np.int32)
+        return (params, *caches, jnp.asarray(ids), jnp.int32(1),
+                jnp.int32(3), jnp.asarray(row), jnp.int32(0),
+                jnp.int32(0), jnp.asarray(eng._key_data[0]))
+
+    @pytest.mark.parametrize("kv_dtype", ("f32", "int8"))
+    def test_pallas_issues_zero_gathered_view_gathers(self, params,
+                                                      kv_dtype):
+        """THE structural gate: every xla serving program gathers the
+        full block-table row (2 pools, +2 scale arrays when scaled) per
+        layer; every pallas program gathers it ZERO times — the walk
+        happens inside the kernel. Asserted on decode, the smallest
+        prefill bucket (requant span < table width — the auditor's
+        caller contract), and a verify bucket."""
+        counts = {}
+        for kernel in attn_kernels():
+            eng = _engine(params, kernel, kv_dtype=kv_dtype,
+                          num_blocks=24, spec=SpecConfig(max_draft=4))
+            kw = dict(num_blocks=24, table_width=eng.table_width)
+            b0 = eng.prefill_buckets[0]
+            counts[kernel] = dict(
+                decode=gathered_view_gathers(
+                    eng._decode.fn, *self._args(eng, params, "decode"),
+                    **kw),
+                prefill=gathered_view_gathers(
+                    eng._prefills[b0].fn,
+                    *self._args(eng, params, "prefill", b0), **kw),
+                verify=gathered_view_gathers(
+                    eng._verifies[2].fn,
+                    *self._args(eng, params, "verify", 2), **kw),
+            )
+        per_layer = 4 if kv_dtype == "int8" else 2
+        for which in ("decode", "prefill", "verify"):
+            assert counts["xla"][which] == per_layer, counts
+            assert counts["pallas"][which] == 0, counts
+
+    def test_compile_counts_unchanged_per_backend(self, params):
+        """Same sentinel set, same bounds, either backend — the kernel
+        never adds a program."""
+        rng = np.random.default_rng(5)
+        prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (n,)),
+                              np.int32) for n in (3, 7)]
+        for kernel in attn_kernels():
+            eng = _engine(params, kernel)
+            _serve(eng, prompts, 5)
+            assert eng.compile_stats() == {"prefill": 1, "decode": 1}
+            eng.assert_compile_count()
+
+    def test_unknown_kernel_rejected(self, params):
+        with pytest.raises(ValueError, match="attn_kernel"):
+            _engine(params, "triton")
+
+    def test_pallas_unavailable_rejected_at_construction(self, params,
+                                                         monkeypatch):
+        """A jax install without pallas TPU support must fail at
+        ServeEngine construction, not deep inside the first serving
+        step."""
+        import importlib
+
+        # the ops package re-exports the paged_attention FUNCTION, so
+        # attribute-style module access resolves to it — go via
+        # importlib for the module object
+        pa = importlib.import_module(
+            "quintnet_tpu.ops.paged_attention")
+        monkeypatch.setattr(pa, "_HAVE_PLTPU", False)
+        with pytest.raises(RuntimeError, match="pallas"):
+            _engine(params, "pallas")
+
+    def test_pallas_sp_rejected(self, params):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        with pytest.raises(NotImplementedError, match="pallas"):
+            _engine(params, "pallas", mesh=mesh, sp_axis="sp",
+                    prefill_bucket_sizes=(16, 32))
+
+    def test_dense_path_rejects_pallas(self):
+        from quintnet_tpu.nn.attention import mha_decode, mha_init
+
+        p = mha_init(jax.random.key(0), H * D)
+        x = jnp.zeros((1, 1, H * D))
+        kc = jnp.zeros((1, H, 8, D))
+        with pytest.raises(ValueError, match="paged"):
+            mha_decode(p, x, kc, kc, jnp.int32(0), num_heads=H,
+                       attn_kernel="pallas")
+
+    def test_scaled_kernel_requires_fresh_kv(self):
+        from quintnet_tpu.ops.paged_attention import paged_attention
+
+        q = jnp.zeros((1, H, 1, D))
+        pool = jnp.zeros((NB * BS, H, D), jnp.int8)
+        sc = jnp.ones((NB, H), jnp.float32)
+        with pytest.raises(ValueError, match="fresh_kv"):
+            paged_attention(q, pool, pool, _tables()[:1],
+                            jnp.zeros((1,), jnp.int32), block_size=BS,
+                            kv_scales=(sc, sc))
+
+
+def test_ops_import_surface():
+    """ops/ exports its public kernel entry points (the previously
+    empty ``__init__`` belied its own docstring)."""
+    import quintnet_tpu.ops as ops
+
+    expected = {"flash_attention", "blockwise_attention",
+                "pallas_flash_attention", "paged_attention",
+                "paged_quant_window_update", "ring_attention",
+                "zigzag_ring_attention", "ulysses_attention"}
+    assert expected == set(ops.__all__)
+    for name in ops.__all__:
+        assert callable(getattr(ops, name)), name
+
+
+def test_attn_kernel_ladder_pinned():
+    assert attn_kernels() == ("xla", "pallas")

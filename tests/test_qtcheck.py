@@ -292,6 +292,37 @@ class TestServe:
             census = collective_census(fn, *args)
             assert census.diff(spec) == [], census.as_dict()
 
+    def test_pallas_census_matches_xla_per_backend(self, gpt2):
+        """The attention-backend ladder (analysis/specs.attn_kernels)
+        must not move a single collective: under tp the pallas decode
+        program carries EXACTLY the xla decode census (2 row-parallel
+        psums per layer — the kernel sits strictly inside the per-layer
+        attention; a pallas_call has no collectives), for the
+        passthrough f32 pool AND the scaled int8 one. A kernel that
+        snuck a gather/psum into the wire would fail with a named
+        diff."""
+        from quintnet_tpu.analysis.specs import attn_kernels
+
+        cfg, params = gpt2
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        spec = census_specs.expected_serve_decode(cfg.n_layer,
+                                                  tp_axis="tp")
+        for kv_dtype in ("f32", "int8"):
+            per_backend = {}
+            for kernel in attn_kernels():
+                eng = self._engine(cfg, params, mesh=mesh,
+                                   kv_dtype=kv_dtype,
+                                   attn_kernel=kernel)
+                caches = eng.pool.caches()
+                args = (params, *caches, jnp.asarray(eng._tok),
+                        jnp.asarray(eng._pos), jnp.asarray(eng._tables),
+                        jnp.asarray(eng._key_data))
+                census = collective_census(eng._decode.fn, *args)
+                assert census.diff(spec) == [], (kernel, kv_dtype,
+                                                 census.as_dict())
+                per_backend[kernel] = census.as_dict()
+            assert per_backend["pallas"] == per_backend["xla"]
+
     def test_one_prefill_one_decode_across_mixed_trace(self, gpt2):
         """The PR 1 serving promise as a sentinel-enforced invariant:
         staggered arrivals, varying prompt lengths, retirements, block

@@ -153,7 +153,7 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False,
                  params=None, adapters=None, max_seq=None,
                  prefill_len=None, chunked_prefill: bool = False,
                  prefill_chunk_budget=None, kv_dtype=None,
-                 num_blocks=None):
+                 num_blocks=None, attn_kernel=None):
     from quintnet_tpu.serve import ServeEngine, SpecConfig
 
     family, params = build_model(args, params=params)
@@ -172,6 +172,8 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False,
         eos_token_id=args.eos, temperature=args.temperature,
         policy=args.policy, prefix_cache=prefix_cache,
         kv_dtype=kv_dtype if kv_dtype is not None else args.kv_dtype,
+        attn_kernel=(attn_kernel if attn_kernel is not None
+                     else args.kernel),
         spec=SpecConfig(max_draft=args.max_draft) if spec else None,
         adapters=adapters, lora_max_rank=args.lora_rank)
 
@@ -518,6 +520,91 @@ def run(args) -> dict:
             "extras": extras,
         }
 
+    if args.kernel_ab:
+        # fused-kernel A/B over the SAME default trace. Two committed
+        # signals, both wall-noise-free: (1) every request's token
+        # stream is IDENTICAL across backends (the kernel is
+        # bit-parity-pinned against the gathered-view oracle), and
+        # (2) the jaxpr auditor proves the pallas programs issue ZERO
+        # full-row block-table gathers where the xla ones issue 2 (4
+        # under a scaled KV policy) per layer — the structural
+        # HBM-traffic win the kernel exists for. CPU wall clocks ride
+        # along for the record but are NOT gated: off-TPU the kernel
+        # runs in the Pallas interpreter, which prices emulation.
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from quintnet_tpu.analysis import gathered_view_gathers
+
+        prefix_cache = args.prefix_cache == "on"
+        spec = args.spec == "on"
+        eng_warm = build_engine(args, prefix_cache=prefix_cache,
+                                spec=spec, attn_kernel="xla")
+        trace = poisson_trace(args, eng_warm.family.cfg.vocab_size)
+        replay(eng_warm, trace, args)   # process warm-up, untimed
+        del eng_warm
+        eng_p = build_engine(args, prefix_cache=prefix_cache,
+                             spec=spec, attn_kernel="pallas")
+        s_p = replay(eng_p, trace, args)
+        eng_x = build_engine(args, prefix_cache=prefix_cache,
+                             spec=spec, attn_kernel="xla")
+        s_x = replay(eng_x, trace, args)
+        # token-identity is THE signal this mode exists to report, so
+        # a divergence (different lengths, an unfinished or errored
+        # request on one side) must come back as token_identical=false
+        # with a count — never a traceback
+        n = min(s_p["finished"], s_x["finished"])
+        mismatched = 0
+        for r in range(n):
+            try:
+                a, b = eng_p.result(r), eng_x.result(r)
+            except Exception:
+                mismatched += 1
+                continue
+            if a.shape != b.shape or not (a == b).all():
+                mismatched += 1
+        token_identical = (n == len(trace) and mismatched == 0)
+
+        def _gathers(eng):
+            caches = eng.pool.caches()
+            dargs = (eng.params, *caches, _jnp.asarray(eng._tok),
+                     _jnp.asarray(eng._pos), _jnp.asarray(eng._tables),
+                     _jnp.asarray(eng._key_data))
+            return gathered_view_gathers(
+                eng._decode.fn, *dargs,
+                num_blocks=eng.pool.num_blocks,
+                table_width=eng.table_width)
+
+        gx, gp = _gathers(eng_x), _gathers(eng_p)
+        extras = _common_extras(args, s_p)
+        ratio = (round(s_p["tokens_per_sec"] / s_x["tokens_per_sec"], 3)
+                 if s_x["tokens_per_sec"] else 0.0)
+        extras.update({
+            "kernel_ab": True,
+            "attn_kernel": "pallas",
+            "kv_dtype": args.kv_dtype,
+            "token_identical": bool(token_identical),
+            "compared_requests": int(n),
+            "mismatched_requests": int(mismatched),
+            # THE structural gate (CI-pinned): full-row block-table
+            # gathers per decode program
+            "xla_gathered_view_gathers": int(gx),
+            "pallas_gathered_view_gathers": int(gp),
+            "xla_tokens_per_sec": s_x["tokens_per_sec"],
+            "xla_wall_s": s_x["wall_s"],
+            "xla_finished": s_x["finished"],
+            "cpu_interpret_mode": _jax.default_backend() != "tpu",
+            "speedup_vs_xla": ratio,
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_kernel_tokens_per_sec",
+            "value": s_p["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": ratio,
+            "rc": 0,
+            "extras": extras,
+        }
+
     if args.kv_capacity:
         # equal-pool-BYTES capacity A/B over the shared-prefix trace
         # (quantized KV, serve/kv_quant.py): the f32 reference keeps
@@ -814,6 +901,7 @@ def run(args) -> dict:
     extras["prefix_cache"] = prefix_cache
     extras["spec"] = spec
     extras["kv_dtype"] = args.kv_dtype
+    extras["attn_kernel"] = args.kernel
     if obs is not None:
         extras.update(_obs_summary(*obs))
         extras.update(_write_trace_out(args.trace_out, *obs))
@@ -861,6 +949,21 @@ def main():
                          "int8 stores blocks quantized with per-block-"
                          "per-head scales, dequantized inside the "
                          "gathered-view attention kernels")
+    ap.add_argument("--kernel", default="xla",
+                    choices=("xla", "pallas"),
+                    help="serving attention backend "
+                         "(ops/paged_attention.py): 'xla' is the "
+                         "gathered-view oracle, 'pallas' the fused "
+                         "block-table-walking kernel (interpret mode "
+                         "off-TPU)")
+    ap.add_argument("--kernel-ab", action="store_true",
+                    help="replay the SAME default trace through an "
+                         "xla and a pallas engine: token-identity + "
+                         "the auditor-verified structural win (zero "
+                         "gathered-view gathers) are the committed "
+                         "signals; CPU walls are recorded but NOT the "
+                         "gate (interpret mode prices emulation, not "
+                         "the kernel)")
     ap.add_argument("--kv-capacity", action="store_true",
                     help="equal-pool-BYTES capacity A/B over the "
                          "shared-prefix trace: f32 at --num-blocks vs "
