@@ -197,6 +197,15 @@ class FleetMetrics:
     handoff_transfers: int = 0
     handoff_retries: int = 0
     handoff_fallbacks: int = 0
+    # tiered-KV peer lookup (serve/kv_tier.py, fleet/proc.py):
+    # ``tier_probes`` counts dispatches that ran the kv_peek fan-out;
+    # ``tier_peer_transfers`` chains actually shipped peer->target
+    # before dispatch; ``tier_peer_fallbacks`` probes where a better
+    # peer existed but the transfer degraded (export/import failed) —
+    # dispatch proceeded without warm peer KV, token-identical
+    tier_probes: int = 0
+    tier_peer_transfers: int = 0
+    tier_peer_fallbacks: int = 0
     # admission-queue pressure gauges, refreshed through the probe the
     # owning fleet attaches (the metrics object cannot see the queue):
     # depth says how much is waiting, oldest-wait age how badly —
@@ -249,6 +258,9 @@ class FleetMetrics:
             "handoff_transfers": self.handoff_transfers,
             "handoff_retries": self.handoff_retries,
             "handoff_fallbacks": self.handoff_fallbacks,
+            "tier_probes": self.tier_probes,
+            "tier_peer_transfers": self.tier_peer_transfers,
+            "tier_peer_fallbacks": self.tier_peer_fallbacks,
             "ttft_s": serve_metrics._pcts(self.ttfts),
             "latency_s": serve_metrics._pcts(self.latencies),
         }

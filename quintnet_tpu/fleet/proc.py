@@ -254,6 +254,16 @@ def replica_main(name: str, host: str, port: int, token: str,
                 time.sleep(chaos.handoff_stall_s)
             send({"t": "kv", "id": cmd["id"], "kv": kv,
                   "reason": reason})
+        elif t == "kv_peek":
+            # tier peer lookup, probe side: how many token positions
+            # this replica could serve warm (device chain + host-tier
+            # extension) for a prefix. Read-only and cheap — no data
+            # moves, nothing pins — so the dispatcher can fan it out
+            # to every replica before choosing whom to kv_export from.
+            tokens = np.asarray(cmd.get("tokens", []), np.int32)
+            send({"t": "kv_n", "id": cmd["id"],
+                  "n_tokens": int(engine.peek_kv_chain(
+                      tokens, namespace=cmd.get("namespace")))})
         elif t == "kv_import":
             # receiving side: verify the checksum, admit the chain as
             # a warm prefix hit. A corrupt/mismatched frame is a TYPED
@@ -620,6 +630,7 @@ class ProcessFleet:
                  backoff: Optional[Backoff] = None,
                  handoff_retry: Optional[RetryPolicy] = None,
                  handoff_timeout_s: float = 60.0,
+                 tier_peer_lookup: Optional[bool] = None,
                  chaos: Optional[Sequence[Dict]] = None,
                  platform: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
@@ -661,6 +672,14 @@ class ProcessFleet:
         self._handoff_retry = handoff_retry or RetryPolicy(
             base_s=0.05, cap_s=1.0, jitter=0.25, max_attempts=3)
         self._handoff_timeout_s = float(handoff_timeout_s)
+        # tiered-KV peer lookup (serve/kv_tier.py): before a fresh
+        # dispatch, probe every replica's combined device+host chain
+        # for the prompt (kv_peek) and ship the best peer's chain into
+        # the target (kv_export -> kv_import) when it beats the
+        # target's own by >= 1 block — a host-hit on ANY replica beats
+        # a re-prefill. None = auto: on when the engines report a host
+        # tier in their limits AND the fleet has >= 2 replicas.
+        self._tier_peer_lookup = tier_peer_lookup
         self._pool_down_seen: Dict[str, bool] = {}
         self.engine_spec = dict(engine_spec)
         self.platform = platform
@@ -1834,11 +1853,24 @@ class ProcessFleet:
             # migrated request must not stall token delivery and
             # stall detection while its key is advanced
             payload = wire.progress_to_wire(self._progress_for(freq))
+            frame = {"t": "submit", "fid": freq.fid,
+                     "progress": payload,
+                     "prefill_only":
+                         freq.dispatched_phase == "prefill"}
+            if self._tier_lookup_applies(rep, freq):
+                # warm the target from the best peer's tier BEFORE the
+                # submit lands — on its own thread (the _run_handoff
+                # discipline): the probe + transfer RPCs may block and
+                # must stall neither token delivery nor stall
+                # detection. The thread sends the submit afterward,
+                # warm or not.
+                threading.Thread(
+                    target=self._run_peer_fetch,
+                    args=(rep, freq, frame), daemon=True,
+                    name=f"tierfetch-{freq.fid}").start()
+                continue
             try:
-                rep.send({"t": "submit", "fid": freq.fid,
-                          "progress": payload,
-                          "prefill_only":
-                              freq.dispatched_phase == "prefill"})
+                rep.send(frame)
             except OSError:
                 # connection failure AT dispatch (dead socket, or a
                 # send timed out against a wedged peer): the replica
@@ -1849,6 +1881,108 @@ class ProcessFleet:
                 # concurrent stall-handler migration (migrated flag).
                 with self._cv:
                     self._handle_death_locked(rep, stalled=False)
+
+    # ------------------------------------------------------------------
+    # tiered-KV peer lookup (serve/kv_tier.py)
+    # ------------------------------------------------------------------
+    def _tier_lookup_applies(self, rep: ProcReplica,
+                             freq: FleetRequest) -> bool:
+        """Should this dispatch run the kv_peek fan-out first? Only
+        for FRESH requests (no journaled tokens — a migration's
+        re-prefill path already benefits from whatever the target
+        holds) whose prompt spans at least one full block beyond the
+        admission cap, on a multi-replica fleet whose engines carry a
+        host tier (auto mode) or when explicitly forced on."""
+        limits = self._limits or {}
+        if self._tier_peer_lookup is None:
+            enabled = (bool(limits.get("kv_tier"))
+                       and len(self._replicas) >= 2)
+        else:
+            enabled = bool(self._tier_peer_lookup)
+        if not enabled or self._closed or freq.committed:
+            return False
+        bs = int(limits.get("block_size", 0) or 0)
+        return bs > 0 and len(freq.prompt) > bs
+
+    def _run_peer_fetch(self, rep: ProcReplica, freq: FleetRequest,
+                        frame: Dict) -> None:
+        """Probe peers' tiers and warm ``rep`` before its submit frame
+        lands. OPPORTUNISTIC, single attempt, total fallback: any
+        fault — peer death, timeout, corrupt frame, declined export —
+        just dispatches without warm peer KV (the chain is cache, so
+        re-prefill is token-identical; that is the whole failure
+        semantics). The submit is sent from THIS thread afterward
+        either way, with the dispatcher's own dead-socket
+        discipline."""
+        try:
+            self._peer_fetch(rep, freq)
+        except Exception as e:
+            with self._cv:
+                self.metrics.tier_peer_fallbacks += 1
+            self._emit("tier_peer_miss", fid=freq.fid,
+                       replica=rep.name, reason=repr(e))
+        try:
+            rep.send(frame)
+        except OSError:
+            with self._cv:
+                self._handle_death_locked(rep, stalled=False)
+
+    def _peer_fetch(self, rep: ProcReplica,
+                    freq: FleetRequest) -> None:
+        timeout = self._handoff_timeout_s
+        tokens = [int(x) for x in np.asarray(freq.prompt).reshape(-1)]
+        ns = freq.adapter_id
+        with self._cv:
+            self.metrics.tier_probes += 1
+            peers = [r for r in self._replicas
+                     if r is not rep and r.state == HEALTHY]
+        bs = max(int((self._limits or {}).get("block_size", 1) or 1), 1)
+        # the target's own coverage is the bar a peer must clear — by
+        # a full block, or the transfer costs more than it saves
+        local = int(rep.rpc({"t": "kv_peek", "tokens": tokens,
+                             "namespace": ns},
+                            timeout=timeout).get("n_tokens", 0))
+        best, best_n = None, local
+        for peer in peers:
+            try:
+                n = int(peer.rpc({"t": "kv_peek", "tokens": tokens,
+                                  "namespace": ns},
+                                 timeout=timeout).get("n_tokens", 0))
+            except (OSError, TimeoutError, wire.WireError):
+                continue      # a dead peer is just a peer with no hit
+            if n > best_n:
+                best, best_n = peer, n
+        if best is None or best_n < local + bs:
+            self._emit("tier_peer_miss", fid=freq.fid,
+                       replica=rep.name, reason="no_better_peer",
+                       local_tokens=local, best_tokens=best_n)
+            return
+        f = best.rpc({"t": "kv_export", "tokens": tokens,
+                      "namespace": ns, "trace_id": freq.trace_id},
+                     timeout=timeout)
+        kv = f.get("kv")
+        if kv is None:
+            with self._cv:
+                self.metrics.tier_peer_fallbacks += 1
+            self._emit("tier_peer_miss", fid=freq.fid,
+                       replica=rep.name,
+                       reason=str(f.get("reason") or "export_declined"))
+            return
+        f2 = rep.rpc({"t": "kv_import", "kv": kv,
+                      "trace_id": freq.trace_id}, timeout=timeout)
+        imported = int(f2.get("imported", 0))
+        if imported <= 0:
+            with self._cv:
+                self.metrics.tier_peer_fallbacks += 1
+            self._emit("tier_peer_miss", fid=freq.fid,
+                       replica=rep.name,
+                       reason=str(f2.get("error") or "import_declined"))
+            return
+        with self._cv:
+            self.metrics.tier_peer_transfers += 1
+        self._emit("tier_peer_hit", fid=freq.fid,
+                   from_replica=best.name, to_replica=rep.name,
+                   tokens=imported)
 
     # ------------------------------------------------------------------
     # lifecycle / operations

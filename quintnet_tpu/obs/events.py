@@ -52,6 +52,14 @@ EVENT_KINDS = frozenset({
     #                         threshold for a breaching objective
     "rebalance_recommended",  # observe-only planner output (attrs:
     #                           direction, reason, burn — NO actuation)
+    # tiered KV peer lookup (serve/kv_tier.py, fleet/proc.py): the
+    # dispatcher probed peer replicas' host tiers before dispatch
+    "tier_peer_hit",        # a peer's chain beat the target's — KV
+    #                         shipped peer->target before dispatch
+    #                         (attrs: from/to_replica, tokens)
+    "tier_peer_miss",       # no peer beat the target (or the
+    #                         transfer degraded) — dispatch proceeds
+    #                         without warm peer KV (attrs: reason)
 })
 
 

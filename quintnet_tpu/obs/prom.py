@@ -51,6 +51,10 @@ _COUNTER_KEYS = frozenset({
     "accepted", "shed", "shed_queue_full", "shed_deadline",
     "shed_shutdown", "migrations", "replica_deaths", "stalls",
     "restarts", "requests", "tokens_delivered",
+    # tiered KV (serve/kv_tier.py): host_tier_bytes stays a gauge
+    "kv_cache_evictions", "kv_demotions", "kv_promotions",
+    "kv_host_evictions", "host_hit_tokens", "decode_blocked_demotions",
+    "tier_probes", "tier_peer_transfers", "tier_peer_fallbacks",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

@@ -44,14 +44,15 @@ from typing import Dict, List, Optional
 # Engine (serve/engine.py): submit, queue, admit, prefill,
 #   prefill_chunk, decode, verify, preempt, deadline_exceeded, export,
 #   restore, finish (attrs.handed_off marks a disaggregated prefill
-#   retirement), kv_export, kv_import.
+#   retirement), kv_export, kv_import, kv_promote (host-tier chain
+#   re-import, serve/kv_tier.py — attrs.phase: start/feed/done).
 # Fleet (fleet/fleet.py, fleet/proc.py): fleet_submit, fleet_queue,
 #   dispatch, first_token, migration, handoff (attrs: to_replica /
 #   fallback — the prefill→decode KV transfer outcome).
 SPAN_NAMES = frozenset({
     "submit", "queue", "admit", "prefill", "prefill_chunk", "decode",
     "verify", "preempt", "deadline_exceeded", "export", "restore",
-    "finish", "kv_export", "kv_import",
+    "finish", "kv_export", "kv_import", "kv_promote",
     "fleet_submit", "fleet_queue", "dispatch", "first_token",
     "migration", "handoff",
 })

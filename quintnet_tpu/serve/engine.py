@@ -114,10 +114,11 @@ from quintnet_tpu.serve.adapters import (AdapterRegistry, adapter_paths,
 from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
 from quintnet_tpu.serve.kv_quant import make_policy
+from quintnet_tpu.serve.kv_tier import HostTier, PromotionState
 from quintnet_tpu.serve.metrics import ServeMetrics
-from quintnet_tpu.serve.scheduler import (FINISHED, DeadlineExceeded,
-                                          Request, RequestProgress,
-                                          Scheduler)
+from quintnet_tpu.serve.scheduler import (FINISHED, PROMOTING, WAITING,
+                                          DeadlineExceeded, Request,
+                                          RequestProgress, Scheduler)
 from quintnet_tpu.serve.spec import NgramDrafter, SpecConfig
 
 
@@ -126,7 +127,8 @@ def check_admissible(prompt_len: int, max_new_tokens: int, *,
                      usable_blocks: int, block_size: int,
                      max_slots: int = 0,
                      chunked_prefill: bool = False,
-                     prefix_cache: bool = True) -> None:
+                     prefix_cache: bool = True,
+                     kv_tier: bool = False) -> None:
     """Submit-time rejection of requests an engine with these limits
     can NEVER run. Standalone (no engine instance) so a remote
     dispatcher — the process fleet's parent, which has only the
@@ -134,9 +136,10 @@ def check_admissible(prompt_len: int, max_new_tokens: int, *,
     ITS front door instead of round-tripping a doomed request to a
     replica process. ``max_slots`` (dispatch-window sizing) and
     ``prefix_cache`` (the disaggregated fleet's handoff precondition,
-    validated at fleet startup) ride along in ``limits()`` and are
-    accepted (unused) here so the dict splats straight in — neither
-    is an admissibility bound. ``chunked_prefill`` (serve/longctx.py) lifts
+    validated at fleet startup) and ``kv_tier`` (whether a host-RAM
+    second tier is attached — the fleet's tier-peer-lookup trigger)
+    ride along in ``limits()`` and are accepted (unused) here so the
+    dict splats straight in — none is an admissibility bound. ``chunked_prefill`` (serve/longctx.py) lifts
     the prefill-window bound: a chunked engine streams any prompt
     through bucket-sized chunks, so only ``max_seq_len`` and pool
     capacity remain."""
@@ -196,6 +199,8 @@ class ServeEngine:
                  chunked_prefill: bool = False,
                  prefill_chunk_budget: Optional[int] = None,
                  kv_dtype=None,
+                 kv_tier_bytes: int = 0,
+                 kv_tier_promote_budget_bytes: Optional[int] = None,
                  attn_kernel: str = "xla",
                  logger=None, log_every: int = 0,
                  clock=time.monotonic,
@@ -439,12 +444,51 @@ class ServeEngine:
                                      P(None, None, self.tp_axis, None))
             scale_sharding = NamedSharding(mesh,
                                            P(None, None, self.tp_axis))
+        # host-RAM second tier under the prefix cache (serve/
+        # kv_tier.py): kv_tier_bytes > 0 attaches a bounded HostTier —
+        # eviction demotes published chains there instead of
+        # destroying them, and a host-hit at admission re-promotes
+        # asynchronously (PROMOTING state) under a per-step block
+        # budget so demotion/promotion cost never lands on a decode
+        # dispatch.
+        self.kv_tier: Optional[HostTier] = None
+        if int(kv_tier_bytes) > 0:
+            if not self.prefix_cache:
+                raise ValueError(
+                    "kv_tier_bytes requires prefix_cache=True — the "
+                    "host tier spills the prefix cache; with the "
+                    "cache off there is nothing to demote")
+            self.kv_tier = HostTier(byte_budget=int(kv_tier_bytes))
+        elif int(kv_tier_bytes) < 0:
+            raise ValueError(
+                f"kv_tier_bytes must be >= 0; got {kv_tier_bytes}")
         self.pool = KVPool(
             n_layers=family.n_layers, n_kv_heads=family.n_kv_heads,
             head_dim=family.head_dim, block_size=block_size,
             num_blocks=num_blocks, policy=self.kv_policy,
             sharding=sharding, scale_sharding=scale_sharding,
-            prefix_cache=self.prefix_cache)
+            prefix_cache=self.prefix_cache, host_tier=self.kv_tier)
+        # per-step promotion budget in BLOCKS (Sarathi's budget
+        # discipline applied to host->device memcpy): default 4 blocks
+        # a step — enough to drain typical chains in a few steps
+        # without turning any single step into a bulk transfer
+        bpb = self.pool.bytes_per_block
+        budget_bytes = (4 * bpb if kv_tier_promote_budget_bytes is None
+                        else int(kv_tier_promote_budget_bytes))
+        if budget_bytes < 1:
+            raise ValueError(
+                f"kv_tier_promote_budget_bytes must be >= 1; got "
+                f"{budget_bytes}")
+        self._promote_budget_blocks = max(1, budget_bytes // bpb)
+        # in-flight promotions by rid + rids whose promotion round
+        # already ran (one promotion attempt per admission try — stops
+        # a promote/evict livelock under extreme pool pressure)
+        self._promoting: Dict[int, PromotionState] = {}
+        self._promotion_done: set = set()
+        # demotions observed DURING a plain decode dispatch — the
+        # structural "decode never blocks on a demotion copy" counter
+        # (always 0 by step phasing; surfaced so the bench can gate it)
+        self._decode_blocked_demotions = 0
         self.table_width = self.pool.blocks_for(self.max_seq_len)
         self.scheduler = Scheduler(self.pool, policy=policy)
         self.metrics = ServeMetrics(clock=clock)
@@ -937,7 +981,8 @@ class ServeEngine:
                 "block_size": self.pool.block_size,
                 "max_slots": self.max_slots,
                 "chunked_prefill": self.chunked_prefill,
-                "prefix_cache": self.prefix_cache}
+                "prefix_cache": self.prefix_cache,
+                "kv_tier": self.kv_tier is not None}
 
     def _check_admissible(self, prompt: np.ndarray,
                           max_new_tokens: int) -> None:
@@ -1200,6 +1245,10 @@ class ServeEngine:
                    if r.deadline is not None and now >= r.deadline]
         for req in expired:
             self.scheduler.waiting.remove(req)
+            # a PROMOTING request dies like any waiting one — whatever
+            # its promotion already landed stays published (cache is
+            # never wasted), the rest of the plan is abandoned
+            self._promoting.pop(req.rid, None)
             self._fail_request(req, DeadlineExceeded(
                 f"request {req.rid} still waiting at its deadline; "
                 f"never admitted", rid=req.rid, generated=0))
@@ -1208,6 +1257,77 @@ class ServeEngine:
                 self.tracer.event(req.trace_id, "deadline_exceeded",
                                   generated=0, where="waiting")
             finished.append(req.rid)
+
+    # ---- host-tier promotion (serve/kv_tier.py) ----------------------
+    def _start_promotion(self, req: Request) -> bool:
+        """Probe the combined device+host chain for the queue head; on
+        a host-hit (host-resident boundaries would extend the device
+        chain) park the request in the PROMOTING state with the plan
+        of keys to re-import. Same lookup cap as the admission plan
+        (``len(tokens) - 1``: at least one token is always
+        prefilled)."""
+        tokens = req.output_ids()
+        covered, keys = self.pool.plan_promotion(
+            tokens, max_tokens=len(tokens) - 1,
+            namespace=req.adapter_id)
+        if not keys:
+            return False
+        req.state = PROMOTING
+        self._promoting[req.rid] = PromotionState(req=req, keys=keys)
+        if self.tracer is not None:
+            self.tracer.event(req.trace_id, "kv_promote",
+                              phase="start", blocks=len(keys),
+                              covered_tokens=int(covered))
+        return True
+
+    def _feed_promotions(self) -> None:
+        """Advance every in-flight promotion by at most the per-step
+        block budget (shared across promotions): host->device copies
+        land while OTHER slots keep decoding — the chunk feed's budget
+        discipline applied to memcpy. A completed promotion flips its
+        request back to WAITING, where this same step's admission loop
+        finds the promoted chain as an ordinary device prefix hit. A
+        promotion that can make no progress while nothing is running
+        (the pool cannot yield a block and no retirement will free
+        one) is force-finished — admission's cache-cold fallback is
+        always correct, so the degradation is re-prefill, never a
+        wedge."""
+        budget = self._promote_budget_blocks
+        for rid in list(self._promoting):
+            if budget <= 0:
+                break
+            st = self._promoting[rid]
+            req = st.req
+            if req.state != PROMOTING:  # failed while parked (sweep)
+                self._promoting.pop(rid, None)
+                continue
+            taken, blocks = self.pool.promote_chain(
+                st.keys[st.next:], max_blocks=budget)
+            st.next += taken
+            budget -= blocks
+            if blocks and self.tracer is not None:
+                self.tracer.event(req.trace_id, "kv_promote",
+                                  phase="feed", blocks=blocks,
+                                  remaining=st.remaining)
+            if st.done or (taken == 0 and blocks == 0
+                           and not self._active_slots()):
+                self._promoting.pop(rid, None)
+                self._promotion_done.add(req.rid)
+                req.state = WAITING
+                if self.tracer is not None:
+                    self.tracer.event(req.trace_id, "kv_promote",
+                                      phase="done",
+                                      promoted_keys=st.next)
+
+    def peek_kv_chain(self, tokens, *,
+                      namespace: Optional[str] = None) -> int:
+        """Token positions this engine could serve warm for ``tokens``
+        (device chain + host-tier extension). Read-only and cheap —
+        the fleet's ``kv_peek`` RPC (tier peer lookup) calls this on
+        every candidate replica before choosing whom to pull a chain
+        from."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        return self.pool.peek_chain_tokens(tokens, namespace=namespace)
 
     def _preempt(self, slot: int) -> None:
         """Evict: checkpoint progress host-side (generated tokens are
@@ -1663,14 +1783,32 @@ class ServeEngine:
         # 0. deadline enforcement — running slots AND the waiting queue
         self._sweep_deadlines(finished)
 
+        # 1a. host-tier promotion feed: stream at most the per-step
+        # block budget of host->device chain re-imports (the PROMOTING
+        # queue head) — decode below still runs for every generating
+        # slot, so promotions never stall in-flight streams
+        if self._promoting:
+            self._feed_promotions()
+
         # 1. admissions — chunked mode allocates slot + table only
         # (the budget-capped chunk feed below does the compute); plain
         # mode prefills the whole tail here, as always
         while not self._admissions_paused:
             free = self._free_slots()
+            if self.kv_tier is not None:
+                w = self.scheduler.waiting
+                # third admission outcome, host-hit: the head's chain
+                # extends onto the host tier — park it PROMOTING (one
+                # round per admission try) instead of re-prefilling
+                # what the tier still holds
+                if (w and w[0].state == WAITING
+                        and w[0].rid not in self._promotion_done
+                        and self._start_promotion(w[0])):
+                    break
             req = self.scheduler.next_admission(len(free))
             if req is None:
                 break
+            self._promotion_done.discard(req.rid)
             slot = free[0]
             if self.chunked_prefill:
                 prefix_hit_tokens += self._admit_slot_chunked(slot, req)
@@ -1714,6 +1852,13 @@ class ServeEngine:
                 decode_tokens, draft_tokens, accepted_draft = \
                     self._verify_step(decoding, drafts, finished)
             else:
+                # structural tier invariant: the plain decode dispatch
+                # performs NO pool acquires, so it can never trigger a
+                # demotion copy — the snapshot below proves it per
+                # step (surfaced as decode_blocked_demotions, pinned
+                # at 0 by the bench gate)
+                demo0 = (self.kv_tier.demotions
+                         if self.kv_tier is not None else 0)
                 if self.adapters is None:
                     sentinel, extra = self._decode, ()
                 else:
@@ -1758,8 +1903,12 @@ class ServeEngine:
                             token=token, pos=int(self._pos[slot]))
                     if self._append_token(slot, token):
                         finished.append(self._retire(slot))
+                if self.kv_tier is not None:
+                    self._decode_blocked_demotions += (
+                        self.kv_tier.demotions - demo0)
 
         # 4. metrics
+        tier = self.kv_tier
         self.metrics.record_step(
             running=len(self._active_slots()),
             waiting=len(self.scheduler.waiting),
@@ -1773,7 +1922,14 @@ class ServeEngine:
             spec_step=spec_step,
             draft_tokens=draft_tokens,
             accepted_draft_tokens=accepted_draft,
-            prefill_chunks=prefill_chunks)
+            prefill_chunks=prefill_chunks,
+            kv_cache_evictions=self.pool.cache_evictions,
+            kv_demotions=0 if tier is None else tier.demotions,
+            kv_promotions=0 if tier is None else tier.promotions,
+            kv_host_evictions=0 if tier is None else tier.evictions,
+            host_hit_tokens=0 if tier is None else tier.promoted_tokens,
+            host_tier_bytes=0 if tier is None else tier.bytes_used,
+            decode_blocked_demotions=self._decode_blocked_demotions)
         if self.recorder is not None:
             from quintnet_tpu.obs.recorder import StepRecord
 

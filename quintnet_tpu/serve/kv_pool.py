@@ -57,14 +57,16 @@ reuse, block-granular):
 
 from __future__ import annotations
 
+import heapq
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 import jax.numpy as jnp
 
 from quintnet_tpu.serve.kv_quant import KVLayoutPolicy, make_policy
+from quintnet_tpu.serve.kv_tier import HostTier
 
 NULL_BLOCK = 0
 
@@ -110,7 +112,8 @@ class KVPool:
                  block_size: int, num_blocks: int, dtype=jnp.float32,
                  policy: "KVLayoutPolicy | str | None" = None,
                  sharding=None, scale_sharding=None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 host_tier: Optional[HostTier] = None):
         if block_size < 1 or num_blocks < 2:
             raise ValueError(
                 f"need block_size >= 1 and num_blocks >= 2 (block 0 is "
@@ -164,6 +167,17 @@ class KVPool:
         self._cached_free: Set[int] = set()
         self._lru: Dict[int, int] = {}
         self._touch_counter = 0
+        # lazy-deletion eviction heap over (touch stamp, block): every
+        # touch pushes, eviction pops until an entry matches the
+        # block's CURRENT stamp — O(log touches) per eviction instead
+        # of min() over the whole retention set, which matters once
+        # eviction means a device->host demotion copy
+        self._lru_heap: List[Tuple[int, int]] = []
+        # host-RAM second tier (serve/kv_tier.py): eviction demotes
+        # published blocks here instead of destroying them. Meaningful
+        # only under the prefix cache — there is nothing to spill when
+        # nothing is retained.
+        self.host_tier = host_tier if self.prefix_cache else None
         # eviction counter (hit accounting lives in ServeMetrics,
         # which sees per-admission cached-token counts)
         self.cache_evictions = 0
@@ -244,17 +258,61 @@ class KVPool:
     def _touch(self, b: int) -> None:
         self._touch_counter += 1
         self._lru[b] = self._touch_counter
+        heapq.heappush(self._lru_heap, (self._touch_counter, b))
+        if len(self._lru_heap) > 8 * self.num_blocks + 64:
+            # lazy-deletion debt outgrew the pool: rebuild from the
+            # live stamps (at most one entry per touched block)
+            self._lru_heap = [(s, blk) for blk, s in self._lru.items()]
+            heapq.heapify(self._lru_heap)
 
     def _evict_lru(self) -> int:
         """Drop the least-recently-touched refcount-zero cached block
-        from the index and hand it back as a plain free block. Only
-        unreferenced blocks are candidates, so an evicted block is — by
-        construction — unreachable from every live block table."""
-        b = min(self._cached_free, key=self._lru.__getitem__)
+        from the index and hand it back as a plain free block —
+        demoting its slot data to the host tier first when one is
+        attached, so the chain survives as a host-hit instead of
+        costing a future re-prefill. Only unreferenced blocks are
+        candidates, so an evicted block is — by construction —
+        unreachable from every live block table. Heap entries whose
+        stamp is no longer the block's current one are stale (the
+        block was re-touched, re-referenced, or already evicted) and
+        are discarded on pop."""
+        while self._lru_heap:
+            stamp, b = heapq.heappop(self._lru_heap)
+            if b in self._cached_free and self._lru.get(b) == stamp:
+                break
+        else:
+            # unreachable while the heap invariant holds (every cached
+            # block's latest touch is in the heap); kept as a guard so
+            # a bookkeeping bug degrades to the old O(n) scan instead
+            # of corrupting the allocator
+            b = min(self._cached_free, key=self._lru.__getitem__)
+        if self.host_tier is not None:
+            self._demote(b)
         self._cached_free.remove(b)
         self._unpublish(b)
         self.cache_evictions += 1
         return b
+
+    def _demote(self, b: int) -> bool:
+        """Copy published block ``b`` to the host tier before eviction
+        destroys it: one export-format record — the full block's slot
+        data exactly as stored (``store_dtype``) plus its scale rows
+        when the policy is scaled — keyed by the block's prefix-index
+        key, so the host tier walks the same key ladder the device
+        index does. A device->host copy on the ALLOCATION path only:
+        the engine's step phasing keeps it off every decode dispatch."""
+        key = self._block_key.get(b)
+        fill = self._block_fill.get(b, 0)
+        if key is None or fill <= 0:
+            return False
+        bs = self.block_size
+        rec = {"fill": int(fill),
+               "k": np.asarray(self.k[:, b * bs:(b + 1) * bs]),
+               "v": np.asarray(self.v[:, b * bs:(b + 1) * bs])}
+        if self.policy.scaled:
+            rec["k_scale"] = np.asarray(self.k_scale[:, b])
+            rec["v_scale"] = np.asarray(self.v_scale[:, b])
+        return self.host_tier.put(key, rec)
 
     def _unpublish(self, b: int) -> None:
         key = self._block_key.pop(b, None)
@@ -517,6 +575,157 @@ class KVPool:
         self._block_fill[b] = fill
         self._touch(b)
 
+    # ---- host tier: combined walk, peek, promotion -------------------
+    def _walk_chain(self, tokens: np.ndarray, limit: int,
+                    namespace: Optional[str]) -> Tuple[int, List[Tuple]]:
+        """The longest chain covering ``tokens[:limit]`` from EITHER
+        tier: full blocks at block boundaries, then the longest partial
+        leaf, exactly the :meth:`lookup` walk — but a boundary missing
+        from the device index may be satisfied by a host-tier record.
+        Returns ``(covered_tokens, entries)`` with entries in chain
+        order: ``("dev", block, fill)`` for device-resident blocks,
+        ``("host", key, fill)`` for host-resident ones. Read-only (host
+        probes use :meth:`HostTier.contains`, which does not touch the
+        tier's LRU)."""
+        entries: List[Tuple] = []
+        if not self.prefix_cache or limit <= 0:
+            return 0, entries
+        tier = self.host_tier
+        bs = self.block_size
+        n = 0
+        while (n + 1) * bs <= limit:
+            key = self._key(tokens, (n + 1) * bs, namespace)
+            b = self._index.get(key)
+            if b is not None:
+                entries.append(("dev", b, bs))
+            elif tier is not None and tier.contains(key):
+                entries.append(("host", key, bs))
+            else:
+                break
+            n += 1
+        m = n * bs
+        for f in range(min(bs - 1, limit - m), 0, -1):
+            key = self._key(tokens, m + f, namespace)
+            b = self._index.get(key)
+            if b is not None:
+                entries.append(("dev", b, f))
+                m += f
+                break
+            if tier is not None and tier.contains(key):
+                entries.append(("host", key, f))
+                m += f
+                break
+        return m, entries
+
+    def peek_chain_tokens(self, tokens, *,
+                          namespace: Optional[str] = None) -> int:
+        """Token positions this pool could serve warm for ``tokens`` —
+        the device chain PLUS its host-tier extension. No data moves
+        and nothing is pinned or touched: this is the cheap probe the
+        fleet's tier peer lookup sends every replica (``kv_peek``)
+        before deciding whom to pull a chain from."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        covered, _entries = self._walk_chain(tokens, len(tokens),
+                                             namespace)
+        return covered
+
+    def plan_promotion(self, tokens, max_tokens: Optional[int] = None,
+                       *, namespace: Optional[str] = None,
+                       ) -> Tuple[int, List[bytes]]:
+        """The host-resident boundaries a promotion must import so the
+        DEVICE chain covers everything the combined walk can. Returns
+        ``(covered_tokens, host_keys)`` — empty ``host_keys`` means
+        there is nothing to promote (pure device hit, or a miss in
+        both tiers). The third admission outcome in one probe:
+        device-hit (covered > 0, no keys), host-hit (keys to promote),
+        miss (covered == 0)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        limit = len(tokens) if max_tokens is None else min(
+            int(max_tokens), len(tokens))
+        if self.host_tier is None:
+            return 0, []
+        covered, entries = self._walk_chain(tokens, limit, namespace)
+        keys = [e[1] for e in entries if e[0] == "host"]
+        return covered, keys
+
+    def promote_chain(self, keys: Sequence[bytes], *,
+                      max_blocks: Optional[int] = None,
+                      ) -> Tuple[int, int]:
+        """Re-promote up to ``max_blocks`` host-tier records into
+        freshly acquired device blocks — ONE fused scatter per pool
+        array, the same device-write shape as :meth:`import_chain`, so
+        promotion compiles nothing new — publishing each under its own
+        boundary key and releasing (the chain lands refcount-zero in
+        the retention set, an ordinary device prefix hit for the next
+        admission).
+
+        Returns ``(keys_consumed, blocks_promoted)``: the caller (the
+        engine's per-step promotion feed) advances its cursor by the
+        first and charges the second against its budget. Keys already
+        device-resident are consumed for free. A key missing from the
+        host tier (its record was budget-evicted while the promotion
+        was in flight) TRUNCATES the chain: later records could never
+        be reached past the gap by a device walk, so the remainder is
+        consumed unpromoted and admission re-prefills from the gap —
+        degraded, never wrong."""
+        keys = list(keys)
+        if self.host_tier is None or not keys:
+            return len(keys), 0
+        budget = len(keys) if max_blocks is None else max(0,
+                                                          int(max_blocks))
+        avail = self.num_available
+        taken = 0
+        todo: List[Tuple[bytes, Dict]] = []
+        terminal = False
+        for key in keys:
+            if key in self._index:
+                taken += 1
+                continue
+            if len(todo) >= budget or len(todo) >= avail:
+                break       # out of budget/capacity — retry next step
+            rec = self.host_tier.get(key)
+            if rec is None:
+                terminal = True
+                break
+            todo.append((key, rec))
+            taken += 1
+        if todo:
+            blocks = self.acquire(len(todo))
+            assert blocks is not None  # len(todo) <= num_available
+            bs = self.block_size
+            idx = np.concatenate([np.arange(b * bs, (b + 1) * bs)
+                                  for b in blocks])
+            k_new = np.concatenate([np.asarray(r["k"])
+                                    for _, r in todo], axis=1)
+            v_new = np.concatenate([np.asarray(r["v"])
+                                    for _, r in todo], axis=1)
+            k = self.k.at[:, idx].set(
+                jnp.asarray(k_new, self.policy.store_dtype))
+            v = self.v.at[:, idx].set(
+                jnp.asarray(v_new, self.policy.store_dtype))
+            if self.policy.scaled:
+                barr = np.asarray(blocks, np.int32)
+                ks = np.stack([np.asarray(r["k_scale"])
+                               for _, r in todo], axis=1)
+                vs = np.stack([np.asarray(r["v_scale"])
+                               for _, r in todo], axis=1)
+                self.update(k, v,
+                            self.k_scale.at[:, barr].set(
+                                jnp.asarray(ks, jnp.float32)),
+                            self.v_scale.at[:, barr].set(
+                                jnp.asarray(vs, jnp.float32)))
+            else:
+                self.update(k, v)
+            for b, (key, rec) in zip(blocks, todo):
+                self._publish_one(b, key, int(rec["fill"]))
+            self.release(blocks)
+            self.host_tier.promotions += len(todo)
+            self.host_tier.promoted_tokens += sum(
+                int(r["fill"]) for _, r in todo)
+        if terminal:
+            taken = len(keys)
+        return taken, len(todo)
+
     # ---- chain export / import (disaggregated KV handoff) -----------
     def export_chain(self, tokens, *,
                      namespace: Optional[str] = None) -> Optional[Dict]:
@@ -527,42 +736,61 @@ class KVPool:
         policy's ``store_dtype`` — int8 blocks export as int8, ~4x
         smaller than f32) plus its per-block-per-head scale rows when
         the policy is scaled, so an import is a byte-exact replica of
-        the source blocks. Returns ``None`` when nothing is cached for
-        the prefix (evicted, or never published). Read-only: refcounts,
-        the index and the LRU are untouched beyond a touch."""
+        the source blocks. When a host tier is attached the chain is
+        assembled ACROSS tiers: device-resident boundaries come from
+        the fused pool gather, host-resident ones from their demoted
+        records (already host bytes, zero device traffic) — so a
+        replica can serve its whole retained working set to a peer,
+        not just the slice that happens to sit in HBM. Returns ``None``
+        when nothing is cached for the prefix (evicted from both
+        tiers, or never published). Read-only: refcounts, the index
+        and the LRUs are untouched beyond a touch."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
-        plan = self.lookup(tokens, max_tokens=len(tokens),
-                           namespace=namespace)
-        chain_blocks = list(plan.shared_blocks)
-        fills = [self.block_size] * len(chain_blocks)
-        if plan.cow_src is not None:
-            chain_blocks.append(plan.cow_src)
-            fills.append(plan.cow_len)
-        if not chain_blocks:
+        covered, entries = self._walk_chain(tokens, len(tokens),
+                                            namespace)
+        if not entries:
             return None
         bs = self.block_size
-        # ONE gather per pool array (then split host-side), not one
-        # device op per block: a chain transfer must cost O(chain
-        # bytes), never O(blocks * pool bytes)
-        idx = np.concatenate([np.arange(b * bs, (b + 1) * bs)
-                              for b in chain_blocks])
-        k_all = np.asarray(self.k[:, idx])
-        v_all = np.asarray(self.v[:, idx])
-        if self.policy.scaled:
-            barr = np.asarray(chain_blocks, np.int32)
-            ks_all = np.asarray(self.k_scale[:, barr])
-            vs_all = np.asarray(self.v_scale[:, barr])
-        records: List[Dict] = []
-        for j, fill in enumerate(fills):
-            rec = {"fill": int(fill),
-                   "k": k_all[:, j * bs:(j + 1) * bs],
-                   "v": v_all[:, j * bs:(j + 1) * bs]}
+        # ONE gather per pool array for the device-resident blocks
+        # (then split host-side), not one device op per block: a chain
+        # transfer must cost O(chain bytes), never O(blocks * pool
+        # bytes)
+        dev = [(j, e[1]) for j, e in enumerate(entries)
+               if e[0] == "dev"]
+        if dev:
+            idx = np.concatenate([np.arange(b * bs, (b + 1) * bs)
+                                  for _, b in dev])
+            k_all = np.asarray(self.k[:, idx])
+            v_all = np.asarray(self.v[:, idx])
             if self.policy.scaled:
-                rec["k_scale"] = ks_all[:, j]
-                rec["v_scale"] = vs_all[:, j]
+                barr = np.asarray([b for _, b in dev], np.int32)
+                ks_all = np.asarray(self.k_scale[:, barr])
+                vs_all = np.asarray(self.v_scale[:, barr])
+        dev_slot = {j: s for s, (j, _b) in enumerate(dev)}
+        records: List[Dict] = []
+        n_out = 0
+        for j, (kind, ref, fill) in enumerate(entries):
+            if kind == "dev":
+                s = dev_slot[j]
+                rec = {"fill": int(fill),
+                       "k": k_all[:, s * bs:(s + 1) * bs],
+                       "v": v_all[:, s * bs:(s + 1) * bs]}
+                if self.policy.scaled:
+                    rec["k_scale"] = ks_all[:, s]
+                    rec["v_scale"] = vs_all[:, s]
+            else:
+                rec = self.host_tier.get(ref)
+                if rec is None:
+                    # cannot happen single-threaded (the walk just saw
+                    # it); truncate at the gap rather than ship a
+                    # chain with a hole
+                    break
             records.append(rec)
-        return {"tokens": tokens[:plan.cached_tokens].copy(),
-                "n_tokens": int(plan.cached_tokens),
+            n_out += int(fill)
+        if not records:
+            return None
+        return {"tokens": tokens[:n_out].copy(),
+                "n_tokens": int(n_out),
                 "policy": self.policy.name,
                 "block_size": bs,
                 "n_layers": self.n_layers,
@@ -595,11 +823,16 @@ class KVPool:
         retained in the LRU exactly like a retired request's, so the
         next admission for this prefix hits instead of re-prefilling.
         Returns the number of token positions now served from cache
-        (0 when the pool cannot hold the chain or the prefix cache is
-        off — the caller's fallback is local re-prefill, which is
-        always correct). Keys already published keep their incumbent
-        block (the duplicate import frees on release), so a racing
-        local prefill can never be corrupted by a late handoff."""
+        (0 when the pool cannot hold any of the chain or the prefix
+        cache is off — the caller's fallback is local re-prefill,
+        which is always correct). A chain LARGER than the pool can
+        hold is not discarded: the longest block-aligned prefix that
+        fits is imported instead — the chain is cache, so a partial
+        import is always correct and still saves that many prefill
+        tokens (the dropped tail includes any partially-filled leaf).
+        Keys already published keep their incumbent block (the
+        duplicate import frees on release), so a racing local prefill
+        can never be corrupted by a late handoff."""
         self._check_chain_geometry(chain)
         records = chain["blocks"]
         n_tokens = int(chain["n_tokens"])
@@ -610,8 +843,14 @@ class KVPool:
             raise ValueError(
                 f"KV chain block count {len(records)} does not cover "
                 f"n_tokens={n_tokens} at block_size={self.block_size}")
+        n_fit = min(len(records), self.num_available)
+        if n_fit <= 0:
+            return 0
+        if n_fit < len(records):
+            records = records[:n_fit]
+            n_tokens = n_fit * self.block_size
         blocks = self.acquire(len(records))
-        if blocks is None:
+        if blocks is None:  # unreachable: capacity checked above
             return 0
         bs = self.block_size
         # ONE fused scatter per pool array — a per-block .at[].set

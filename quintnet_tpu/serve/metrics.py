@@ -205,6 +205,26 @@ class ServeMetrics:
     prefill_chunks: int = 0
     chunk_steps: int = 0
     chunk_tokens: int = 0
+    # tiered-KV ledger (serve/kv_tier.py): CUMULATIVE pool/tier
+    # counters mirrored (assigned, not summed) each step — the pool
+    # owns the truth, the mirror makes eviction/demotion/promotion
+    # visible to summary()/aggregate() and the Prometheus exporter.
+    # kv_cache_evictions: published device blocks evicted (tier off:
+    # chains destroyed; tier on: each eviction first demotes).
+    # kv_demotions / kv_promotions: blocks copied device->host /
+    # host->device; kv_host_evictions: host records dropped by the
+    # tier's own byte-budget LRU; host_hit_tokens: token positions
+    # re-promoted from host instead of re-prefilled;
+    # decode_blocked_demotions: demotions observed during a plain
+    # decode dispatch — structurally 0 (the bench gates it).
+    kv_cache_evictions: int = 0
+    kv_demotions: int = 0
+    kv_promotions: int = 0
+    kv_host_evictions: int = 0
+    host_hit_tokens: int = 0
+    decode_blocked_demotions: int = 0
+    # gauge: host bytes the tier currently holds (<= its byte budget)
+    host_tier_bytes: int = 0
     peak_kv_utilization: float = 0.0
     peak_running: int = 0
 
@@ -238,7 +258,14 @@ class ServeMetrics:
                     accepted_draft_tokens: int = 0,
                     prefill_chunks: int = 0,
                     kv_pool_bytes: int = 0,
-                    kv_bytes_per_token: float = 0.0) -> None:
+                    kv_bytes_per_token: float = 0.0,
+                    kv_cache_evictions: int = 0,
+                    kv_demotions: int = 0,
+                    kv_promotions: int = 0,
+                    kv_host_evictions: int = 0,
+                    host_hit_tokens: int = 0,
+                    host_tier_bytes: int = 0,
+                    decode_blocked_demotions: int = 0) -> None:
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
@@ -263,6 +290,15 @@ class ServeMetrics:
         if prefill_chunks > 0:
             self.chunk_steps += 1
             self.chunk_tokens += prefill_tokens
+        # tier ledger: cumulative mirrors (assigned, never summed —
+        # the engine passes the pool/tier counters' current values)
+        self.kv_cache_evictions = kv_cache_evictions
+        self.kv_demotions = kv_demotions
+        self.kv_promotions = kv_promotions
+        self.kv_host_evictions = kv_host_evictions
+        self.host_hit_tokens = host_hit_tokens
+        self.host_tier_bytes = host_tier_bytes
+        self.decode_blocked_demotions = decode_blocked_demotions
         util = kv_blocks_used / max(kv_blocks_total, 1)
         self.peak_kv_utilization = max(self.peak_kv_utilization, util)
         self.peak_running = max(self.peak_running, running)
@@ -336,6 +372,18 @@ class ServeMetrics:
         return self.prefix_hit_tokens / denom if denom else 0.0
 
     @property
+    def host_hit_rate(self) -> float:
+        """Fraction of all warm-or-computed prefill positions that
+        were served by a HOST-tier promotion rather than device cache
+        or fresh prefill: host_hit / (prefix_hit + prefill). Promoted
+        positions surface again as prefix_hit_tokens when the request
+        admits (the promoted chain is a device hit by then), so the
+        denominator already contains the numerator — the rate reads
+        as "share of prefill demand the host tier rescued"."""
+        denom = self.prefix_hit_tokens + self.prefill_tokens
+        return self.host_hit_tokens / denom if denom else 0.0
+
+    @property
     def tokens_per_decode_step(self) -> float:
         """Mean tokens committed per decode/verify invocation, summed
         over the batch — ~(mean active slots) for plain decoding (one
@@ -389,6 +437,14 @@ class ServeMetrics:
             "chunk_steps": self.chunk_steps,
             "chunk_tokens": self.chunk_tokens,
             "chunk_tokens_per_step": round(self.chunk_tokens_per_step, 4),
+            "kv_cache_evictions": self.kv_cache_evictions,
+            "kv_demotions": self.kv_demotions,
+            "kv_promotions": self.kv_promotions,
+            "kv_host_evictions": self.kv_host_evictions,
+            "host_hit_tokens": self.host_hit_tokens,
+            "host_hit_rate": round(self.host_hit_rate, 4),
+            "host_tier_bytes": self.host_tier_bytes,
+            "decode_blocked_demotions": self.decode_blocked_demotions,
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0
             else 0.0,
@@ -462,6 +518,7 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
             agg["gen_tokens"] += d["gen_tokens"]
             agg["groups"].append(_group(d["ttfts"]))
     hit = sum(m.prefix_hit_tokens for m in all_metrics)
+    host_hit = sum(m.host_hit_tokens for m in all_metrics)
     prefill = sum(m.prefill_tokens for m in all_metrics)
     dsteps = sum(m.decode_steps for m in all_metrics)
     dtok = sum(m.decode_tokens for m in all_metrics)
@@ -496,6 +553,20 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "chunk_tokens_per_step": round(
             sum(m.chunk_tokens for m in all_metrics)
             / max(sum(m.chunk_steps for m in all_metrics), 1), 4),
+        "kv_cache_evictions": sum(m.kv_cache_evictions
+                                  for m in all_metrics),
+        "kv_demotions": sum(m.kv_demotions for m in all_metrics),
+        "kv_promotions": sum(m.kv_promotions for m in all_metrics),
+        "kv_host_evictions": sum(m.kv_host_evictions
+                                 for m in all_metrics),
+        "host_hit_tokens": host_hit,
+        "host_hit_rate": round(host_hit / (hit + prefill), 4)
+        if (hit + prefill) else 0.0,
+        # fleet host-tier residency is the SUM of the replicas' tiers
+        # (each replica spills to its own host RAM)
+        "host_tier_bytes": sum(m.host_tier_bytes for m in all_metrics),
+        "decode_blocked_demotions": sum(m.decode_blocked_demotions
+                                        for m in all_metrics),
         "wall_s": round(wall, 4),
         "tokens_per_sec": round(gen_tokens / wall, 2) if wall > 0 else 0.0,
         "ttft_s": _pooled_pcts(ttft_groups),

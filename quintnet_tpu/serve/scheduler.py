@@ -27,6 +27,13 @@ import numpy as np
 from quintnet_tpu.serve.kv_pool import KVPool
 
 WAITING = "waiting"
+# host->device KV promotion in flight (serve/kv_tier.py): the request
+# stays at the head of the waiting queue — head-of-line order is
+# preserved — but next_admission holds it until the engine's per-step
+# promotion feed finishes re-importing its host-tier chain and flips
+# it back to WAITING, where admission finds the promoted chain as an
+# ordinary device prefix hit
+PROMOTING = "promoting"
 RUNNING = "running"
 FINISHED = "finished"
 
@@ -275,6 +282,13 @@ class Scheduler:
         if self.pool.num_available == 0:
             return None
         head = self.waiting[0]
+        if head.state == PROMOTING:
+            # the engine is streaming this request's host-tier chain
+            # back to the device under its per-step budget; admitting
+            # now would re-prefill what the promotion is about to make
+            # free — and admitting ANYTHING else would break the
+            # head-of-line ordering contract
+            return None
         plan = self.admission_plan(head)
         if not self.pool.can_admit(plan):
             return None

@@ -22,7 +22,8 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                     chunked_prefill: bool = False,
                     prefill_chunk_budget=None,
                     kv_dtype=None, prefix_cache: bool = True,
-                    attn_kernel: str = "xla"):
+                    attn_kernel: str = "xla",
+                    kv_tier_bytes: int = 0):
     from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
     from quintnet_tpu.serve import ServeEngine, gpt2_family
 
@@ -37,4 +38,5 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                        prefill_chunk_budget=prefill_chunk_budget,
                        kv_dtype=kv_dtype, prefix_cache=prefix_cache,
                        attn_kernel=attn_kernel, temperature=temperature,
-                       top_k=top_k, eos_token_id=eos_token_id)
+                       top_k=top_k, eos_token_id=eos_token_id,
+                       kv_tier_bytes=kv_tier_bytes)

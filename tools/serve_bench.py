@@ -49,6 +49,20 @@ Exp(rate)). Two trace shapes:
   the usable-blocks ratio at equal bytes, and extras carry the
   structural evidence (preemptions, cache evictions, hit rates, peak
   utilization, both pools' bytes);
+- ``--tier-trace``: the tiered-KV A/B (serve/kv_tier.py) over a
+  MANY-TENANT prefix set sized ``--tier-prefix-ratio`` x the usable
+  device pool (``--tier-prefixes`` distinct system prompts visited
+  round-robin with unique tails, ``--tier-repeats`` visits each): by
+  the time a prefix is revisited its chain has been LRU-evicted from
+  the device pool, so side A (host tier armed, ``--tier-bytes``)
+  demotes on eviction and re-promotes on the host-hit while side B
+  (evict-only: the identical engine, tier off) re-prefills from
+  scratch. The record's value is tiered tok/s, ``vs_baseline`` the
+  tok/s ratio, and extras carry the gates: warm hit rate vs the
+  evict-only hit rate, TTFT both sides, the tier ledger
+  (demotions/promotions/host bytes/host evictions), and the
+  structural ``decode_blocked_demotions == 0`` — demotion copies
+  never stall a decode step;
 - ``--lora-trace``: N tenants spread round-robin over ``--adapters``
   LoRA adapters (trained variants of one base model, saved through
   the real safetensors path) — the multi-tenant scenario
@@ -153,12 +167,15 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False,
                  params=None, adapters=None, max_seq=None,
                  prefill_len=None, chunked_prefill: bool = False,
                  prefill_chunk_budget=None, kv_dtype=None,
-                 num_blocks=None, attn_kernel=None):
+                 num_blocks=None, attn_kernel=None,
+                 kv_tier_bytes: int = 0,
+                 kv_tier_promote_budget_bytes=None):
     from quintnet_tpu.serve import ServeEngine, SpecConfig
 
     family, params = build_model(args, params=params)
     max_prompt = (args.shared_prefix + args.max_tail
                   if args.prefix_share or args.kv_capacity
+                  or args.tier_trace
                   else args.max_prompt)
     if max_seq is None:
         max_seq = min(max_prompt + args.max_new, family.max_positions)
@@ -175,7 +192,9 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False,
         attn_kernel=(attn_kernel if attn_kernel is not None
                      else args.kernel),
         spec=SpecConfig(max_draft=args.max_draft) if spec else None,
-        adapters=adapters, lora_max_rank=args.lora_rank)
+        adapters=adapters, lora_max_rank=args.lora_rank,
+        kv_tier_bytes=kv_tier_bytes,
+        kv_tier_promote_budget_bytes=kv_tier_promote_budget_bytes)
 
 
 def poisson_arrivals(rng, n: int, rate: float):
@@ -238,6 +257,40 @@ def prefix_share_trace(args, vocab_size: int):
         n = int(rng.integers(args.min_tail, args.max_tail + 1))
         tail = rng.integers(0, vocab_size, (n,)).astype(np.int32)
         trace.append((t, np.concatenate([shared, tail]), args.max_new))
+    return trace
+
+
+def tier_trace_gen(args, vocab_size: int):
+    """MANY-TENANT prefix churn for the tiered-KV A/B: P distinct
+    system prompts visited round-robin with unique tails,
+    ``--tier-repeats`` visits each. P is sized so the prefix set
+    costs ``--tier-prefix-ratio`` x the usable device pool (or pinned
+    by ``--tier-prefixes``) — the revisit gap is P whole prefixes, so
+    by the time prefix j comes around again the device LRU has
+    destroyed its chain: the tiered engine serves the revisit from
+    host RAM, the evict-only engine re-prefills from scratch.
+    Resolves ``args.tier_prefixes`` to the chosen P as a side effect
+    so the run() branch can report it. [(t, prompt, max_new)]"""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    blocks_per_prefix = -(-args.shared_prefix // args.block_size)
+    usable = max(args.num_blocks - 1, 1)  # minus the reserved null
+    if not args.tier_prefixes:
+        args.tier_prefixes = max(2, round(
+            args.tier_prefix_ratio * usable / blocks_per_prefix))
+    prefixes = [rng.integers(0, vocab_size,
+                             (args.shared_prefix,)).astype(np.int32)
+                for _ in range(args.tier_prefixes)]
+    n_requests = args.tier_repeats * args.tier_prefixes
+    arrivals = poisson_arrivals(rng, n_requests, args.rate)
+    trace = []
+    for j, t in enumerate(arrivals):
+        n = int(rng.integers(args.min_tail, args.max_tail + 1))
+        tail = rng.integers(0, vocab_size, (n,)).astype(np.int32)
+        trace.append(
+            (t, np.concatenate([prefixes[j % args.tier_prefixes], tail]),
+             args.max_new))
     return trace
 
 
@@ -687,6 +740,80 @@ def run(args) -> dict:
             "extras": extras,
         }
 
+    if args.tier_trace:
+        # tiered-KV A/B (serve/kv_tier.py) over the many-tenant churn
+        # trace: the SAME engine twice — host tier armed vs evict-only
+        # — so every delta is the tier. The host budget defaults to 4x
+        # the device pool's bytes (the spill-to-abundant-host-RAM
+        # regime the tier is for); --tier-bytes pins it.
+        from quintnet_tpu.serve.kv_quant import make_policy
+
+        family, params = build_model(args)
+        dims = dict(n_layers=family.n_layers,
+                    n_kv_heads=family.n_kv_heads,
+                    head_dim=family.head_dim, block_size=args.block_size)
+        per_block = make_policy(args.kv_dtype).bytes_per_block(**dims)
+        tier_bytes = int(args.tier_bytes
+                         or 4 * args.num_blocks * per_block)
+        promote_bytes = (args.tier_promote_blocks * per_block
+                         if args.tier_promote_blocks else None)
+        eng_t = build_engine(args, prefix_cache=True, params=params,
+                             kv_tier_bytes=tier_bytes,
+                             kv_tier_promote_budget_bytes=promote_bytes)
+        trace = tier_trace_gen(args, eng_t.family.cfg.vocab_size)
+        s_t = replay(eng_t, trace, args)
+        eng_e = build_engine(args, prefix_cache=True, params=params)
+        s_e = replay(eng_e, trace, args)
+        # THE structural gate: a demotion copy must never ride a plain
+        # decode dispatch — the tier's whole latency contract
+        assert s_t["decode_blocked_demotions"] == 0, \
+            "demotion blocked a decode step"
+        extras = _common_extras(args, s_t)
+        ratio = round(s_t["tokens_per_sec"]
+                      / max(s_e["tokens_per_sec"], 1e-9), 3)
+        extras.update({
+            "tier_trace": True,
+            "kv_dtype": args.kv_dtype,
+            "shared_prefix": args.shared_prefix,
+            "tier_prefixes": args.tier_prefixes,
+            "tier_repeats": args.tier_repeats,
+            "tier_byte_budget": tier_bytes,
+            "tier_promote_blocks": args.tier_promote_blocks,
+            "requests": len(trace),
+            # the tier ledger (tiered side)
+            "kv_demotions": s_t["kv_demotions"],
+            "kv_promotions": s_t["kv_promotions"],
+            "kv_host_evictions": s_t["kv_host_evictions"],
+            "host_hit_tokens": s_t["host_hit_tokens"],
+            "host_hit_rate": s_t["host_hit_rate"],
+            "host_tier_bytes": s_t["host_tier_bytes"],
+            "decode_blocked_demotions": s_t["decode_blocked_demotions"],
+            "kv_cache_evictions": s_t["kv_cache_evictions"],
+            # the A/B: a revisited prefix is a host hit on the tiered
+            # side (promotion memcpy + tail prefill) and a cold
+            # re-prefill on the evict-only side — hit rate and TTFT
+            # are the committed wins
+            "warm_hit_rate": s_t["prefix_hit_rate"],
+            "evict_only_hit_rate": s_e["prefix_hit_rate"],
+            "evict_only_ttft_p50_s": s_e["ttft_s"]["p50"],
+            "evict_only_ttft_p95_s": s_e["ttft_s"]["p95"],
+            "evict_only_tokens_per_sec": s_e["tokens_per_sec"],
+            "evict_only_wall_s": s_e["wall_s"],
+            "evict_only_prefill_tokens": s_e["prefill_tokens"],
+            "evict_only_cache_evictions": s_e["kv_cache_evictions"],
+            "evict_only_finished": s_e["finished"],
+            "evict_only_preempted": s_e["preempted"],
+            "speedup_vs_evict_only": ratio,
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_tier_tokens_per_sec",
+            "value": s_t["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": ratio,
+            "rc": 0,
+            "extras": extras,
+        }
+
     if args.prefix_share:
         # A/B over the SAME shared-prefix trace: cache-on vs cache-off
         eng_on = build_engine(args, prefix_cache=True)
@@ -969,6 +1096,27 @@ def main():
                          "shared-prefix trace: f32 at --num-blocks vs "
                          "--kv-dtype (int8 unless set otherwise) at "
                          "however many blocks the same bytes buy")
+    ap.add_argument("--tier-trace", action="store_true",
+                    help="tiered-KV A/B over a many-tenant prefix-"
+                         "churn trace: host tier armed (demote on "
+                         "evict, promote on host-hit) vs the identical"
+                         " evict-only engine; the prefix set is sized "
+                         "--tier-prefix-ratio x the device pool so "
+                         "every revisit has been evicted")
+    ap.add_argument("--tier-prefixes", type=int, default=None,
+                    help="distinct system prompts in the --tier-trace "
+                         "(default: auto-sized from the ratio)")
+    ap.add_argument("--tier-prefix-ratio", type=float, default=3.5,
+                    help="prefix-set footprint as a multiple of the "
+                         "usable device pool (--tier-trace)")
+    ap.add_argument("--tier-repeats", type=int, default=3,
+                    help="visits per prefix in the --tier-trace")
+    ap.add_argument("--tier-bytes", type=int, default=None,
+                    help="host-tier byte budget (--tier-trace; "
+                         "default: 4x the device pool's bytes)")
+    ap.add_argument("--tier-promote-blocks", type=int, default=None,
+                    help="promotion budget in blocks per engine step "
+                         "(--tier-trace; default: the engine's own)")
     ap.add_argument("--prefix-share", action="store_true",
                     help="shared-system-prompt trace, reported cache-on "
                          "vs cache-off over the same trace")
