@@ -256,6 +256,33 @@ def expected_serve_verify(n_layers: int, *,
                                  vocab_parallel=vocab_parallel)
 
 
+def expected_serve_moe(n_layers: int, *,
+                       ep_axis: Optional[str] = None,
+                       tp_axis: Optional[str] = None,
+                       vocab_parallel: bool = False) -> CensusDict:
+    """One compiled serving program (any of prefill/decode/verify) of
+    an MoE family whose experts are sharded over ``ep_axis``: the
+    dense tp census unchanged (the router, attention and lm_head are
+    ep-replicated; expert FFN tp psums fold into the same 2-per-layer
+    count) PLUS exactly **2 all_to_alls per MoE layer** — dispatch
+    (tokens to their experts' owner ranks) and combine (expert
+    outputs back, nn/moe.py) — and nothing else: the capacity-bounded
+    scatter/gather is local, the router replicated. ``ep_axis=None``
+    (ep=1 or no mesh) is the dense-replicated program: the MoE math
+    runs everywhere identically, ZERO ep collectives — the census
+    face of the ep=1 == dense-replication bit-identity contract.
+    Independent of bucket width, top_k and capacity, so every bucket
+    of every program kind must match this same spec."""
+    c = expected_serve_prefill(n_layers, tp_axis=tp_axis,
+                               vocab_parallel=vocab_parallel)
+    if ep_axis is not None:
+        c = dict(c)
+        c[ep_axis] = dict(c.get(ep_axis, {}))
+        c[ep_axis]["all_to_all"] = (
+            c[ep_axis].get("all_to_all", 0) + 2 * n_layers)
+    return c
+
+
 def expected_serve_sp_prefill(n_layers: int, sp: int, *,
                               sp_axis: str = "sp") -> CensusDict:
     """One compiled SEQUENCE-PARALLEL prefill bucket (long-context

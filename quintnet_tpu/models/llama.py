@@ -360,20 +360,30 @@ def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None,
 def llama_mlp_residual(p, x, cfg: LlamaConfig, *,
                        tp_axis: Optional[str] = None,
                        ep_axis: Optional[str] = None,
-                       lora=None, lora_scale=None):
+                       lora=None, lora_scale=None,
+                       return_stats: bool = False):
     """-> (x + FFN(ln2(x)), moe_aux) — aux is 0.0 for dense blocks.
     THE one FFN-residual implementation for training forward, prefill
     and decode (a fix here fixes all three). ``lora``: per-slot packed
     gate/up/down adapters (serving multi-LoRA; MoE blocks have no LoRA
-    targets and ignore it)."""
+    targets and ignore it). ``return_stats`` (serving): widen the
+    return to (x, aux, routing_stats_or_None) — the MoE routing-stats
+    dict (nn/moe.py moe_apply) the engine's metrics ledger reads."""
     h = rms_norm_apply(p["ln2"], x, eps=cfg.rms_eps)
     if "moe" in p:
+        if return_stats:
+            y, aux, stats = moe_apply(p["moe"], h, cfg.moe_args,
+                                      ep_axis=ep_axis, tp_axis=tp_axis,
+                                      return_stats=True)
+            return x + y, aux, stats
         y, aux = moe_apply(p["moe"], h, cfg.moe_args, ep_axis=ep_axis,
                            tp_axis=tp_axis)
         return x + y, aux
-    return x + swiglu_apply(p["mlp"], h, tp_axis=tp_axis, lora=lora,
-                            lora_scale=lora_scale), \
-        jnp.zeros((), jnp.float32)
+    out = x + swiglu_apply(p["mlp"], h, tp_axis=tp_axis, lora=lora,
+                           lora_scale=lora_scale)
+    if return_stats:
+        return out, jnp.zeros((), jnp.float32), None
+    return out, jnp.zeros((), jnp.float32)
 
 
 def llama_block_apply(p, x, cfg: LlamaConfig, *, cos, sin,
@@ -438,6 +448,7 @@ def llama_block_prefill(p, x, cfg: LlamaConfig, cos, sin,
 def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
                               cfg: LlamaConfig, cos, sin,
                               tp_axis: Optional[str] = None,
+                              ep_axis: Optional[str] = None,
                               block_tables=None,
                               block_size: Optional[int] = None,
                               lora=None, lora_scale=None,
@@ -530,10 +541,12 @@ def llama_block_prefill_paged(p, x, kc, vc, positions, tail_len,
                                       axis=-1).astype(q.dtype), vf)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
                             lora=attn_lora, lora_scale=lora_scale)
-    x, _aux = llama_mlp_residual(
-        p, x, cfg, tp_axis=tp_axis,
+    x, _aux, stats = llama_mlp_residual(
+        p, x, cfg, tp_axis=tp_axis, ep_axis=ep_axis,
         lora=lora.get("mlp") if lora is not None else None,
-        lora_scale=lora_scale)
+        lora_scale=lora_scale, return_stats=True)
+    if "moe" in p:
+        return x, (*pools, stats)
     return x, pools
 
 
@@ -570,6 +583,7 @@ def llama_block_prefill_paged_sp(p, x, kc, vc, start, t0,
 def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
                              cfg: LlamaConfig, cos, sin,
                              tp_axis: Optional[str] = None,
+                             ep_axis: Optional[str] = None,
                              block_tables=None,
                              block_size: Optional[int] = None,
                              lora=None, lora_scale=None,
@@ -657,15 +671,18 @@ def llama_block_verify_paged(p, x, kc, vc, positions, tail_lens,
                                       axis=-1).astype(q.dtype), vf)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
                             lora=attn_lora, lora_scale=lora_scale)
-    x, _aux = llama_mlp_residual(
-        p, x, cfg, tp_axis=tp_axis,
+    x, _aux, stats = llama_mlp_residual(
+        p, x, cfg, tp_axis=tp_axis, ep_axis=ep_axis,
         lora=lora.get("mlp") if lora is not None else None,
-        lora_scale=lora_scale)
+        lora_scale=lora_scale, return_stats=True)
+    if "moe" in p:
+        return x, (*pools, stats)
     return x, pools
 
 
 def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
                        tp_axis: Optional[str] = None,
+                       ep_axis: Optional[str] = None,
                        block_tables=None, block_size: Optional[int] = None,
                        lora=None, lora_scale=None,
                        kv_scales=None, policy=None,
@@ -769,11 +786,14 @@ def llama_block_decode(p, x, kc, vc, pos, cfg: LlamaConfig, cos, sin,
                                       axis=-1).astype(q.dtype), vf)
     x = llama_attn_residual(p["attn"], x, o, tp_axis=tp_axis,
                             lora=attn_lora, lora_scale=lora_scale)
-    x, _aux = llama_mlp_residual(
-        p, x, cfg, tp_axis=tp_axis,
+    x, _aux, stats = llama_mlp_residual(
+        p, x, cfg, tp_axis=tp_axis, ep_axis=ep_axis,
         lora=lora.get("mlp") if lora is not None else None,
-        lora_scale=lora_scale)
-    return x, (pools if pools is not None else (kc, vc))
+        lora_scale=lora_scale, return_stats=True)
+    out_pools = pools if pools is not None else (kc, vc)
+    if "moe" in p:
+        return x, (*out_pools, stats)
+    return x, out_pools
 
 
 def _positions(b, s, sp_axis: Optional[str]):

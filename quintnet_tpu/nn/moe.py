@@ -137,12 +137,22 @@ def _capacity(s_local: int, args: MoEArgs) -> int:
 
 
 def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
-              tp_axis: Optional[str] = None, act=gelu):
-    """x: [B, T_local, D] -> (y, aux_loss).
+              tp_axis: Optional[str] = None, act=gelu,
+              return_stats: bool = False):
+    """x: [B, T_local, D] -> (y, aux_loss[, stats]).
 
     All shapes static: S = B*T local tokens, E experts, per-rank
     per-expert capacity C. Tokens routed beyond capacity are dropped
     (identity residual path in the transformer block keeps them alive).
+
+    ``return_stats`` adds a routing-stats dict (all f32, computed from
+    the replicated routing math so every ep/tp rank holds identical
+    values): ``expert_tokens`` [E] — routed assignment demand per
+    expert BEFORE the capacity cut (the honest skew signal: post-cut
+    loads saturate at C under a hot expert); ``dropped`` — assignments
+    past capacity (masked into the dump row); ``assigned`` — total
+    assignments S*k; ``entropy`` — mean per-token router entropy in
+    nats. The serving engine ships these to ServeMetrics per step.
     """
     B, T, D = x.shape
     S = B * T
@@ -165,7 +175,7 @@ def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
     if args.router == "expert_choice":
         return _moe_expert_choice(p, xt, probs, logits, (B, T, D), C,
                                   args, ep_axis=ep_axis, tp_axis=tp_axis,
-                                  act=act)
+                                  act=act, return_stats=return_stats)
 
     gate_v, gate_i = lax.top_k(probs, k)  # [S, k]
     if args.normalize_gates:
@@ -211,7 +221,22 @@ def moe_apply(p, x, args: MoEArgs, *, ep_axis: Optional[str] = None,
         z = jax.scipy.special.logsumexp(logits, axis=-1)
         aux = aux + args.z_weight * jnp.mean(jnp.square(z))
 
-    return yt.reshape(B, T, D), aux
+    y_out = yt.reshape(B, T, D)
+    if return_stats:
+        return y_out, aux, _routing_stats(oh, keep, probs, S * k)
+    return y_out, aux
+
+
+def _routing_stats(oh, keep, probs, assigned: int):
+    """Per-call routing stats from the (replicated) dispatch masks —
+    see :func:`moe_apply`'s docstring for field semantics."""
+    return {
+        "expert_tokens": jnp.sum(oh, axis=0).astype(jnp.float32),
+        "dropped": jnp.sum(~keep).astype(jnp.float32),
+        "assigned": jnp.asarray(float(assigned), jnp.float32),
+        "entropy": -jnp.mean(
+            jnp.sum(probs * jnp.log(probs + 1e-9), axis=-1)),
+    }
 
 
 def _expert_ffn(p, xe, *, act, tp_axis):
@@ -234,7 +259,7 @@ def _expert_ffn(p, xe, *, act, tp_axis):
 
 
 def _moe_expert_choice(p, xt, probs, logits, btd, C, args: MoEArgs, *,
-                       ep_axis, tp_axis, act=gelu):
+                       ep_axis, tp_axis, act=gelu, return_stats=False):
     """Expert-choice routing: expert e takes the C tokens with the
     highest affinity probs[:, e]; combine weight = that affinity.
     Every expert buffer is exactly full (no drops, no load imbalance),
@@ -264,4 +289,16 @@ def _moe_expert_choice(p, xt, probs, logits, btd, C, args: MoEArgs, *,
     if args.z_weight:
         z = jax.scipy.special.logsumexp(logits, axis=-1)
         aux = args.z_weight * jnp.mean(jnp.square(z))
+    if return_stats:
+        # expert choice is perfectly balanced by construction: every
+        # expert takes exactly C tokens, nothing is dropped
+        E = probs.shape[-1]
+        stats = {
+            "expert_tokens": jnp.full((E,), float(C), jnp.float32),
+            "dropped": jnp.zeros((), jnp.float32),
+            "assigned": jnp.asarray(float(E * C), jnp.float32),
+            "entropy": -jnp.mean(
+                jnp.sum(probs * jnp.log(probs + 1e-9), axis=-1)),
+        }
+        return yt.reshape(B, T, D), aux, stats
     return yt.reshape(B, T, D), aux

@@ -231,16 +231,22 @@ def stacked_blocks_apply(
 
 def _block_mlp(p, x, *, act, moe_args, ep_axis, tp_axis, lora=None,
                lora_scale=None):
-    """The MLP half of a block (dense or MoE, aux discarded). ``lora``:
+    """The MLP half of a block -> ``(x, routing_stats_or_None)``. The
+    serving helpers append the MoE stats (per-expert routed counts,
+    capacity drops, router entropy — nn/moe.py moe_apply) to their
+    return tuple so the engine's metrics ledger reads the program's own
+    numbers instead of re-deriving routing host-side; the training-side
+    aux loss has no serving consumer and stays dropped here. ``lora``:
     this layer's packed per-slot mlp adapters (fc/proj targets; serving
     multi-LoRA) — MoE blocks have no LoRA targets and ignore it."""
     h = layer_norm_apply(p["ln2"], x)
     if moe_args is not None:
-        y, _aux = moe_apply(p["moe"], h, moe_args, ep_axis=ep_axis,
-                            tp_axis=tp_axis, act=act)
-        return x + y
+        y, _aux, stats = moe_apply(p["moe"], h, moe_args, ep_axis=ep_axis,
+                                   tp_axis=tp_axis, act=act,
+                                   return_stats=True)
+        return x + y, stats
     return x + mlp_apply(p["mlp"], h, act=act, tp_axis=tp_axis,
-                         lora=lora, lora_scale=lora_scale)
+                         lora=lora, lora_scale=lora_scale), None
 
 
 def block_prefill(p, x, *, num_heads: int, act: Callable = gelu,
@@ -254,13 +260,15 @@ def block_prefill(p, x, *, num_heads: int, act: Callable = gelu,
                           num_heads=num_heads, causal=True, return_kv=True,
                           tp_axis=tp_axis)
     x = x + a
-    return _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                      tp_axis=tp_axis), (k, v)
+    x, _stats = _block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
+                           tp_axis=tp_axis)
+    return x, (k, v)
 
 
 def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
                         num_heads: int, act: Callable = gelu,
                         moe_args: Optional[MoEArgs] = None,
+                        ep_axis: Optional[str] = None,
                         tp_axis: Optional[str] = None,
                         block_tables=None,
                         block_size: Optional[int] = None,
@@ -273,8 +281,11 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
     prefix-cached prefill path. ``lora``/``lora_scale``: this layer's
     packed per-slot adapters (serving multi-LoRA; serve/adapters.py).
     ``kv_scales``/``policy``: scaled KV layout (serve/kv_quant.py) —
-    this layer's (k_scale, v_scale) ride along and come back. Returns
-    (x, k_cache, v_cache[, k_scale, v_scale])."""
+    this layer's (k_scale, v_scale) ride along and come back.
+    ``ep_axis``: MoE expert parallelism — experts sharded over the
+    axis, one all_to_all each way inside the FFN (nn/moe.py). Returns
+    (x, k_cache, v_cache[, k_scale, v_scale][, moe_stats]) — MoE
+    blocks append their routing-stats dict."""
     attn_lora = lora.get("attn") if lora is not None else None
     out = mha_prefill_paged(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
@@ -282,11 +293,14 @@ def block_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
         block_tables=block_tables, block_size=block_size,
         lora=attn_lora, lora_scale=lora_scale,
         kv_scales=kv_scales, policy=policy, attn_kernel=attn_kernel)
-    x = x + out[0]
-    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                       tp_axis=tp_axis,
-                       lora=lora.get("mlp") if lora is not None else None,
-                       lora_scale=lora_scale), *out[1:])
+    x, stats = _block_mlp(
+        p, x + out[0], act=act, moe_args=moe_args, ep_axis=ep_axis,
+        tp_axis=tp_axis,
+        lora=lora.get("mlp") if lora is not None else None,
+        lora_scale=lora_scale)
+    if moe_args is not None:
+        return (x, *out[1:], stats)
+    return (x, *out[1:])
 
 
 def block_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
@@ -309,14 +323,17 @@ def block_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
         start, t0, num_heads=num_heads, sp_axis=sp_axis, tp_axis=tp_axis,
         block_tables=block_tables, block_size=block_size,
         kv_scales=kv_scales, policy=policy)
-    x = x + out[0]
-    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                       tp_axis=tp_axis), *out[1:])
+    # sp prefill never composes with MoE (the engine rejects the pair
+    # at construction), so the stats-free return shape is invariant
+    x, _stats = _block_mlp(p, x + out[0], act=act, moe_args=moe_args,
+                           ep_axis=None, tp_axis=tp_axis)
+    return (x, *out[1:])
 
 
 def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
                        num_heads: int, act: Callable = gelu,
                        moe_args: Optional[MoEArgs] = None,
+                       ep_axis: Optional[str] = None,
                        tp_axis: Optional[str] = None,
                        block_tables=None,
                        block_size: Optional[int] = None,
@@ -328,8 +345,9 @@ def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
     caches are flat pool views — the serve engine's speculative-decode
     scoring path (serve/spec.py). ``lora``/``lora_scale``: this layer's
     packed per-slot adapters. ``kv_scales``/``policy``: scaled KV
-    layout (serve/kv_quant.py). Returns
-    (x, k_cache, v_cache[, k_scale, v_scale])."""
+    layout (serve/kv_quant.py). ``ep_axis``: expert parallelism for
+    MoE blocks (nn/moe.py). Returns
+    (x, k_cache, v_cache[, k_scale, v_scale][, moe_stats])."""
     attn_lora = lora.get("attn") if lora is not None else None
     out = mha_verify_paged(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache,
@@ -337,16 +355,20 @@ def block_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
         block_tables=block_tables, block_size=block_size,
         lora=attn_lora, lora_scale=lora_scale,
         kv_scales=kv_scales, policy=policy, attn_kernel=attn_kernel)
-    x = x + out[0]
-    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                       tp_axis=tp_axis,
-                       lora=lora.get("mlp") if lora is not None else None,
-                       lora_scale=lora_scale), *out[1:])
+    x, stats = _block_mlp(
+        p, x + out[0], act=act, moe_args=moe_args, ep_axis=ep_axis,
+        tp_axis=tp_axis,
+        lora=lora.get("mlp") if lora is not None else None,
+        lora_scale=lora_scale)
+    if moe_args is not None:
+        return (x, *out[1:], stats)
+    return (x, *out[1:])
 
 
 def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
                  act: Callable = gelu,
                  moe_args: Optional[MoEArgs] = None,
+                 ep_axis: Optional[str] = None,
                  tp_axis: Optional[str] = None,
                  block_tables=None, block_size: Optional[int] = None,
                  lora=None, lora_scale=None,
@@ -359,8 +381,9 @@ def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
     (quintnet_tpu/serve/); default is the dense single-request cache.
     ``lora``/``lora_scale``: this layer's packed per-slot adapters
     (multi-tenant LoRA serving). ``kv_scales``/``policy``: scaled KV
-    layout (serve/kv_quant.py; paged path only) — returns
-    (x, k_cache, v_cache[, k_scale, v_scale])."""
+    layout (serve/kv_quant.py; paged path only). ``ep_axis``: expert
+    parallelism for MoE blocks (nn/moe.py) — returns
+    (x, k_cache, v_cache[, k_scale, v_scale][, moe_stats])."""
     attn_lora = lora.get("attn") if lora is not None else None
     out = mha_decode(
         p["attn"], layer_norm_apply(p["ln1"], x), k_cache, v_cache, pos,
@@ -368,8 +391,11 @@ def block_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
         block_tables=block_tables, block_size=block_size,
         lora=attn_lora, lora_scale=lora_scale,
         kv_scales=kv_scales, policy=policy, attn_kernel=attn_kernel)
-    x = x + out[0]
-    return (_block_mlp(p, x, act=act, moe_args=moe_args, ep_axis=None,
-                       tp_axis=tp_axis,
-                       lora=lora.get("mlp") if lora is not None else None,
-                       lora_scale=lora_scale), *out[1:])
+    x, stats = _block_mlp(
+        p, x + out[0], act=act, moe_args=moe_args, ep_axis=ep_axis,
+        tp_axis=tp_axis,
+        lora=lora.get("mlp") if lora is not None else None,
+        lora_scale=lora_scale)
+    if moe_args is not None:
+        return (x, *out[1:], stats)
+    return (x, *out[1:])

@@ -55,6 +55,9 @@ _COUNTER_KEYS = frozenset({
     "kv_cache_evictions", "kv_demotions", "kv_promotions",
     "kv_host_evictions", "host_hit_tokens", "decode_blocked_demotions",
     "tier_probes", "tier_peer_transfers", "tier_peer_fallbacks",
+    # MoE routing ledger (serve/metrics.py): drop_rate/skew/entropy
+    # stay gauges
+    "moe_routed_tokens", "moe_dropped_tokens",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
@@ -128,6 +131,20 @@ def _add_summary(b: _Builder, prefix: str, summary: Dict,
             for aid, d in sorted(v.items()):
                 al = dict(labels or {}, adapter=aid)
                 _add_summary(b, f"{prefix}_adapter", d, labels=al)
+            continue
+        if key == "moe_expert_tokens" and isinstance(v, dict):
+            # per-expert cumulative routed demand ({expert id ->
+            # count}, serve/metrics.py) -> one counter family labeled
+            # by expert — the per-expert utilization series a
+            # hot-expert dashboard plots
+            name = _metric_name(prefix, key)
+            for eid, count in sorted(v.items(),
+                                     key=lambda kv: int(kv[0])):
+                b.add(name, count,
+                      labels=dict(labels or {}, expert=str(eid)),
+                      mtype="counter",
+                      help_="token-expert assignments routed to this "
+                            "expert (pre-capacity-cut demand)")
             continue
         if _is_pct_dict(v):
             name = _metric_name(prefix, key)

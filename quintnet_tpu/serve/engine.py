@@ -196,6 +196,7 @@ class ServeEngine:
                  top_p: float = 1.0, policy: str = "fcfs",
                  mesh=None, tp_axis: str = "tp",
                  sp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None,
                  chunked_prefill: bool = False,
                  prefill_chunk_budget: Optional[int] = None,
                  kv_dtype=None,
@@ -275,6 +276,73 @@ class ServeEngine:
                 "sequence-parallel prefill (the ring path is XLA-only)"
                 " — drop sp_axis or use attn_kernel='xla'")
         self.attn_kernel = attn_kernel
+        # MoE serving (nn/moe.py through the family moe_args seam): an
+        # ``ep`` mesh axis of size > 1 shards the experts — one
+        # all_to_all each way per MoE layer inside every program
+        # (census pinned in analysis/specs.expected_serve_moe). ep
+        # absent or of size 1 builds the dense-replicated MoE programs
+        # — the bit-identity contract engine(ep=1) promises. ep x tp
+        # composes (moe_specs column/row-shards the expert FFN inside
+        # each expert); ep x sp and ep x adapters are rejected here,
+        # PR-9 style. MoEArgs misconfigurations fail HERE with
+        # actionable errors, never deep inside the first serving
+        # step's trace.
+        moe = getattr(family.cfg, "moe_args", None)
+        self.moe_args = moe
+        self._moe_on = moe is not None
+        self._moe_acc: List[Dict] = []
+        self.ep_axis: Optional[str] = None
+        if moe is not None:
+            if not 1 <= moe.top_k <= moe.n_experts:
+                raise ValueError(
+                    f"MoEArgs.top_k={moe.top_k} must be in "
+                    f"[1, n_experts={moe.n_experts}]")
+            if moe.capacity is not None and int(moe.capacity) < 1:
+                raise ValueError(
+                    f"MoEArgs.capacity={moe.capacity} gives every "
+                    f"expert a non-positive token buffer (every "
+                    f"routed token would be dropped) — pass a "
+                    f"positive capacity, or None to derive it from "
+                    f"capacity_factor")
+            if moe.capacity is None and moe.capacity_factor <= 0:
+                raise ValueError(
+                    f"MoEArgs.capacity_factor={moe.capacity_factor} "
+                    f"must be > 0 — it sizes the per-expert token "
+                    f"buffer C = ceil(S*top_k/E * capacity_factor)")
+            if self.sp_axis is not None:
+                raise NotImplementedError(
+                    "sequence-parallel prefill does not yet compose "
+                    "with MoE families — drop sp_axis")
+        if ep_axis is not None:
+            if moe is None:
+                raise ValueError(
+                    f"ep_axis={ep_axis!r} requires an MoE family "
+                    f"(cfg.n_experts > 0); this {family.name!r} config "
+                    f"is dense")
+            if mesh is None or ep_axis not in mesh.shape:
+                # like sp: an explicitly-requested axis the mesh does
+                # not carry is a misconfiguration, not a degenerate
+                # case — silently running replicated would burn N
+                # devices for nothing
+                raise ValueError(
+                    f"ep_axis={ep_axis!r} is not an axis of the mesh "
+                    f"({None if mesh is None else tuple(mesh.shape)}); "
+                    f"pass a mesh with that axis (size 1 falls back to "
+                    f"the dense-replicated MoE programs) or drop "
+                    f"ep_axis")
+            if adapters:
+                raise NotImplementedError(
+                    "expert-parallel serving does not yet compose "
+                    "with multi-tenant adapters — drop ep_axis or "
+                    "serve adapters on a replicated MoE engine")
+            ep = int(mesh.shape[ep_axis])
+            if moe.n_experts % ep != 0:
+                raise ValueError(
+                    f"n_experts={moe.n_experts} must be divisible by "
+                    f"the ep axis size {ep} — each rank owns "
+                    f"n_experts/ep experts (nn/moe.py moe_specs)")
+            if ep > 1:
+                self.ep_axis = ep_axis
         self.logger = logger
         self.log_every = int(log_every)
         self.clock = clock
@@ -583,6 +651,7 @@ class ServeEngine:
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
         sp_axis = self.sp_axis
+        ep_axis = self.ep_axis
         attn_kernel = self.attn_kernel
         use_lora = self.adapters is not None
         policy = self.kv_policy
@@ -626,7 +695,7 @@ class ServeEngine:
             if sp_axis is None:
                 out = family.prefill_from(
                     params, k_pool, v_pool, ids, start, t0, table_row,
-                    bs, tp_axis=tp_axis, lora=lora,
+                    bs, tp_axis=tp_axis, ep_axis=ep_axis, lora=lora,
                     lora_scale=lora_scale, kv_scales=kv_scales,
                     policy=policy, attn_kernel=attn_kernel)
             else:
@@ -652,6 +721,7 @@ class ServeEngine:
     def _build_decode(self, *, donate):
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        ep_axis = self.ep_axis
         attn_kernel = self.attn_kernel
         use_lora = self.adapters is not None
         policy = self.kv_policy
@@ -664,7 +734,8 @@ class ServeEngine:
             lora, lora_scale = rest if use_lora else (None, None)
             out = family.decode(
                 params, k_pool, v_pool, tok, pos, tables, bs,
-                tp_axis=tp_axis, lora=lora, lora_scale=lora_scale,
+                tp_axis=tp_axis, ep_axis=ep_axis,
+                lora=lora, lora_scale=lora_scale,
                 kv_scales=(k_scale, v_scale) if scaled else None,
                 policy=policy, attn_kernel=attn_kernel)
             logits, pools = out[0], out[1:]
@@ -690,6 +761,7 @@ class ServeEngine:
         is bit-identical to plain decoding (greedy AND sampled)."""
         family, bs = self.family, self.pool.block_size
         tp_axis = self.tp_axis
+        ep_axis = self.ep_axis
         attn_kernel = self.attn_kernel
         use_lora = self.adapters is not None
         policy = self.kv_policy
@@ -702,7 +774,7 @@ class ServeEngine:
             lora, lora_scale = rest if use_lora else (None, None)
             out = family.verify(
                 params, k_pool, v_pool, ids, starts, tail_lens, tables,
-                bs, tp_axis=tp_axis, lora=lora,
+                bs, tp_axis=tp_axis, ep_axis=ep_axis, lora=lora,
                 lora_scale=lora_scale,
                 kv_scales=(k_scale, v_scale) if scaled else None,
                 policy=policy, attn_kernel=attn_kernel)
@@ -778,7 +850,12 @@ class ServeEngine:
         pool_specs = (P(None, None, self.tp_axis, None),) * 2
         if self.kv_policy.scaled:
             pool_specs = pool_specs + (P(None, None, self.tp_axis),) * 2
-        pspecs = self.family.partition_specs(self.tp_axis)
+        pspecs = self.family.partition_specs(self.tp_axis, self.ep_axis)
+        # MoE families widen every program's return by one trailing
+        # routing-stats dict, computed from the replicated router masks
+        # — identical on every rank, so a single replicated prefix spec
+        # covers the whole pytree.
+        moe_out = (P(),) if self._moe_on else ()
 
         # prefill body: (params, *pools, ids, start, t0, row, cow_src,
         #                cow_len, key[, lora, scale]) -> pools + 2 outs
@@ -792,7 +869,7 @@ class ServeEngine:
             body, self.mesh,
             in_specs=((pspecs,) + pool_specs
                       + (P(),) * n_rest + lora_specs),
-            out_specs=pool_specs + (P(), P()))
+            out_specs=pool_specs + moe_out + (P(), P()))
         return jax.jit(smapped, donate_argnums=donate)
 
     # ------------------------------------------------------------------
@@ -1425,6 +1502,41 @@ class ServeEngine:
                  evictions_forced=int(evictions),
                  chunked=chunked, adapter_id=req.adapter_id)
 
+    # ------------------------------------------------------------------
+    # MoE routing-stats ledger (serve/metrics.py)
+    # ------------------------------------------------------------------
+    def _pop_moe(self, pools, *, note: bool = True):
+        """Split the trailing routing-stats dict off a MoE program's
+        pool outputs (serve/families.py widens every MoE program's
+        return by one) and bank it for the step ledger. Dense families
+        pass through untouched; warmup calls pass ``note=False`` so
+        compile-time probes never pollute the serving numbers."""
+        if not self._moe_on:
+            return pools
+        *pools, st = pools
+        if note:
+            self._moe_acc.append(jax.tree.map(np.asarray, st))
+        return tuple(pools)
+
+    def _drain_moe(self) -> Dict[str, object]:
+        """Fold the routing stats banked since the last step boundary
+        into ``record_step`` kwargs. expert_tokens counts routed demand
+        BEFORE the capacity cut — the honest skew signal (post-cut
+        counts saturate at capacity under a hot expert)."""
+        acc, self._moe_acc = self._moe_acc, []
+        if not acc:
+            return {}
+        return {
+            "moe_expert_tokens": np.sum(
+                [a["expert_tokens"] for a in acc], axis=0),
+            "moe_routed_tokens": float(
+                np.sum([a["assigned"] for a in acc])),
+            "moe_dropped_tokens": float(
+                np.sum([a["dropped"] for a in acc])),
+            "moe_router_entropy": float(
+                np.mean([a["entropy"] for a in acc])),
+        }
+
     def _admit_one(self, slot: int, req: Request) -> Tuple[int, int]:
         """Admit ``req`` into ``slot``: reuse the longest cached prefix
         chain, prefill only the uncached tail in the smallest bucket
@@ -1457,7 +1569,7 @@ class ServeEngine:
             jnp.int32(start), jnp.int32(t0), jnp.asarray(row),
             jnp.int32(plan.cow_src if plan.cow_src is not None else 0),
             jnp.int32(plan.cow_len), jnp.asarray(req.key_data), *extra)
-        self.pool.update(*pools)
+        self.pool.update(*self._pop_moe(pools))
         if plan.cow_src is not None:
             # the COW source was pinned only for the copy above
             self.pool.release([plan.cow_src])
@@ -1541,7 +1653,7 @@ class ServeEngine:
             jnp.int32(st.cow_src if cow else 0),
             jnp.int32(st.cow_len if cow else 0),
             jnp.asarray(self._key_data[slot]), *extra)
-        self.pool.update(*pools)
+        self.pool.update(*self._pop_moe(pools))
         if cow:
             # the COW source was pinned only for the copy above
             self.pool.release([st.cow_src])
@@ -1710,7 +1822,7 @@ class ServeEngine:
             jnp.asarray(starts), jnp.asarray(tail_lens),
             jnp.asarray(self._tables), jnp.asarray(self._key_data),
             *extra)
-        self.pool.update(*pools)
+        self.pool.update(*self._pop_moe(pools))
         toks = np.asarray(toks)
         chain = np.asarray(chain)
 
@@ -1884,7 +1996,7 @@ class ServeEngine:
                     jnp.asarray(tok), jnp.asarray(pos),
                     jnp.asarray(tables),
                     jnp.asarray(self._key_data), *extra)
-                self.pool.update(*pools)
+                self.pool.update(*self._pop_moe(pools))
                 nxt = np.asarray(nxt)
                 key2 = np.array(key2)
                 for s in prefilling:
@@ -1907,7 +2019,10 @@ class ServeEngine:
                     self._decode_blocked_demotions += (
                         self.kv_tier.demotions - demo0)
 
-        # 4. metrics
+        # 4. metrics — MoE families additionally drain the routing
+        # stats their programs returned this step (per-expert demand,
+        # capacity drops, router entropy) into the same ledger
+        moe_kw = self._drain_moe() if self._moe_on else {}
         tier = self.kv_tier
         self.metrics.record_step(
             running=len(self._active_slots()),
@@ -1929,7 +2044,8 @@ class ServeEngine:
             kv_host_evictions=0 if tier is None else tier.evictions,
             host_hit_tokens=0 if tier is None else tier.promoted_tokens,
             host_tier_bytes=0 if tier is None else tier.bytes_used,
-            decode_blocked_demotions=self._decode_blocked_demotions)
+            decode_blocked_demotions=self._decode_blocked_demotions,
+            **moe_kw)
         if self.recorder is not None:
             from quintnet_tpu.obs.recorder import StepRecord
 
@@ -1948,7 +2064,10 @@ class ServeEngine:
                 prefix_hit_tokens=prefix_hit_tokens,
                 prefill_chunks=prefill_chunks,
                 spec_step=spec_step, draft_tokens=draft_tokens,
-                accepted_draft_tokens=accepted_draft))
+                accepted_draft_tokens=accepted_draft,
+                attrs={k: (v.tolist() if isinstance(v, np.ndarray)
+                           else v)
+                       for k, v in moe_kw.items()} if moe_kw else {}))
         if self.log_every:
             self.metrics.log_step(self.logger, every=self.log_every)
         return finished
@@ -1977,7 +2096,7 @@ class ServeEngine:
                 self.params, *self.pool.caches(),
                 jnp.zeros((1, b), jnp.int32), jnp.int32(0), jnp.int32(1),
                 zrow, jnp.int32(0), jnp.int32(0), key, *p_extra)
-            self.pool.update(*pools)
+            self.pool.update(*self._pop_moe(pools, note=False))
             key = jnp.asarray(np.asarray(_k))
         for R, sentinel in self._decodes.items():
             extra = (self._lora_args("decode", rank_bucket=R)
@@ -1986,7 +2105,7 @@ class ServeEngine:
                 self.params, *self.pool.caches(), jnp.asarray(self._tok),
                 jnp.asarray(self._pos), jnp.asarray(self._tables),
                 jnp.asarray(self._key_data), *extra)
-            self.pool.update(*pools)
+            self.pool.update(*self._pop_moe(pools, note=False))
         v_extra = self._lora_args("verify") if lora_on else ()
         for k, sentinel in self._verifies.items():
             # all-zero tables + zero tail_lens: every write lands in
@@ -1998,7 +2117,7 @@ class ServeEngine:
                 jnp.zeros((self.max_slots,), jnp.int32),
                 jnp.zeros((self.max_slots, self.table_width), jnp.int32),
                 jnp.asarray(self._key_data), *v_extra)
-            self.pool.update(*pools)
+            self.pool.update(*self._pop_moe(pools, note=False))
 
     def run(self, *, max_steps: Optional[int] = None) -> None:
         """Step until all submitted work is finished (or ``max_steps``)."""

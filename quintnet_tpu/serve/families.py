@@ -90,15 +90,21 @@ class Family:
     head_dim: int
     max_positions: int
     prefill_from: Callable   # (params, kp, vp, ids, start, t0, row, bs,
-    #                           tp_axis, lora, lora_scale)
-    #                           -> (logits, kp, vp)
+    #                           tp_axis, ep_axis, lora, lora_scale)
+    #                           -> (logits, kp, vp[, moe_stats])
     decode: Callable         # (params, kp, vp, tok, pos, tables, bs,
-    #                           tp_axis, lora, lora_scale)
+    #                           tp_axis, ep_axis, lora, lora_scale)
     verify: Callable         # (params, kp, vp, ids [S, P], starts [S],
     #                           tail_lens [S], tables, bs, tp_axis,
-    #                           lora, lora_scale)
-    #                           -> (logits [S, P, V], kp, vp)
-    partition_specs: Callable  # (tp_axis) -> param pytree specs
+    #                           ep_axis, lora, lora_scale)
+    #                           -> (logits [S, P, V], kp, vp[, moe_stats])
+    partition_specs: Callable  # (tp_axis, ep_axis=None) -> param specs
+    # MoE families (cfg.moe_args set) widen every contract's return by
+    # one trailing routing-stats dict — per-expert routed counts,
+    # capacity drops, assignments, router entropy, already reduced over
+    # layers (_reduce_moe_stats) — and take ``ep_axis``: experts
+    # sharded over the axis with one all_to_all each way per MoE layer
+    # (nn/moe.py); None runs the dense-replicated MoE math.
     # sequence-parallel prefill (long-context serving, serve/longctx.py):
     # same contract as prefill_from except ids is THIS SP RANK's slice
     # [1, P/sp] of the bucket (the engine's shard_map splits dim 1) and
@@ -145,6 +151,20 @@ def _scan_layer(layer, lora, scaled: bool = False):
     return blk, kc, vc, sc, lr
 
 
+def _reduce_moe_stats(st):
+    """Layer-stacked routing stats (each leaf leading [L], the scan's
+    ys) -> per-program totals: counts summed over layers, entropy
+    meaned. Every value is replicated across ep/tp ranks (routing is
+    computed on the replicated token batch), so the engine's shard_map
+    emits them with a replicated out-spec."""
+    return {
+        "expert_tokens": jnp.sum(st["expert_tokens"], axis=0),
+        "dropped": jnp.sum(st["dropped"]),
+        "assigned": jnp.sum(st["assigned"]),
+        "entropy": jnp.mean(st["entropy"]),
+    }
+
+
 def gpt2_family(cfg) -> Family:
     from quintnet_tpu.models.gpt2 import gpt2_partition_specs
     from quintnet_tpu.models.gpt2_generate import (_embed_tok, _local_heads,
@@ -158,8 +178,9 @@ def gpt2_family(cfg) -> Family:
                                              block_verify_paged)
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
-                     block_size, tp_axis=None, lora=None, lora_scale=None,
-                     kv_scales=None, policy=None, attn_kernel="xla"):
+                     block_size, tp_axis=None, ep_axis=None, lora=None,
+                     lora_scale=None, kv_scales=None, policy=None,
+                     attn_kernel="xla"):
         B, P = ids.shape
         emb = params["embedding"]
         positions = start + jnp.arange(P, dtype=jnp.int32)
@@ -175,7 +196,8 @@ def gpt2_family(cfg) -> Family:
             blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
             out = block_prefill_paged(
                 blk, x, kc, vc, positions, tail_len, num_heads=heads,
-                act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
+                act=gelu, moe_args=cfg.moe_args, ep_axis=ep_axis,
+                tp_axis=tp_axis,
                 block_tables=table_row, block_size=block_size,
                 lora=lr, lora_scale=lora_scale,
                 kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
@@ -184,11 +206,14 @@ def gpt2_family(cfg) -> Family:
         h, pools = lax.scan(
             body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
                               kv_scales))
+        if cfg.moe_args is not None:
+            *pools, st = pools
+            pools = (*pools, _reduce_moe_stats(st))
         h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
         return (_logits(params, h_last, cfg, tp_axis)[:, 0, :], *pools)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
-               tp_axis=None, lora=None, lora_scale=None,
+               tp_axis=None, ep_axis=None, lora=None, lora_scale=None,
                kv_scales=None, policy=None, attn_kernel="xla"):
         emb = params["embedding"]
         x = (_embed_tok(emb, tok[:, None], cfg, tp_axis)
@@ -200,6 +225,7 @@ def gpt2_family(cfg) -> Family:
             blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
             out = block_decode(blk, h, kc, vc, pos, num_heads=heads,
                                act=gelu, moe_args=cfg.moe_args,
+                               ep_axis=ep_axis,
                                tp_axis=tp_axis, block_tables=tables,
                                block_size=block_size,
                                lora=lr, lora_scale=lora_scale,
@@ -210,11 +236,15 @@ def gpt2_family(cfg) -> Family:
         h, pools = lax.scan(
             body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora,
                               kv_scales))
+        if cfg.moe_args is not None:
+            *pools, st = pools
+            pools = (*pools, _reduce_moe_stats(st))
         return (_logits(params, h, cfg, tp_axis)[:, 0, :], *pools)
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-               block_size, tp_axis=None, lora=None, lora_scale=None,
-               kv_scales=None, policy=None, attn_kernel="xla"):
+               block_size, tp_axis=None, ep_axis=None, lora=None,
+               lora_scale=None, kv_scales=None, policy=None,
+               attn_kernel="xla"):
         S, P = ids.shape
         emb = params["embedding"]
         positions = (starts[:, None]
@@ -229,7 +259,8 @@ def gpt2_family(cfg) -> Family:
             blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
             out = block_verify_paged(
                 blk, x, kc, vc, positions, tail_lens, num_heads=heads,
-                act=gelu, moe_args=cfg.moe_args, tp_axis=tp_axis,
+                act=gelu, moe_args=cfg.moe_args, ep_axis=ep_axis,
+                tp_axis=tp_axis,
                 block_tables=tables, block_size=block_size,
                 lora=lr, lora_scale=lora_scale,
                 kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
@@ -238,6 +269,9 @@ def gpt2_family(cfg) -> Family:
         h, pools = lax.scan(
             body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
                               kv_scales))
+        if cfg.moe_args is not None:
+            *pools, st = pools
+            pools = (*pools, _reduce_moe_stats(st))
         return (_logits(params, h, cfg, tp_axis), *pools)
 
     def prefill_from_sp(params, k_pool, v_pool, ids, start, t0,
@@ -288,8 +322,8 @@ def gpt2_family(cfg) -> Family:
         head_dim=cfg.n_embd // cfg.n_head, max_positions=cfg.n_positions,
         prefill_from=prefill_from, decode=decode, verify=verify,
         prefill_from_sp=prefill_from_sp,
-        partition_specs=lambda tp_axis: gpt2_partition_specs(
-            cfg, tp_axis=tp_axis),
+        partition_specs=lambda tp_axis, ep_axis=None: gpt2_partition_specs(
+            cfg, tp_axis=tp_axis, ep_axis=ep_axis),
         lora_targets=DEFAULT_TARGETS, lora_layout=lora_layout,
     )
 
@@ -310,8 +344,9 @@ def llama_family(cfg) -> Family:
     from quintnet_tpu.nn.attention import sp_last_hidden
 
     def prefill_from(params, k_pool, v_pool, ids, start, t0, table_row,
-                     block_size, tp_axis=None, lora=None, lora_scale=None,
-                     kv_scales=None, policy=None, attn_kernel="xla"):
+                     block_size, tp_axis=None, ep_axis=None, lora=None,
+                     lora_scale=None, kv_scales=None, policy=None,
+                     attn_kernel="xla"):
         B, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)
         positions = start + jnp.arange(P, dtype=jnp.int32)
@@ -323,7 +358,7 @@ def llama_family(cfg) -> Family:
             blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
             x, pools = llama_block_prefill_paged(
                 blk, x, kc, vc, positions, tail_len, cfg, cos, sin,
-                tp_axis=tp_axis, block_tables=table_row,
+                tp_axis=tp_axis, ep_axis=ep_axis, block_tables=table_row,
                 block_size=block_size, lora=lr, lora_scale=lora_scale,
                 kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
             return x, pools
@@ -331,12 +366,15 @@ def llama_family(cfg) -> Family:
         h, pools = lax.scan(
             body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
                               kv_scales))
+        if cfg.moe_args is not None:
+            *pools, st = pools
+            pools = (*pools, _reduce_moe_stats(st))
         h_last = lax.dynamic_slice_in_dim(h, t0 - 1 - start, 1, axis=1)
         return (_full_logits(params, h_last, cfg, tp_axis)[:, 0, :],
                 *pools)
 
     def decode(params, k_pool, v_pool, tok, pos, tables, block_size,
-               tp_axis=None, lora=None, lora_scale=None,
+               tp_axis=None, ep_axis=None, lora=None, lora_scale=None,
                kv_scales=None, policy=None, attn_kernel="xla"):
         x = _embed(params, tok[:, None], cfg, tp_axis)        # [S, 1, D]
         cos, sin = llama_rope_tables(pos, cfg)                # [S, hd]
@@ -347,6 +385,7 @@ def llama_family(cfg) -> Family:
             blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
             h, pools = llama_block_decode(
                 blk, h, kc, vc, pos, cfg, cos, sin, tp_axis=tp_axis,
+                ep_axis=ep_axis,
                 block_tables=tables, block_size=block_size,
                 lora=lr, lora_scale=lora_scale,
                 kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
@@ -355,11 +394,15 @@ def llama_family(cfg) -> Family:
         h, pools = lax.scan(
             body, x, _scan_xs(params["blocks"], k_pool, v_pool, lora,
                               kv_scales))
+        if cfg.moe_args is not None:
+            *pools, st = pools
+            pools = (*pools, _reduce_moe_stats(st))
         return (_full_logits(params, h, cfg, tp_axis)[:, 0, :], *pools)
 
     def verify(params, k_pool, v_pool, ids, starts, tail_lens, tables,
-               block_size, tp_axis=None, lora=None, lora_scale=None,
-               kv_scales=None, policy=None, attn_kernel="xla"):
+               block_size, tp_axis=None, ep_axis=None, lora=None,
+               lora_scale=None, kv_scales=None, policy=None,
+               attn_kernel="xla"):
         S, P = ids.shape
         h = _embed(params, ids, cfg, tp_axis)                 # [S, P, D]
         positions = (starts[:, None]
@@ -372,7 +415,7 @@ def llama_family(cfg) -> Family:
             blk, kc, vc, sc, lr = _scan_layer(layer, lora, scaled)
             x, pools = llama_block_verify_paged(
                 blk, x, kc, vc, positions, tail_lens, cfg, cos, sin,
-                tp_axis=tp_axis, block_tables=tables,
+                tp_axis=tp_axis, ep_axis=ep_axis, block_tables=tables,
                 block_size=block_size, lora=lr, lora_scale=lora_scale,
                 kv_scales=sc, policy=policy, attn_kernel=attn_kernel)
             return x, pools
@@ -380,6 +423,9 @@ def llama_family(cfg) -> Family:
         h, pools = lax.scan(
             body, h, _scan_xs(params["blocks"], k_pool, v_pool, lora,
                               kv_scales))
+        if cfg.moe_args is not None:
+            *pools, st = pools
+            pools = (*pools, _reduce_moe_stats(st))
         return (_full_logits(params, h, cfg, tp_axis), *pools)
 
     def prefill_from_sp(params, k_pool, v_pool, ids, start, t0,
@@ -417,7 +463,7 @@ def llama_family(cfg) -> Family:
         max_positions=cfg.n_positions,
         prefill_from=prefill_from, decode=decode, verify=verify,
         prefill_from_sp=prefill_from_sp,
-        partition_specs=lambda tp_axis: llama_partition_specs(
-            cfg, tp_axis=tp_axis),
+        partition_specs=lambda tp_axis, ep_axis=None: llama_partition_specs(
+            cfg, tp_axis=tp_axis, ep_axis=ep_axis),
         lora_targets=LLAMA_TARGETS,
     )

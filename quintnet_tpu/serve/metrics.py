@@ -228,6 +228,23 @@ class ServeMetrics:
     peak_kv_utilization: float = 0.0
     peak_running: int = 0
 
+    # MoE routing ledger (nn/moe.py routing stats, drained by the
+    # engine once per step; absent for dense families — summary()
+    # gates the keys on MoE activity so dense exposition stays
+    # byte-identical). moe_routed_tokens counts token-expert
+    # assignments the router DEMANDED (pre-capacity-cut, summed over
+    # layers and programs: S * top_k per MoE layer per invocation);
+    # moe_dropped_tokens the assignments the capacity cut discarded;
+    # moe_expert_tokens the cumulative per-expert demand [E] (the
+    # honest skew signal — post-cut counts saturate at capacity under
+    # a hot expert); entropy is the mean per-token router entropy,
+    # averaged over the steps that reported it
+    moe_routed_tokens: float = 0.0
+    moe_dropped_tokens: float = 0.0
+    moe_expert_tokens: Optional[np.ndarray] = None
+    moe_entropy_sum: float = 0.0
+    moe_stat_steps: int = 0
+
     # per-adapter ledger (multi-tenant LoRA, serve/adapters.py):
     # adapter id -> {"requests": finished, "gen_tokens": generated,
     # "ttfts": Reservoir} — the per-tenant slice of the totals above
@@ -265,7 +282,11 @@ class ServeMetrics:
                     kv_host_evictions: int = 0,
                     host_hit_tokens: int = 0,
                     host_tier_bytes: int = 0,
-                    decode_blocked_demotions: int = 0) -> None:
+                    decode_blocked_demotions: int = 0,
+                    moe_routed_tokens: float = 0.0,
+                    moe_dropped_tokens: float = 0.0,
+                    moe_expert_tokens=None,
+                    moe_router_entropy: Optional[float] = None) -> None:
         now = self.clock()
         if self._t0 is None:
             self._t0 = now
@@ -299,6 +320,16 @@ class ServeMetrics:
         self.host_hit_tokens = host_hit_tokens
         self.host_tier_bytes = host_tier_bytes
         self.decode_blocked_demotions = decode_blocked_demotions
+        self.moe_routed_tokens += float(moe_routed_tokens)
+        self.moe_dropped_tokens += float(moe_dropped_tokens)
+        if moe_expert_tokens is not None:
+            et = np.asarray(moe_expert_tokens, np.float64)
+            if self.moe_expert_tokens is None:
+                self.moe_expert_tokens = np.zeros_like(et)
+            self.moe_expert_tokens = self.moe_expert_tokens + et
+        if moe_router_entropy is not None:
+            self.moe_entropy_sum += float(moe_router_entropy)
+            self.moe_stat_steps += 1
         util = kv_blocks_used / max(kv_blocks_total, 1)
         self.peak_kv_utilization = max(self.peak_kv_utilization, util)
         self.peak_running = max(self.peak_running, running)
@@ -408,14 +439,40 @@ class ServeMetrics:
         return (self.chunk_tokens / self.chunk_steps
                 if self.chunk_steps else 0.0)
 
+    @property
+    def moe_drop_rate(self) -> float:
+        """Fraction of routed token-expert assignments the capacity
+        cut discarded."""
+        return (self.moe_dropped_tokens / self.moe_routed_tokens
+                if self.moe_routed_tokens else 0.0)
+
+    @property
+    def moe_expert_skew(self) -> float:
+        """max/mean of cumulative per-expert routed demand — 1.0 is
+        perfectly balanced, E is a single hot expert taking all of
+        it."""
+        et = self.moe_expert_tokens
+        if et is None or float(np.sum(et)) == 0.0:
+            return 0.0
+        return float(np.max(et) / np.mean(et))
+
+    @property
+    def moe_router_entropy(self) -> float:
+        """Mean per-token router-distribution entropy over the steps
+        that reported one (nats; ln(E) is uniform)."""
+        return (self.moe_entropy_sum / self.moe_stat_steps
+                if self.moe_stat_steps else 0.0)
+
     def summary(self) -> Dict:
         """One JSON-able dict: throughput, TTFT/latency percentiles,
         peak pool pressure. tok/s counts GENERATED (decode + prefill-
         sampled) tokens — the serving-throughput number, not prompt
-        reading speed."""
+        reading speed. MoE keys appear only when routing stats were
+        recorded, so a dense engine's summary is byte-identical to
+        what it was before MoE serving existed."""
         wall = self.wall_s
         gen_tokens = self.gen_tokens
-        return {
+        out = {
             "steps": self.steps,
             "gen_tokens": gen_tokens,
             "admitted": self.admitted,
@@ -461,6 +518,18 @@ class ServeMetrics:
                       "ttft_s": _pcts(d["ttfts"])}
                 for aid, d in sorted(self.per_adapter.items())},
         }
+        if self.moe_stat_steps or self.moe_routed_tokens:
+            out["moe_routed_tokens"] = int(self.moe_routed_tokens)
+            out["moe_dropped_tokens"] = int(self.moe_dropped_tokens)
+            out["moe_drop_rate"] = round(self.moe_drop_rate, 4)
+            out["moe_expert_skew"] = round(self.moe_expert_skew, 4)
+            out["moe_router_entropy"] = round(self.moe_router_entropy,
+                                              4)
+            out["moe_expert_tokens"] = (
+                {str(e): int(v)
+                 for e, v in enumerate(self.moe_expert_tokens)}
+                if self.moe_expert_tokens is not None else {})
+        return out
 
     def log_step(self, logger: Optional[logging.Logger], *,
                  every: int = 1) -> None:
@@ -524,7 +593,7 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
     dtok = sum(m.decode_tokens for m in all_metrics)
     drafted = sum(m.draft_tokens for m in all_metrics)
     accepted = sum(m.accepted_draft_tokens for m in all_metrics)
-    return {
+    out = {
         "replicas": len(all_metrics),
         "steps": sum(m.steps for m in all_metrics),
         "gen_tokens": gen_tokens,
@@ -590,3 +659,27 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
                   "ttft_s": _pooled_pcts(d["groups"])}
             for aid, d in sorted(adapters.items())},
     }
+    # MoE roll-up mirrors summary(): counters summed across replicas,
+    # per-expert demand summed elementwise, keys gated on activity so
+    # a dense fleet's aggregate is unchanged
+    moe_routed = sum(m.moe_routed_tokens for m in all_metrics)
+    moe_steps = sum(m.moe_stat_steps for m in all_metrics)
+    if moe_steps or moe_routed:
+        moe_dropped = sum(m.moe_dropped_tokens for m in all_metrics)
+        ets = [m.moe_expert_tokens for m in all_metrics
+               if m.moe_expert_tokens is not None]
+        et = np.sum(ets, axis=0) if ets else None
+        out["moe_routed_tokens"] = int(moe_routed)
+        out["moe_dropped_tokens"] = int(moe_dropped)
+        out["moe_drop_rate"] = (round(moe_dropped / moe_routed, 4)
+                                if moe_routed else 0.0)
+        out["moe_expert_skew"] = (
+            round(float(np.max(et) / np.mean(et)), 4)
+            if et is not None and float(np.sum(et)) else 0.0)
+        out["moe_router_entropy"] = (
+            round(sum(m.moe_entropy_sum for m in all_metrics)
+                  / moe_steps, 4) if moe_steps else 0.0)
+        out["moe_expert_tokens"] = (
+            {str(e): int(v) for e, v in enumerate(et)}
+            if et is not None else {})
+    return out

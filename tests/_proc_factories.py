@@ -23,11 +23,18 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                     prefill_chunk_budget=None,
                     kv_dtype=None, prefix_cache: bool = True,
                     attn_kernel: str = "xla",
-                    kv_tier_bytes: int = 0):
+                    kv_tier_bytes: int = 0,
+                    n_experts: int = 0, expert_top_k: int = 2,
+                    expert_capacity=None):
     from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
     from quintnet_tpu.serve import ServeEngine, gpt2_family
 
-    cfg = GPT2Config.tiny(n_layer=n_layer,
+    # n_experts > 0 makes the replica an MoE engine (dense-replicated:
+    # a fleet replica process owns no ep mesh) — its routing ledger
+    # rides the stats frame like every other ServeMetrics field
+    cfg = GPT2Config.tiny(n_layer=n_layer, n_experts=n_experts,
+                          expert_top_k=expert_top_k,
+                          expert_capacity=expert_capacity,
                           **({} if n_positions is None
                              else {"n_positions": n_positions}))
     params = gpt2_init(jax.random.key(seed), cfg)

@@ -457,6 +457,108 @@ class TestServe:
 
 
 # ---------------------------------------------------------------------
+# MoE serving: expert-parallel collective census
+# ---------------------------------------------------------------------
+
+class TestServeMoE:
+    """Census goldens for expert-parallel serving
+    (analysis/specs.expected_serve_moe): under ep>1 every program kind
+    — every prefill bucket, the decode step, every verify bucket —
+    carries EXACTLY 2 all_to_alls per MoE layer (the nn/moe.py
+    dispatch + combine) and nothing else on the ep axis; the
+    capacity-bounded scatter/gather is local and the router
+    replicated. ep=1 (and no mesh) is the dense-replicated program:
+    ZERO collectives — the census face of the ep=1 == dense
+    bit-identity contract. The dense families' own censuses are
+    pinned by TestServe above; these goldens prove MoE adds all_to_all
+    and ONLY all_to_all, and only on the ep axis."""
+
+    _engine = TestServe._engine
+    _spec_engine = TestServe._spec_engine
+    _prefill_args = TestServe._prefill_args
+    _decode_args = TestServe._decode_args
+    _verify_args = TestServe._verify_args
+
+    @pytest.fixture(scope="class")
+    def gpt2(self):
+        from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+        cfg = GPT2Config.tiny(n_layer=2, n_experts=4, expert_top_k=2)
+        return cfg, gpt2_init(jax.random.key(0), cfg)
+
+    def test_ep_census_two_all_to_alls_per_moe_layer(self, gpt2):
+        cfg, params = gpt2
+        mesh = Mesh(np.array(jax.devices()[:2]), ("ep",))
+        eng = self._spec_engine(cfg, params, mesh=mesh, ep_axis="ep")
+        assert eng.ep_axis == "ep"
+        spec = census_specs.expected_serve_moe(cfg.n_layer,
+                                               ep_axis="ep")
+        cases = [(eng._prefills[b].fn,
+                  self._prefill_args(eng, params, b))
+                 for b in eng.prefill_buckets]
+        cases.append((eng._decode.fn, self._decode_args(eng, params)))
+        cases.extend((eng._verifies[k].fn,
+                      self._verify_args(eng, params, k))
+                     for k in eng.spec.buckets)
+        for fn, args in cases:
+            census = collective_census(fn, *args)
+            assert census.diff(spec) == [], census.as_dict()
+            assert census.total() == 2 * cfg.n_layer
+
+    def test_ep_times_tp_census_composes(self, gpt2):
+        """ep x tp: the dense tp census (2 row-parallel psums per
+        layer — the expert FFN's down-proj psum folds into the same
+        count) PLUS the 2 per-layer ep all_to_alls, each axis
+        accounted separately."""
+        cfg, params = gpt2
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices")
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("ep", "tp"))
+        eng = self._engine(cfg, params, mesh=mesh, ep_axis="ep")
+        spec = census_specs.expected_serve_moe(cfg.n_layer,
+                                               ep_axis="ep",
+                                               tp_axis="tp")
+        cases = [(eng._prefills[b].fn,
+                  self._prefill_args(eng, params, b))
+                 for b in eng.prefill_buckets]
+        cases.append((eng._decode.fn, self._decode_args(eng, params)))
+        for fn, args in cases:
+            census = collective_census(fn, *args)
+            assert census.diff(spec) == [], census.as_dict()
+
+    def test_ep1_census_is_collective_free(self, gpt2):
+        """A size-1 ep mesh nulls ep_axis at construction — the
+        programs are the dense-replicated MoE math, zero collectives
+        (expected_serve_moe with ep_axis=None)."""
+        cfg, params = gpt2
+        mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
+        eng = self._engine(cfg, params, mesh=mesh, ep_axis="ep")
+        assert eng.ep_axis is None
+        assert census_specs.expected_serve_moe(cfg.n_layer) == {}
+        for b in eng.prefill_buckets:
+            census = collective_census(
+                eng._prefills[b].fn, *self._prefill_args(eng, params, b))
+            assert census.total() == 0
+
+    def test_ep_donation_no_aliasable_misses(self, gpt2):
+        """The widened MoE return (the trailing routing-stats dict)
+        must not cost a donation: every aliasable buffer of every ep
+        program is still donated."""
+        cfg, params = gpt2
+        mesh = Mesh(np.array(jax.devices()[:2]), ("ep",))
+        eng = self._engine(cfg, params, mesh=mesh, ep_axis="ep")
+        cases = [(eng._prefills[b].fn,
+                  self._prefill_args(eng, params, b))
+                 for b in eng.prefill_buckets]
+        cases.append((eng._decode.fn, self._decode_args(eng, params)))
+        for fn, args in cases:
+            rep = donation_report(fn, *args)
+            assert rep.undonated_aliasable == [], rep.summary()
+            assert rep.donated_bytes > 0
+
+
+# ---------------------------------------------------------------------
 # serve programs: dtype-promotion census per KV layout policy
 # ---------------------------------------------------------------------
 

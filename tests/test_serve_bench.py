@@ -43,6 +43,14 @@ rate, TTFT (p50 AND p95), and tok/s, with the structural
 decode_blocked_demotions == 0 — demotion copies never ride a decode
 dispatch. (The r19 plain record is NOT gated against r14's value:
 the box changed between eras — r19's plain gates are structural.)
+artifacts/serve_r20.json gates MoE serving: the routing A/B replays a
+diverse Poisson trace and a hot-expert (shared tiled pattern) trace
+through the same capacity-bounded MoE engine, and the gates are
+structural and wall-noise-free — the hot side's expert-utilization
+skew exceeds the diverse side's, the routing ledger accounts exactly
+(per-expert demand sums to the routed total, drops bounded by it,
+drop rate reported for both sides), and the compile bound does not
+move (MoE adds zero programs: same prefill ladder, one decode).
 """
 
 import json
@@ -65,6 +73,7 @@ KVCAP_METRIC = "serve_gpt2_tiny_kvcap_tokens_per_sec"
 OBS_METRIC = "serve_gpt2_tiny_obs_tokens_per_sec"
 KERNEL_METRIC = "serve_gpt2_tiny_kernel_tokens_per_sec"
 TIER_METRIC = "serve_gpt2_tiny_tier_tokens_per_sec"
+MOE_METRIC = "serve_gpt2_tiny_moe_tokens_per_sec"
 R09 = os.path.join(REPO, "artifacts", "serve_r09.json")
 R10 = os.path.join(REPO, "artifacts", "serve_r10.json")
 R11 = os.path.join(REPO, "artifacts", "serve_r11.json")
@@ -73,6 +82,7 @@ R14 = os.path.join(REPO, "artifacts", "serve_r14.json")
 R15 = os.path.join(REPO, "artifacts", "obs_r15.json")
 R18 = os.path.join(REPO, "artifacts", "serve_r18.json")
 R19 = os.path.join(REPO, "artifacts", "serve_r19.json")
+R20 = os.path.join(REPO, "artifacts", "serve_r20.json")
 
 
 @pytest.mark.fast
@@ -762,6 +772,95 @@ def test_tier_artifact_surfaces_in_staleness_scan():
     last = bench.last_known_result(metric=TIER_METRIC)
     assert last is not None
     assert last["metric"] == TIER_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+@pytest.mark.fast
+def test_moe_trace_smoke_cli():
+    """`serve_bench.py --moe-trace` runs the diverse-vs-hot-expert A/B
+    end-to-end on CPU through a real MoE engine (the bench's own
+    runtime asserts already gate the routing ledger and the compile
+    bound — a leak or a recompile exits nonzero). The smoke checks the
+    record shape and that routing actually happened on both sides."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--synthetic", "--moe-trace", "--requests", "10",
+         "--max-new", "6", "--seed", "3"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == MOE_METRIC
+    assert rec["rc"] == 0
+    e = rec["extras"]
+    for k in ("hot_expert_skew", "diverse_expert_skew",
+              "hot_drop_rate", "diverse_drop_rate",
+              "hot_router_entropy", "hot_expert_tokens",
+              "diverse_expert_tokens", "compile_counts"):
+        assert k in e, k
+    assert e["experts"] == 4 and e["expert_top_k"] == 2
+    assert e["hot_routed_tokens"] > 0
+    assert e["diverse_routed_tokens"] > 0
+    assert len(e["hot_expert_tokens"]) == e["experts"]
+    # a max/mean skew is >= 1 by construction; > 1 means the router
+    # actually discriminated between experts
+    assert e["hot_expert_skew"] >= 1.0
+    assert e["finished"] == e["submitted"] == 10
+    # MoE adds zero programs to the engine's compile bound
+    assert e["compile_counts"]["decode"] == 1
+
+
+@pytest.mark.fast
+def test_committed_moe_artifact_meets_acceptance():
+    """The committed serve_r20.json is the MoE-serving PR's acceptance
+    evidence, and every gate is structural (wall-noise-free): the
+    hot-expert trace concentrates routed demand — its expert skew
+    exceeds the diverse trace's — the routing ledger accounts exactly
+    on BOTH sides (per-expert demand sums to the routed total, drops
+    bounded by it), capacity drops are reported as rates in [0, 1],
+    and the compile bound is untouched (one decode program; the
+    prefill count is the ladder's, not MoE's). The plain record is
+    gated structurally only, per the r19 precedent."""
+    with open(R20) as f:
+        records = json.load(f)
+    by_metric = {r["metric"]: r for r in records}
+
+    rec = by_metric[MOE_METRIC]
+    e = rec["extras"]
+    assert e["moe_trace"] is True
+    assert e["finished"] == e["submitted"] == e["requests"]
+    # the A/B's point: skewed traffic shows up in the ledger
+    assert e["hot_expert_skew"] > e["diverse_expert_skew"] >= 1.0
+    # the ledger accounts exactly, both sides
+    for side in ("hot", "diverse"):
+        tokens = e[f"{side}_expert_tokens"]
+        assert len(tokens) == e["experts"]
+        assert sum(tokens.values()) == e[f"{side}_routed_tokens"] > 0
+        assert 0 <= e[f"{side}_dropped_tokens"] \
+            <= e[f"{side}_routed_tokens"]
+        assert 0.0 <= e[f"{side}_drop_rate"] <= 1.0
+        assert e[f"{side}_router_entropy"] > 0.0
+    # capacity pressure was real on the skewed side
+    assert e["hot_dropped_tokens"] > 0
+    # compile bound unchanged: MoE added zero programs
+    assert e["compile_counts"]["decode"] == 1
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+
+    plain = by_metric[SERVE_METRIC]
+    pe = plain["extras"]
+    assert pe["kv_dtype"] == "f32"
+    assert pe["finished"] == pe["submitted"] == pe["requests"]
+    assert plain["value"] > 0
+
+
+@pytest.mark.fast
+def test_moe_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=MOE_METRIC)
+    assert last is not None
+    assert last["metric"] == MOE_METRIC
     assert last["value"] > 0
     assert last["source"].startswith("artifacts")
     assert last["as_of"]

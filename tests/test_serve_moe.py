@@ -1,0 +1,352 @@
+"""MoE serving goldens (expert parallelism through the paged engine).
+
+THE contracts, in order of strength:
+
+- **ep=1 == dense replication**: an engine on a size-1 ``ep`` mesh (or
+  no mesh at all) builds the dense-replicated MoE programs — its
+  committed token streams are identical to each other, greedy AND
+  sampled.
+- **ep=2 == ep=1**: sharding the experts over two ranks moves WHERE
+  each expert FFN runs (two all_to_alls per MoE layer, census pinned
+  in tests/test_qtcheck.py), never WHAT is computed — token-identical
+  streams, greedy AND sampled, composing with the prefix cache,
+  chunked prefill, int8 KV and speculative decoding.
+- **Composition rules at construction**: ep x tp is allowed
+  (nn/moe.py moe_specs), ep x sp and ep x adapters raise
+  NotImplementedError, and MoEArgs misconfigurations raise actionable
+  ValueErrors — all at ``ServeEngine(...)``, never inside the first
+  serving step's trace.
+- **Honest routing telemetry**: per-expert routed demand
+  (pre-capacity-cut), capacity-drop counts, and router entropy flow
+  from the programs' replicated routing masks into ServeMetrics,
+  aggregate(), the Prometheus exposition and the StepRecorder ring —
+  and a DENSE engine's summary/exposition is byte-identical to what
+  it was before MoE serving existed.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+from quintnet_tpu.serve import ServeEngine, SpecConfig, gpt2_family
+
+CFG = GPT2Config.tiny(n_layer=2, n_experts=4, expert_top_k=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+def _engine(params, cfg=CFG, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 36)
+    kw.setdefault("max_seq_len", 48)
+    return ServeEngine(gpt2_family(cfg), params, **kw)
+
+
+def _ep_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("ep",))
+
+
+def _run_trace(eng, *, lengths=(7, 3, 5), max_new=6, seed=0):
+    """Submit a deterministic staggered trace, run to drain, return
+    the committed streams in submission order."""
+    rng = np.random.default_rng(seed)
+    prompts = [np.asarray(rng.integers(0, CFG.vocab_size, (n,)),
+                          np.int32) for n in lengths]
+    rids = [eng.submit(p, max_new, key=jax.random.key(100 + i))
+            for i, p in enumerate(prompts)]
+    steps = 0
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        assert steps < 500, "engine failed to drain"
+    return [eng.result(r) for r in rids]
+
+
+# ---------------------------------------------------------------------
+# construction-time composition rules + MoEArgs validation
+# ---------------------------------------------------------------------
+
+class TestConstruction:
+    def test_ep_requires_moe_family(self, params):
+        dense = GPT2Config.tiny(n_layer=2)
+        with pytest.raises(ValueError, match="requires an MoE family"):
+            _engine(gpt2_init(jax.random.key(0), dense), cfg=dense,
+                    mesh=_ep_mesh(2), ep_axis="ep")
+
+    def test_ep_axis_must_be_on_mesh(self, params):
+        with pytest.raises(ValueError, match="not an axis of the mesh"):
+            _engine(params, ep_axis="ep")  # no mesh at all
+        with pytest.raises(ValueError, match="not an axis of the mesh"):
+            _engine(params, ep_axis="ep",
+                    mesh=Mesh(np.array(jax.devices()[:2]), ("tp",)))
+
+    def test_n_experts_must_divide_over_ep(self, params):
+        with pytest.raises(ValueError, match="divisible by"):
+            _engine(params, mesh=_ep_mesh(3), ep_axis="ep")
+
+    def test_nonpositive_capacity_rejected(self):
+        cfg = GPT2Config.tiny(n_layer=2, n_experts=4,
+                              expert_capacity=0)
+        with pytest.raises(ValueError, match="capacity"):
+            _engine(gpt2_init(jax.random.key(0), cfg), cfg=cfg)
+
+    def test_nonpositive_capacity_factor_rejected(self):
+        cfg = GPT2Config.tiny(n_layer=2, n_experts=4,
+                              capacity_factor=0.0)
+        with pytest.raises(ValueError, match="capacity_factor"):
+            _engine(gpt2_init(jax.random.key(0), cfg), cfg=cfg)
+
+    def test_bad_top_k_rejected(self):
+        cfg = GPT2Config.tiny(n_layer=2, n_experts=4, expert_top_k=5)
+        with pytest.raises(ValueError, match="top_k"):
+            _engine(gpt2_init(jax.random.key(0), cfg), cfg=cfg)
+
+    def test_moe_rejects_sp(self, params):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        with pytest.raises(NotImplementedError, match="MoE"):
+            _engine(params, mesh=mesh, sp_axis="sp")
+
+    def test_ep_rejects_adapters(self, params):
+        from quintnet_tpu.serve import AdapterRegistry
+
+        with pytest.raises(NotImplementedError, match="adapters"):
+            _engine(params, mesh=_ep_mesh(2), ep_axis="ep",
+                    adapters=AdapterRegistry())
+
+    def test_ep1_mesh_nulls_ep_axis(self, params):
+        eng = _engine(params, mesh=_ep_mesh(1), ep_axis="ep")
+        assert eng.ep_axis is None
+        eng2 = _engine(params, mesh=_ep_mesh(2), ep_axis="ep")
+        assert eng2.ep_axis == "ep"
+
+
+# ---------------------------------------------------------------------
+# the identity contracts: ep=1 == dense replication, ep=2 == ep=1
+# ---------------------------------------------------------------------
+
+class TestEpParity:
+    @pytest.mark.parametrize("sample_kw", [
+        {},                                       # greedy
+        {"temperature": 0.8, "top_k": 16},        # sampled
+    ], ids=["greedy", "sampled"])
+    def test_ep1_identical_to_dense_replication(self, params,
+                                                sample_kw):
+        base = _run_trace(_engine(params, **sample_kw))
+        ep1 = _run_trace(_engine(params, mesh=_ep_mesh(1),
+                                 ep_axis="ep", **sample_kw))
+        for a, b in zip(base, ep1):
+            assert np.array_equal(a, b)
+
+    @pytest.mark.parametrize("sample_kw", [
+        {},
+        {"temperature": 0.8, "top_k": 16},
+    ], ids=["greedy", "sampled"])
+    def test_ep2_token_identical_to_ep1(self, params, sample_kw):
+        ep1 = _run_trace(_engine(params, mesh=_ep_mesh(1),
+                                 ep_axis="ep", **sample_kw))
+        ep2 = _run_trace(_engine(params, mesh=_ep_mesh(2),
+                                 ep_axis="ep", **sample_kw))
+        for a, b in zip(ep1, ep2):
+            assert np.array_equal(a, b)
+
+    @pytest.mark.parametrize("feature_kw", [
+        {"kv_dtype": "int8"},
+        {"spec": SpecConfig()},
+        {"chunked_prefill": True, "prefill_chunk_budget": 8},
+    ], ids=["int8_kv", "spec_decode", "chunked_prefill"])
+    def test_ep2_parity_composes_with_engine_features(self, params,
+                                                      feature_kw):
+        """ep=2 stays token-identical to the dense-replicated engine
+        under each engine feature it must compose with — the feature's
+        own dense goldens (test_kv_quant / test_spec / test_longctx)
+        carry the rest of the equivalence chain."""
+        base = _run_trace(_engine(params, **feature_kw),
+                          lengths=(12, 5, 9), max_new=5)
+        ep2 = _run_trace(_engine(params, mesh=_ep_mesh(2),
+                                 ep_axis="ep", **feature_kw),
+                         lengths=(12, 5, 9), max_new=5)
+        for a, b in zip(base, ep2):
+            assert np.array_equal(a, b)
+
+    def test_ep2_parity_with_prefix_cache_reuse(self, params):
+        """A shared-prefix second request admits through the prefix
+        cache (hit tokens > 0) and STILL matches the dense-replicated
+        engine token-for-token — the COW + cached-chain path neither
+        skips nor double-runs any MoE layer."""
+        rng = np.random.default_rng(3)
+        prefix = np.asarray(rng.integers(0, CFG.vocab_size, (9,)),
+                            np.int32)
+        tail = np.asarray(rng.integers(0, CFG.vocab_size, (4,)),
+                          np.int32)
+        outs = {}
+        for name, kw in (("base", {}),
+                         ("ep2", {"mesh": _ep_mesh(2),
+                                  "ep_axis": "ep"})):
+            eng = _engine(params, **kw)
+            r1 = eng.submit(prefix, 4, key=jax.random.key(1))
+            while eng.has_work:
+                eng.step()
+            r2 = eng.submit(np.concatenate([prefix, tail]), 4,
+                            key=jax.random.key(2))
+            while eng.has_work:
+                eng.step()
+            assert eng.metrics.prefix_hit_tokens > 0
+            outs[name] = (eng.result(r1), eng.result(r2))
+        for a, b in zip(outs["base"], outs["ep2"]):
+            assert np.array_equal(a, b)
+
+    def test_ep_times_tp_parity(self, params):
+        """ep x tp == tp: sharding the experts over ep on top of a
+        tp-sharded engine changes no committed token. (The reference
+        is the tp-ONLY engine, not the dense one: tp splits the FFN
+        contraction and reassociates float sums — a pre-existing tp
+        property, identical for dense and MoE FFNs — while ep moves
+        whole expert FFNs between ranks without touching any
+        reduction order.)"""
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices")
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("ep", "tp"))
+        tp = _run_trace(_engine(
+            params, mesh=Mesh(np.array(jax.devices()[:2]), ("tp",))))
+        eptp = _run_trace(_engine(params, mesh=mesh, ep_axis="ep"))
+        for a, b in zip(tp, eptp):
+            assert np.array_equal(a, b)
+
+    def test_compile_counts_unchanged_by_ep(self, params):
+        """ep changes the programs' internals, never the program
+        ladder: one compiled prefill per bucket + one decode, exactly
+        like a dense engine (RecompileSentinel max_compiles=1)."""
+        eng = _engine(params, mesh=_ep_mesh(2), ep_axis="ep")
+        _run_trace(eng)
+        assert eng.compile_stats() == {"prefill": 1, "decode": 1}
+        eng.assert_compile_count()
+
+
+# ---------------------------------------------------------------------
+# routing telemetry: metrics -> aggregate -> prom -> recorder
+# ---------------------------------------------------------------------
+
+class TestRoutingStats:
+    def test_dense_summary_has_no_moe_keys(self, params):
+        dense = GPT2Config.tiny(n_layer=2)
+        eng = _engine(gpt2_init(jax.random.key(0), dense), cfg=dense)
+        _run_trace(eng)
+        assert not any(k.startswith("moe") for k in
+                       eng.metrics.summary())
+
+    def test_summary_reports_real_routed_demand(self, params):
+        eng = _engine(params)
+        _run_trace(eng)
+        s = eng.metrics.summary()
+        assert s["moe_routed_tokens"] > 0
+        # per-expert demand sums to the total routed demand (both are
+        # PRE-capacity-cut): the ledger reads the programs' own
+        # routing masks, it does not re-derive anything host-side
+        assert (sum(s["moe_expert_tokens"].values())
+                == s["moe_routed_tokens"])
+        assert s["moe_expert_skew"] >= 1.0
+        assert 0.0 <= s["moe_drop_rate"] <= 1.0
+        assert s["moe_router_entropy"] > 0.0
+
+    def test_capacity_drops_are_counted(self, params):
+        """An explicit capacity of 1 token per expert under top_k=2
+        routing MUST drop assignments — the drop ledger reads real
+        program outputs, so it cannot be zero."""
+        cfg = GPT2Config.tiny(n_layer=2, n_experts=4, expert_top_k=2,
+                              expert_capacity=1)
+        eng = _engine(gpt2_init(jax.random.key(0), cfg), cfg=cfg)
+        _run_trace(eng)
+        s = eng.metrics.summary()
+        assert s["moe_dropped_tokens"] > 0
+        assert s["moe_drop_rate"] > 0.0
+
+    def test_ep2_and_dense_report_identical_routing(self, params):
+        """The routing masks are replicated — sharding the experts
+        must not change a single routed/dropped count."""
+        a = _engine(params)
+        b = _engine(params, mesh=_ep_mesh(2), ep_axis="ep")
+        _run_trace(a)
+        _run_trace(b)
+        sa, sb = a.metrics.summary(), b.metrics.summary()
+        for k in ("moe_routed_tokens", "moe_dropped_tokens",
+                  "moe_expert_tokens"):
+            assert sa[k] == sb[k], k
+
+    def test_aggregate_sums_moe_ledgers(self, params):
+        from quintnet_tpu.serve.metrics import aggregate
+
+        a = _engine(params)
+        b = _engine(params)
+        _run_trace(a)
+        _run_trace(b, seed=1)
+        agg = aggregate([a.metrics, b.metrics])
+        sa, sb = a.metrics.summary(), b.metrics.summary()
+        assert agg["moe_routed_tokens"] == (sa["moe_routed_tokens"]
+                                            + sb["moe_routed_tokens"])
+        assert agg["moe_dropped_tokens"] == (
+            sa["moe_dropped_tokens"] + sb["moe_dropped_tokens"])
+        for e in agg["moe_expert_tokens"]:
+            assert agg["moe_expert_tokens"][e] == (
+                sa["moe_expert_tokens"][e] + sb["moe_expert_tokens"][e])
+        # a dense fleet's aggregate stays moe-free
+        dense = GPT2Config.tiny(n_layer=2)
+        d = _engine(gpt2_init(jax.random.key(0), dense), cfg=dense)
+        _run_trace(d)
+        assert not any(k.startswith("moe")
+                       for k in aggregate([d.metrics]))
+
+    def test_prom_exposition_moe_families(self, params):
+        from quintnet_tpu.obs.prom import (iter_samples,
+                                           parse_exposition,
+                                           render_exposition, sample)
+
+        eng = _engine(params)
+        _run_trace(eng)
+        s = eng.metrics.summary()
+        text = render_exposition({}, {"r0": s})
+        parsed = parse_exposition(text)
+        assert sample(parsed, "quintnet_engine_moe_routed_tokens",
+                      replica="r0") == s["moe_routed_tokens"]
+        assert sample(parsed, "quintnet_engine_moe_drop_rate",
+                      replica="r0") == pytest.approx(
+                          s["moe_drop_rate"])
+        # one expert-labeled series per expert
+        per_expert = dict(iter_samples(
+            parsed, "quintnet_engine_moe_expert_tokens"))
+        assert len(per_expert) == CFG.n_experts
+        for labels, v in per_expert.items():
+            eid = dict(labels)["expert"]
+            assert v == s["moe_expert_tokens"][eid]
+        # counters are TYPEd as counters
+        assert ("# TYPE quintnet_engine_moe_routed_tokens counter"
+                in text)
+        # a dense engine's exposition carries no moe families
+        dense = GPT2Config.tiny(n_layer=2)
+        deng = _engine(gpt2_init(jax.random.key(0), dense), cfg=dense)
+        _run_trace(deng)
+        dtext = render_exposition({}, {"r0": deng.metrics.summary()})
+        assert "moe" not in dtext
+
+    def test_recorder_attrs_carry_step_routing(self, params):
+        from quintnet_tpu.obs.recorder import StepRecorder
+
+        eng = _engine(params)
+        eng.recorder = StepRecorder(capacity=64, clock=eng.clock)
+        _run_trace(eng)
+        recs = eng.recorder.snapshot()
+        moe_recs = [r for r in recs if r["attrs"]]
+        assert moe_recs, "no step carried routing attrs"
+        attrs = moe_recs[0]["attrs"]
+        assert attrs["moe_routed_tokens"] > 0
+        assert len(attrs["moe_expert_tokens"]) == CFG.n_experts
+        # the ring's attrs sum to the metrics ledger (every step's
+        # drain landed in exactly one record)
+        assert sum(r["attrs"].get("moe_routed_tokens", 0)
+                   for r in recs) == eng.metrics.moe_routed_tokens

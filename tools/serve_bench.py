@@ -138,6 +138,13 @@ def build_model(args, params=None):
         ("n_layer", args.n_layer), ("n_embd", args.n_embd),
         ("n_head", args.n_head), ("n_positions", args.n_positions),
         ("vocab_size", args.vocab_size)) if v is not None}
+    if getattr(args, "experts", 0):
+        # --experts N makes the synthetic config an MoE one (both
+        # config families carry the same field names)
+        syn_kw.update(n_experts=args.experts,
+                      expert_top_k=args.expert_top_k,
+                      capacity_factor=args.capacity_factor,
+                      expert_capacity=args.expert_capacity)
     if args.model == "gpt2":
         from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
 
@@ -151,7 +158,10 @@ def build_model(args, params=None):
 
         lkw = {{"n_layer": "n_layers", "n_embd": "dim",
                 "n_head": "n_heads", "n_positions": "n_positions",
-                "vocab_size": "vocab_size"}[k]: v
+                "vocab_size": "vocab_size",
+                "n_experts": "n_experts", "expert_top_k": "expert_top_k",
+                "capacity_factor": "capacity_factor",
+                "expert_capacity": "expert_capacity"}[k]: v
                for k, v in syn_kw.items()}
         cfg = (LlamaConfig.tiny(**{"n_layers": 2, **lkw})
                if args.synthetic else LlamaConfig())
@@ -291,6 +301,29 @@ def tier_trace_gen(args, vocab_size: int):
         trace.append(
             (t, np.concatenate([prefixes[j % args.tier_prefixes], tail]),
              args.max_new))
+    return trace
+
+
+def hot_expert_trace(args, vocab_size: int):
+    """Skewed-routing traffic for the ``--moe-trace`` A/B: every
+    request tiles the SAME short token pattern to its sampled prompt
+    length, so the router scores the same few hidden states over and
+    over — routed demand concentrates on that pattern's favourite
+    experts (and the greedy continuations settle into repetitive
+    cycles, concentrating decode-time routing the same way). The
+    diverse side of the A/B is the plain Poisson trace: random
+    prompts spread demand across the expert set."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    pat = rng.integers(0, vocab_size,
+                       (max(args.pattern, 1),)).astype(np.int32)
+    arrivals = poisson_arrivals(rng, args.requests, args.rate)
+    trace = []
+    for t in arrivals:
+        n = int(rng.integers(args.min_prompt, args.max_prompt + 1))
+        trace.append((t, np.tile(pat, -(-n // len(pat)))[:n],
+                      args.max_new))
     return trace
 
 
@@ -814,6 +847,73 @@ def run(args) -> dict:
             "extras": extras,
         }
 
+    if args.moe_trace:
+        # MoE routing A/B over the SAME engine config: a DIVERSE trace
+        # (random prompts spread routed demand over the expert set) vs
+        # a HOT-EXPERT trace (every request tiles one shared pattern —
+        # skewed routing concentrates demand and drives capacity
+        # drops). Wall clocks are reported, never gated; the gates are
+        # structural: the routing ledger must account exactly and the
+        # compile bound must not move (MoE adds zero programs).
+        if not args.synthetic:
+            raise SystemExit("--moe-trace needs --synthetic (the MoE "
+                             "fields extend the tiny config)")
+        family, params = build_model(args)
+        eng_d = build_engine(args, prefix_cache=True, params=params)
+        trace_d = poisson_trace(args, family.cfg.vocab_size)
+        s_d = replay(eng_d, trace_d, args)
+        eng_h = build_engine(args, prefix_cache=True, params=params)
+        trace_h = hot_expert_trace(args, family.cfg.vocab_size)
+        s_h = replay(eng_h, trace_h, args)
+        for s in (s_d, s_h):
+            # the ledger reads program outputs — it must account
+            # exactly: per-expert demand sums to the routed total,
+            # and drops never exceed it
+            assert (sum(s["moe_expert_tokens"].values())
+                    == s["moe_routed_tokens"]), "routing ledger leak"
+            assert 0 <= s["moe_dropped_tokens"] <= s["moe_routed_tokens"]
+        for eng in (eng_d, eng_h):
+            # warmup compiles every ladder bucket once; MoE must not
+            # add a single program beyond that bound
+            eng.assert_compile_count(prefill=len(eng._prefills))
+        extras = _common_extras(args, s_h)
+        ratio = round(s_h["tokens_per_sec"]
+                      / max(s_d["tokens_per_sec"], 1e-9), 3)
+        extras.update({
+            "moe_trace": True,
+            "experts": args.experts,
+            "expert_top_k": args.expert_top_k,
+            "capacity_factor": args.capacity_factor,
+            "expert_capacity": args.expert_capacity,
+            "pattern": args.pattern,
+            "compile_counts": eng_h.compile_stats(),
+            # hot (skewed-routing) side — the committed skew evidence
+            "hot_expert_skew": s_h["moe_expert_skew"],
+            "hot_drop_rate": s_h["moe_drop_rate"],
+            "hot_routed_tokens": s_h["moe_routed_tokens"],
+            "hot_dropped_tokens": s_h["moe_dropped_tokens"],
+            "hot_router_entropy": s_h["moe_router_entropy"],
+            "hot_expert_tokens": s_h["moe_expert_tokens"],
+            # diverse side — the balanced baseline
+            "diverse_expert_skew": s_d["moe_expert_skew"],
+            "diverse_drop_rate": s_d["moe_drop_rate"],
+            "diverse_routed_tokens": s_d["moe_routed_tokens"],
+            "diverse_dropped_tokens": s_d["moe_dropped_tokens"],
+            "diverse_router_entropy": s_d["moe_router_entropy"],
+            "diverse_expert_tokens": s_d["moe_expert_tokens"],
+            "diverse_tokens_per_sec": s_d["tokens_per_sec"],
+            "diverse_wall_s": s_d["wall_s"],
+            "hot_vs_diverse": ratio,
+        })
+        return {
+            "metric": f"serve_{args.model}_{tag}_moe_tokens_per_sec",
+            "value": s_h["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": ratio,
+            "rc": 0,
+            "extras": extras,
+        }
+
     if args.prefix_share:
         # A/B over the SAME shared-prefix trace: cache-on vs cache-off
         eng_on = build_engine(args, prefix_cache=True)
@@ -1175,6 +1275,21 @@ def main():
                     help="synthetic-config max-positions override")
     ap.add_argument("--vocab-size", type=int, default=None,
                     help="synthetic-config vocab override")
+    ap.add_argument("--moe-trace", action="store_true",
+                    help="MoE routing A/B: diverse Poisson trace vs "
+                         "hot-expert (one shared tiled pattern) trace "
+                         "through the same MoE engine; value = hot-side "
+                         "tok/s, vs_baseline = hot/diverse")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="expert count for the synthetic config (0 = "
+                         "dense; --moe-trace defaults this to 4)")
+    ap.add_argument("--expert-top-k", type=int, default=2,
+                    help="routed experts per token (--experts)")
+    ap.add_argument("--capacity-factor", type=float, default=1.25,
+                    help="expert capacity slack multiplier (--experts)")
+    ap.add_argument("--expert-capacity", type=int, default=None,
+                    help="hard per-expert token capacity override "
+                         "(--experts; default: derived from the factor)")
     ap.add_argument("--obs-ab", action="store_true",
                     help="observability overhead A/B over the default "
                          "trace: flight recorder (obs/) armed vs off; "
@@ -1188,6 +1303,8 @@ def main():
     ap.add_argument("--out", default=None,
                     help="append the record to this artifacts JSON file")
     args = ap.parse_args()
+    if args.moe_trace and not args.experts:
+        args.experts = 4
     if args.shared_prefix is None:
         args.shared_prefix = 36 if args.synthetic else 96
     if args.long_trace and args.synthetic and args.n_positions is None:
