@@ -1,6 +1,6 @@
 """qtcheck: static analysis for QuintNet-TPU's compiled programs.
 
-Three passes, one CI gate (``python -m quintnet_tpu.tools.qtcheck``):
+Four passes, one CI gate (``python -m quintnet_tpu.tools.qtcheck``):
 
 - :mod:`~quintnet_tpu.analysis.jaxpr_audit` — lower any jitted function
   and walk its jaxpr: per-axis collective census, dtype-promotion
@@ -11,10 +11,18 @@ Three passes, one CI gate (``python -m quintnet_tpu.tools.qtcheck``):
 - :mod:`~quintnet_tpu.analysis.lint` — AST rules for JAX footguns
   (host numpy / Python RNG in traced code, tracer branching, step-loop
   host syncs, array defaults, unsynced wall-clock timing) with a
-  committed baseline (tools/qtcheck_baseline.json).
+  committed baseline (tools/qtcheck_baseline.json);
+- :mod:`~quintnet_tpu.analysis.threads` — AST concurrency rules for the
+  serving fleet (lock-order cycles, guarded-by inference, thread-spawn
+  census vs the declarative spec) with its own committed baseline
+  (tools/qtcheck_threads_baseline.json). Its runtime twin,
+  :mod:`~quintnet_tpu.analysis.lockrt`, wraps ``threading`` locks with
+  order/hold/contention instrumentation behind the fleets'
+  ``lock_audit=`` flag.
 
-Expected-census specs for the shipped programs live in
-:mod:`~quintnet_tpu.analysis.specs`; tests/test_qtcheck.py pins them.
+Expected-census specs for the shipped programs (and the thread-spawn
+spec) live in :mod:`~quintnet_tpu.analysis.specs`; tests/test_qtcheck.py
+and tests/test_qtcheck_threads.py pin them.
 """
 
 from quintnet_tpu.analysis.jaxpr_audit import (
@@ -27,11 +35,18 @@ from quintnet_tpu.analysis.jaxpr_audit import (
 from quintnet_tpu.analysis.lint import (
     RULES,
     Violation,
+    collect_sources,
     compare_baseline,
+    lint_parsed,
     lint_paths,
     lint_source,
     load_baseline,
     violations_to_baseline,
+)
+from quintnet_tpu.analysis.lockrt import (
+    InstrumentedLock,
+    LockAudit,
+    LockOrderError,
 )
 from quintnet_tpu.analysis.recompile import (
     RecompileError,
@@ -39,6 +54,15 @@ from quintnet_tpu.analysis.recompile import (
     abstract_signature,
     assert_compile_count,
     check_serving_compile_counts,
+)
+from quintnet_tpu.analysis.threads import (
+    RULES as THREAD_RULES,
+    THREAD_PATHS,
+    audit_parsed,
+    audit_paths,
+    audit_sources,
+    load_thread_specs,
+    thread_spawn_census,
 )
 
 __all__ = [
@@ -49,14 +73,26 @@ __all__ = [
     "gathered_view_gathers",
     "RULES",
     "Violation",
+    "collect_sources",
     "compare_baseline",
+    "lint_parsed",
     "lint_paths",
     "lint_source",
     "load_baseline",
     "violations_to_baseline",
+    "InstrumentedLock",
+    "LockAudit",
+    "LockOrderError",
     "RecompileError",
     "RecompileSentinel",
     "abstract_signature",
     "assert_compile_count",
     "check_serving_compile_counts",
+    "THREAD_PATHS",
+    "THREAD_RULES",
+    "audit_parsed",
+    "audit_paths",
+    "audit_sources",
+    "load_thread_specs",
+    "thread_spawn_census",
 ]

@@ -346,12 +346,24 @@ def lint_source(source: str, rel_path: str,
     return sorted(linter.violations, key=lambda v: (v.path, v.line, v.rule))
 
 
-def lint_paths(paths: Sequence[str], *, root: str = ".",
-               rules: Optional[Sequence[str]] = None) -> List[Violation]:
-    """Lint every ``*.py`` under ``paths`` (files or directories),
-    reporting paths relative to ``root`` so baselines are stable across
-    checkouts."""
-    out: List[Violation] = []
+@dataclass(frozen=True)
+class SourceFile:
+    """One collected file: the SHARED parse every pass consumes. The
+    CLI walks and parses the tree exactly once (``collect_sources``)
+    and hands the same list to the lint rules and the concurrency pass
+    (analysis/threads.py) — re-reading and re-parsing per pass was the
+    dominant cost of a full-tree run."""
+    rel: str
+    source: str
+    tree: Optional[ast.Module]           # None when the file failed to
+    error: Optional[str] = None          # parse (error says why)
+
+
+def collect_sources(paths: Sequence[str], *,
+                    root: str = ".") -> List[SourceFile]:
+    """Read + parse every ``*.py`` under ``paths`` (files or
+    directories) once, reporting paths relative to ``root`` so
+    baselines stay stable across checkouts."""
     files: List[str] = []
     for p in paths:
         full = os.path.join(root, p)
@@ -363,17 +375,49 @@ def lint_paths(paths: Sequence[str], *, root: str = ".",
                                if d not in ("__pycache__", ".git")]
                 files.extend(os.path.join(dirpath, f)
                              for f in filenames if f.endswith(".py"))
+    out: List[SourceFile] = []
+    seen: Set[str] = set()
     for f in sorted(files):
         rel = os.path.relpath(f, root)
+        if rel in seen:        # overlapping path args: parse once
+            continue
+        seen.add(rel)
         with open(f, encoding="utf-8") as fh:
             src = fh.read()
         try:
-            out.extend(lint_source(src, rel, rules))
+            out.append(SourceFile(rel, src,
+                                  ast.parse(src, filename=rel)))
         except SyntaxError as e:  # pragma: no cover - tree is parseable
-            out.append(Violation(rule="QT000", path=rel,
-                                 line=e.lineno or 0, symbol="<module>",
-                                 message=f"syntax error: {e.msg}"))
+            out.append(SourceFile(rel, src, None,
+                                  error=f"syntax error: {e.msg} "
+                                        f"(line {e.lineno})"))
     return out
+
+
+def lint_parsed(sources: Sequence[SourceFile],
+                rules: Optional[Sequence[str]] = None) -> List[Violation]:
+    """Lint pre-parsed sources (no file IO, no re-parse)."""
+    out: List[Violation] = []
+    for sf in sources:
+        if sf.tree is None:
+            out.append(Violation(rule="QT000", path=sf.rel, line=0,
+                                 symbol="<module>",
+                                 message=sf.error or "unparseable"))
+            continue
+        linter = _Linter(sf.rel, sf.rel, sf.source,
+                         set(rules) if rules else set(RULES))
+        linter.collect_traced(sf.tree)
+        linter.visit(sf.tree)
+        out.extend(linter.violations)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def lint_paths(paths: Sequence[str], *, root: str = ".",
+               rules: Optional[Sequence[str]] = None) -> List[Violation]:
+    """Lint every ``*.py`` under ``paths`` (files or directories),
+    reporting paths relative to ``root`` so baselines are stable across
+    checkouts."""
+    return lint_parsed(collect_sources(paths, root=root), rules)
 
 
 # ---------------------------------------------------------------------------

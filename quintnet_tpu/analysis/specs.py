@@ -370,3 +370,71 @@ def lora_rank_buckets(max_rank: int, *, floor: int = 4) -> Tuple[int, ...]:
         b *= 2
     out.append(max_rank)
     return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# thread-spawn census (quintnet_tpu/analysis/threads.py, rule QT203)
+
+# THE canonical expected-spawn spec for the fleet/serve/obs tree — the
+# concurrency mirror of the collective censuses above. Every
+# ``threading.Thread``/``Timer`` construction site in the audited tree
+# must appear here, keyed (module, spawning symbol, target), with its
+# shutdown story: ``daemon`` (does process exit reap it) and ``joined``
+# (does some code path wait for it). qtcheck-threads fails BOTH
+# directions — a spawn the spec lacks (new thread landed without a
+# shutdown story) and a spec entry the tree lacks (thread removed,
+# spec stale) — so the fleet's thread population changes only with a
+# named diff here, never silently.
+#
+# MUST stay a pure literal: the zero-jax qtcheck CLI reads it with
+# ``ast.literal_eval`` (threads.load_thread_specs) because this module
+# imports jax at the top.
+THREAD_SPAWN_SPECS = {
+    "quintnet_tpu/fleet/fleet.py": [
+        # in-process fleet dispatcher; close() joins it.
+        {"symbol": "ServeFleet.__init__", "target": "self._dispatch_loop",
+         "daemon": True, "joined": True},
+    ],
+    "quintnet_tpu/fleet/frontdoor.py": [
+        # asyncio event-loop carrier thread; stop() joins it.
+        {"symbol": "FrontDoor.start", "target": "run",
+         "daemon": True, "joined": True},
+        # per-stream disconnect watcher; self-terminates with the
+        # stream (bounded by the request), daemon as backstop.
+        {"symbol": "FrontDoor._generate_stream", "target": "watch",
+         "daemon": True, "joined": False},
+    ],
+    "quintnet_tpu/fleet/proc.py": [
+        # child-side stdin reader + heartbeat: live for the worker
+        # process's lifetime, reaped by process exit.
+        {"symbol": "replica_main", "target": "reader",
+         "daemon": True, "joined": False},
+        {"symbol": "replica_main", "target": "heartbeat",
+         "daemon": True, "joined": False},
+        # parent-side per-replica socket reader; exits on EOF when the
+        # child dies or close() shuts the socket.
+        {"symbol": "ProcReplica.attach", "target": "self._read_loop",
+         "daemon": True, "joined": False},
+        # fleet accept + dispatch loops; close() joins both.
+        {"symbol": "ProcessFleet.__init__", "target": "self._accept_loop",
+         "daemon": True, "joined": True},
+        {"symbol": "ProcessFleet.__init__", "target": "self._dispatch_loop",
+         "daemon": True, "joined": True},
+        # async prefix-handoff push (PR 12); bounded by the RPC
+        # timeout, daemon so a hung peer can't block close().
+        {"symbol": "ProcessFleet._finish", "target": "self._run_handoff",
+         "daemon": True, "joined": False},
+        # tiered-KV peer-fetch daemon (PR 15); same bounded-RPC story.
+        {"symbol": "ProcessFleet._dispatch_loop",
+         "target": "self._run_peer_fetch",
+         "daemon": True, "joined": False},
+        # warmup fan-out: non-daemon worker threads joined in-call.
+        {"symbol": "ProcessFleet.warmup", "target": "one",
+         "daemon": False, "joined": True},
+    ],
+    "quintnet_tpu/fleet/replica.py": [
+        # per-replica worker; stop() joins it.
+        {"symbol": "Replica.__init__", "target": "self._worker",
+         "daemon": True, "joined": True},
+    ],
+}

@@ -283,12 +283,28 @@ class ServeFleet:
                  chaos=None, clock: Callable[[], float] = time.monotonic,
                  name_prefix: str = "r", poll_s: float = 0.02,
                  obs: bool = False, crash_dir: Optional[str] = None,
-                 ring_capacity: int = 512, slo=None):
+                 ring_capacity: int = 512, slo=None,
+                 lock_audit: bool = False):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self._factory = engine_factory
         self.clock = clock
         self.metrics = FleetMetrics()
+        # lock-discipline runtime (analysis/lockrt.py): lock_audit=True
+        # swaps every lock this fleet mints for an InstrumentedLock
+        # sharing ONE order graph + ledger registry, so an A→B/B→A
+        # inversion anywhere in the fleet raises a typed LockOrderError
+        # instead of deadlocking, and GET /metrics grows the
+        # quintnet_lock_* families. Off (the default) the locks are the
+        # stock threading primitives — byte-identical behavior.
+        self.lock_audit = None
+        if lock_audit:
+            from quintnet_tpu.analysis.lockrt import LockAudit
+
+            self.lock_audit = LockAudit(
+                clock=clock,
+                on_violation=lambda info: self._emit(
+                    "lock_order_violation", **info))
         # observability (quintnet_tpu/obs/): ``obs=True`` arms ONE
         # fleet-wide Tracer (engines share the address space, so every
         # replica engine records into it directly — one merged
@@ -313,8 +329,10 @@ class ServeFleet:
         if self._obs:
             from quintnet_tpu.obs import EventLog, Tracer
 
-            self.tracer = Tracer(clock=clock)
-            self.events = EventLog(clock=clock)
+            self.tracer = Tracer(clock=clock,
+                                 lock=self._audit_lock("obs.tracer"))
+            self.events = EventLog(clock=clock,
+                                   lock=self._audit_lock("obs.events"))
         self.crash_dumps: List[str] = []     # paths written (crash_dir)
         self.last_crash: Optional[Dict] = None
         self._pending_dumps: List[Dict] = []  # snapshotted under the
@@ -322,7 +340,11 @@ class ServeFleet:
         #   disk write must never stall token delivery
         self._breaker_seen: Dict[str, str] = {}
         self._router = Router(policy)
-        self._cv = threading.Condition()
+        # threading.Condition()'s default lock IS an RLock — the
+        # audited swap must preserve reentrancy (audit.condition)
+        self._cv = (self.lock_audit.condition("fleet._cv")
+                    if self.lock_audit is not None
+                    else threading.Condition())
         self._queue = AdmissionQueue(max_pending, clock=clock)
         self.metrics._queue_probe = self._queue_gauges
         if slo is not None:
@@ -358,6 +380,13 @@ class ServeFleet:
             target=self._dispatch_loop, name="fleet-dispatch", daemon=True)
         self._dispatcher.start()
 
+    def _audit_lock(self, name: str):
+        """An instrumented Lock under ``lock_audit=True``, else None
+        (the primitive constructors fall back to a stock Lock — the
+        off path constructs exactly what it always did)."""
+        return (self.lock_audit.lock(name)
+                if self.lock_audit is not None else None)
+
     def _spawn(self, name: str, chaos) -> Replica:
         rep = Replica(name, self._factory, chaos=chaos,
                       max_dispatch=self._max_dispatch,
@@ -370,7 +399,8 @@ class ServeFleet:
             # per-engine flight-recorder ring (the replica's black box)
             rep.engine.tracer = self.tracer
             rep.engine.recorder = StepRecorder(
-                capacity=self._ring_capacity, clock=rep.engine.clock)
+                capacity=self._ring_capacity, clock=rep.engine.clock,
+                lock=self._audit_lock(f"recorder.{name}"))
         return rep
 
     def _emit(self, kind: str, **fields) -> None:
@@ -639,6 +669,10 @@ class ServeFleet:
             # fleet freezes (fleet/proc.py)
             "signals": (self.signals.snapshot()
                         if self.signals is not None else {}),
+            # the lock-audit ledgers ride the black box under
+            # lock_audit=True: "who held what, for how long" at death
+            "locks": (self.lock_audit.summary()
+                      if self.lock_audit is not None else {}),
         }
         if self.crash_dir is not None:
             self._pending_dumps.append(dict(
@@ -804,6 +838,8 @@ class ServeFleet:
                             "fleet closed with the request in flight")
             pending, self._pending_dumps = self._pending_dumps, []
         self._write_dumps(pending)   # dumps a closing race queued
+        if self.lock_audit is not None:
+            self.lock_audit.close()
 
     # ------------------------------------------------------------------
     # introspection
@@ -866,10 +902,14 @@ class ServeFleet:
                 "read the per-replica step rings")
         with self._cv:
             if self.events is None:
-                self.events = EventLog(clock=self.clock)
+                self.events = EventLog(
+                    clock=self.clock,
+                    lock=self._audit_lock("obs.events"))
             self.slo = SLOEngine(config, clock=self.clock,
                                  events=self.events)
-            self.signals = SignalBus(clock=self.clock)
+            self.signals = SignalBus(
+                clock=self.clock,
+                lock=self._audit_lock("obs.signals"))
             self._signal_next_t = 0.0
 
     def _slo_observe(self, stream: str, value: float) -> None:

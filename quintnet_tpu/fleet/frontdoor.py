@@ -347,11 +347,13 @@ class FrontDoor:
                                              self._engine_summaries)
         slo = getattr(self.fleet, "slo", None)
         signals = getattr(self.fleet, "signals", None)
+        audit = getattr(self.fleet, "lock_audit", None)
         text = render_exposition(
             self.fleet.metrics.summary(), engines,
             health=self.fleet.health(),
             slo=slo.status() if slo is not None else None,
-            pressure=signals.gauges() if signals is not None else None)
+            pressure=signals.gauges() if signals is not None else None,
+            locks=audit.summary() if audit is not None else None)
         data = text.encode("utf-8")
         head = ["HTTP/1.1 200 OK",
                 "Content-Type: text/plain; version=0.0.4; "

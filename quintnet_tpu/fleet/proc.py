@@ -441,13 +441,20 @@ class ProcReplica:
         from collections import deque
 
         self.ring = deque(maxlen=fleet._ring_capacity)
-        self._ring_lock = threading.Lock()
+        # audited fleets fold both replica locks into the fleet-wide
+        # order graph (same-name re-mint across restarts returns the
+        # SAME lock, so a respawned incarnation keeps its node)
+        self._ring_lock = (
+            fleet.lock_audit.lock(f"proc.{name}._ring_lock")
+            if fleet.lock_audit is not None else threading.Lock())
         self._fid2freq: Dict[int, FleetRequest] = {}
         # adapters this incarnation has been sent (affinity heuristic:
         # the child's registry loaded them on first use; its own LRU
         # may have evicted — affinity is a preference, never a promise)
         self._adapters_seen: set = set()
-        self._send_lock = threading.Lock()
+        self._send_lock = (
+            fleet.lock_audit.lock(f"proc.{name}._send_lock")
+            if fleet.lock_audit is not None else threading.Lock())
         self._pending: Dict[int, tuple] = {}
         self._rpc_counter = 0
 
@@ -490,7 +497,8 @@ class ProcReplica:
             frame = dict(frame, id=rid)
             wire.send_frame(self.sock, frame)
         if not ev.wait(timeout):
-            self._pending.pop(rid, None)
+            with self._send_lock:
+                self._pending.pop(rid, None)
             raise TimeoutError(
                 f"replica {self.name}: no reply to {frame['t']!r} "
                 f"within {timeout}s (state={self.state})")
@@ -567,7 +575,13 @@ class ProcReplica:
                 frame = wire.recv_frame(self.sock, peer=self.name)
                 rid = frame.get("id")
                 if rid is not None:
-                    pend = self._pending.pop(rid, None)
+                    # the pop shares _send_lock with rpc() registration
+                    # and _abort_pending's swap: a timeout-side pop and
+                    # this reply-side pop racing the swap must agree on
+                    # ONE dict (qtcheck-threads QT202 caught the bare
+                    # read here)
+                    with self._send_lock:
+                        pend = self._pending.pop(rid, None)
                     if pend is not None:
                         pend[1]["frame"] = frame
                         pend[0].set()
@@ -638,7 +652,8 @@ class ProcessFleet:
                  spawn_timeout_s: float = 300.0,
                  obs: bool = False, crash_dir: Optional[str] = None,
                  ring_capacity: int = 512,
-                 slo=None, planner: Optional[Dict] = None):
+                 slo=None, planner: Optional[Dict] = None,
+                 lock_audit: bool = False):
         # disaggregated prefill/decode pools (DistServe/Splitwise):
         # ``pools={"prefill": P, "decode": D}`` splits the replicas
         # onto dedicated pools — prefill replicas run a prompt's
@@ -697,6 +712,21 @@ class ProcessFleet:
         self._obs = bool(obs) or slo is not None
         self.crash_dir = crash_dir
         self._ring_capacity = int(ring_capacity)
+        # lock-discipline runtime (analysis/lockrt.py): lock_audit=True
+        # swaps every parent-side lock — the fleet Condition, each
+        # replica's ring + send locks, the obs primitives' mutexes —
+        # for InstrumentedLocks sharing ONE order graph, so an
+        # inversion raises a typed LockOrderError instead of
+        # deadlocking and /metrics grows quintnet_lock_*. Off (the
+        # default) the stock primitives are constructed verbatim.
+        self.lock_audit = None
+        if lock_audit:
+            from quintnet_tpu.analysis.lockrt import LockAudit
+
+            self.lock_audit = LockAudit(
+                clock=clock,
+                on_violation=lambda info: self._emit(
+                    "lock_order_violation", **info))
         self.tracer = None
         self.events = None
         self.slo = None            # obs.SLOEngine once armed
@@ -707,8 +737,10 @@ class ProcessFleet:
         if self._obs:
             from quintnet_tpu.obs import EventLog, Tracer
 
-            self.tracer = Tracer(clock=clock)
-            self.events = EventLog(clock=clock)
+            self.tracer = Tracer(clock=clock,
+                                 lock=self._audit_lock("obs.tracer"))
+            self.events = EventLog(clock=clock,
+                                   lock=self._audit_lock("obs.events"))
         self.crash_dumps: List[str] = []
         self.last_crash: Optional[Dict] = None
         self._pending_dumps: List[Dict] = []  # snapshotted under the
@@ -725,7 +757,11 @@ class ProcessFleet:
         self.backoff = backoff or Backoff()
         self.metrics = FleetMetrics()
         self._router = Router(policy)
-        self._cv = threading.Condition()
+        # threading.Condition()'s default lock IS an RLock — the
+        # audited swap must preserve reentrancy (audit.condition)
+        self._cv = (self.lock_audit.condition("fleet._cv")
+                    if self.lock_audit is not None
+                    else threading.Condition())
         self._queue = AdmissionQueue(max_pending, clock=clock)
         self.metrics._queue_probe = self._queue_gauges
         if slo is not None:
@@ -883,6 +919,13 @@ class ProcessFleet:
                         f"check the engine builder spec "
                         f"{self.engine_spec.get('file') or self.engine_spec.get('module')}")
                 self._cv.wait(0.05)
+
+    def _audit_lock(self, name: str):
+        """An instrumented Lock under ``lock_audit=True``, else None
+        (the primitive constructors fall back to a stock Lock — the
+        off path constructs exactly what it always did)."""
+        return (self.lock_audit.lock(name)
+                if self.lock_audit is not None else None)
 
     def _emit(self, kind: str, **fields) -> None:
         if self.events is not None:
@@ -1446,6 +1489,10 @@ class ProcessFleet:
             # question the corpse cannot answer but the bus can
             "signals": (self.signals.snapshot()
                         if self.signals is not None else {}),
+            # the lock-audit ledgers ride the black box under
+            # lock_audit=True: "who held what, for how long" at death
+            "locks": (self.lock_audit.summary()
+                      if self.lock_audit is not None else {}),
         }
         if self.crash_dir is not None:
             self._pending_dumps.append(dict(
@@ -1565,10 +1612,14 @@ class ProcessFleet:
                 "read the heartbeat-mirrored step rings")
         with self._cv:
             if self.events is None:
-                self.events = EventLog(clock=self.clock)
+                self.events = EventLog(
+                    clock=self.clock,
+                    lock=self._audit_lock("obs.events"))
             self.slo = SLOEngine(config, clock=self.clock,
                                  events=self.events)
-            self.signals = SignalBus(clock=self.clock)
+            self.signals = SignalBus(
+                clock=self.clock,
+                lock=self._audit_lock("obs.signals"))
             self.planner = (PoolRebalancePlanner(
                 clock=self.clock, events=self.events, **planner_kwargs)
                 if self._disagg else None)
@@ -1893,13 +1944,19 @@ class ProcessFleet:
         holds) whose prompt spans at least one full block beyond the
         admission cap, on a multi-replica fleet whose engines carry a
         host tier (auto mode) or when explicitly forced on."""
-        limits = self._limits or {}
+        # called from the dispatch loop OUTSIDE the fleet lock (the
+        # payload-construction window): snapshot the dispatcher-owned
+        # fields under it — _limits is cached at first hello and
+        # _closed flips at close(), both under _cv (QT202)
+        with self._cv:
+            limits = self._limits or {}
+            closed = self._closed
+            n_live = len(self._replicas)
         if self._tier_peer_lookup is None:
-            enabled = (bool(limits.get("kv_tier"))
-                       and len(self._replicas) >= 2)
+            enabled = bool(limits.get("kv_tier")) and n_live >= 2
         else:
             enabled = bool(self._tier_peer_lookup)
-        if not enabled or self._closed or freq.committed:
+        if not enabled or closed or freq.committed:
             return False
         bs = int(limits.get("block_size", 0) or 0)
         return bs > 0 and len(freq.prompt) > bs
@@ -1936,7 +1993,10 @@ class ProcessFleet:
             self.metrics.tier_probes += 1
             peers = [r for r in self._replicas
                      if r is not rep and r.state == HEALTHY]
-        bs = max(int((self._limits or {}).get("block_size", 1) or 1), 1)
+            # _limits is dispatcher-owned state: read it under the
+            # same lock as the peer snapshot, not after it (QT202)
+            bs = max(int((self._limits or {}).get("block_size", 1)
+                         or 1), 1)
         # the target's own coverage is the bar a peer must clear — by
         # a full block, or the transfer costs more than it saves
         local = int(rep.rpc({"t": "kv_peek", "tokens": tokens,
@@ -1988,13 +2048,17 @@ class ProcessFleet:
     # lifecycle / operations
     # ------------------------------------------------------------------
     def pause_all(self) -> None:
-        for rep in self._replicas:
-            rep.paused = True
-            if rep.state == HEALTHY:
-                try:
-                    rep.send({"t": "pause"})
-                except OSError:
-                    pass
+        # symmetric with resume_all: rep.paused is routing state the
+        # dispatcher reads under the fleet lock, so it is written
+        # under it too (the bare writes here predated the auditor)
+        with self._cv:
+            for rep in self._replicas:
+                rep.paused = True
+                if rep.state == HEALTHY:
+                    try:
+                        rep.send({"t": "pause"})
+                    except OSError:
+                        pass
 
     def resume_all(self) -> None:
         with self._cv:
@@ -2139,6 +2203,8 @@ class ProcessFleet:
                 rep._fid2freq = {}
             pending, self._pending_dumps = self._pending_dumps, []
         self._write_dumps(pending)   # dumps a closing race queued
+        if self.lock_audit is not None:
+            self.lock_audit.close()
 
     # ------------------------------------------------------------------
     # introspection

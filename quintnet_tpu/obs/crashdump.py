@@ -40,6 +40,7 @@ def write_crash_dump(dir_path: str, *, replica: str, reason: str,
                      events: Optional[List[Dict]] = None,
                      requests: Optional[List[Dict]] = None,
                      signals: Optional[Dict] = None,
+                     locks: Optional[Dict] = None,
                      extra: Optional[Dict] = None,
                      keep: Optional[int] = 16) -> str:
     """Write one post-mortem file; returns its path.
@@ -51,7 +52,10 @@ def write_crash_dump(dir_path: str, *, replica: str, reason: str,
     (fid, trace id, tokens committed, migrations) the dispatcher's
     journal knows without any cooperation from the corpse; ``signals``
     the dispatcher's last pool-pressure snapshot
-    (``SignalBus.snapshot()``) when the signal plane is armed.
+    (``SignalBus.snapshot()``) when the signal plane is armed;
+    ``locks`` the lock-audit ledgers (``LockAudit.summary()``) when
+    the fleet runs with ``lock_audit=True`` — "who was holding what,
+    and for how long" is black-box material for a stall post-mortem.
 
     ``keep`` bounds the directory: after writing, only the newest
     ``keep`` ``crash_*.json`` files survive (a flapping replica must
@@ -80,6 +84,7 @@ def write_crash_dump(dir_path: str, *, replica: str, reason: str,
         "events": list(events or []),
         "requests": list(requests or []),
         "signals": dict(signals or {}),
+        "locks": dict(locks or {}),
         "extra": dict(extra or {}),
     }
     tmp = path + ".tmp"

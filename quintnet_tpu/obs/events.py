@@ -60,6 +60,12 @@ EVENT_KINDS = frozenset({
     "tier_peer_miss",       # no peer beat the target (or the
     #                         transfer degraded) — dispatch proceeds
     #                         without warm peer KV (attrs: reason)
+    # lock-discipline runtime (analysis/lockrt.py, fleets built with
+    # lock_audit=True): the instrumented locks observed both orders of
+    # a lock pair — the would-be deadlock, reported the moment the
+    # second direction appeared (attrs: first, second, thread,
+    # forward_stack, reverse_stack)
+    "lock_order_violation",
 })
 
 
@@ -73,12 +79,15 @@ class EventLog:
     queryable half."""
 
     def __init__(self, *, clock=time.monotonic, capacity: int = 4096,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None, lock=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.clock = clock
         self.path = path
-        self._lock = threading.Lock()
+        # ``lock=`` lets a fleet built with lock_audit=True hand in an
+        # analysis.lockrt.InstrumentedLock so the ring's mutex joins
+        # the fleet-wide order graph; default is a plain Lock.
+        self._lock = lock if lock is not None else threading.Lock()
         self._ring: "deque[Dict]" = deque(maxlen=int(capacity))
         self._seq = 0
         self._fh = None

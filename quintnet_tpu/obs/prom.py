@@ -209,18 +209,58 @@ def _add_pressure(b: _Builder, gauges: Dict[str, Dict[str, Dict]]
                                              "stat": "last"})
 
 
+def _add_locks(b: _Builder, summary: Dict) -> None:
+    """The lock-audit ledgers (analysis/lockrt.py ``LockAudit.
+    summary()``) as the ``quintnet_lock_*`` families: per-lock
+    acquisition/contention/wait/hold counters labeled by lock name,
+    plus the order graph's edge count and the violations-observed
+    counter — the scrapeable face of ``lock_audit=True``."""
+    b.add("quintnet_lock_order_edges", summary.get("order_edges", 0),
+          help_="distinct acquired-A-then-B orderings observed")
+    b.add("quintnet_lock_order_violations_total",
+          summary.get("order_violations", 0), mtype="counter",
+          help_="lock-order inversions caught (each also raised a "
+                "LockOrderError and emitted a lock_order_violation "
+                "event)")
+    for name, led in sorted(summary.get("locks", {}).items()):
+        labels = {"lock": name}
+        b.add("quintnet_lock_acquisitions_total",
+              led.get("acquisitions", 0), labels=labels,
+              mtype="counter",
+              help_="times this lock was acquired")
+        b.add("quintnet_lock_contended_total",
+              led.get("contended", 0), labels=labels, mtype="counter",
+              help_="acquisitions that had to block (first try failed)")
+        b.add("quintnet_lock_wait_seconds_total",
+              led.get("wait_s", 0.0), labels=labels, mtype="counter",
+              help_="cumulative time spent blocked acquiring")
+        b.add("quintnet_lock_hold_seconds_total",
+              led.get("hold_s", 0.0), labels=labels, mtype="counter",
+              help_="cumulative time held")
+        b.add("quintnet_lock_max_hold_seconds",
+              led.get("max_hold_s", 0.0), labels=labels,
+              help_="longest single hold observed")
+        b.add("quintnet_lock_held_too_long_total",
+              led.get("held_too_long", 0), labels=labels,
+              mtype="counter",
+              help_="holds that exceeded the audit's hold budget")
+
+
 def render_exposition(frontdoor_summary: Dict,
                       engine_summaries: Optional[Dict[str, Dict]] = None,
                       *, health: Optional[Dict] = None,
                       slo: Optional[Dict] = None,
-                      pressure: Optional[Dict] = None) -> str:
+                      pressure: Optional[Dict] = None,
+                      locks: Optional[Dict] = None) -> str:
     """The front door's ``GET /metrics`` body: fleet counters as
     ``quintnet_fleet_*``, each replica engine's summary as
     ``quintnet_engine_*{replica="<name>"}``, (when ``health`` is
     given) per-replica liveness/heartbeat/breaker gauges plus queue
     depth, (when ``slo`` is given) the ``quintnet_slo_*`` burn-rate
-    families, and (when ``pressure`` is given) the
-    ``quintnet_pool_pressure_*`` signal-bus gauges."""
+    families, (when ``pressure`` is given) the
+    ``quintnet_pool_pressure_*`` signal-bus gauges, and (when
+    ``locks`` is given — a ``LockAudit.summary()`` from a
+    ``lock_audit=True`` fleet) the ``quintnet_lock_*`` families."""
     b = _Builder()
     _add_summary(b, "quintnet_fleet", frontdoor_summary)
     for name, summary in sorted((engine_summaries or {}).items()):
@@ -260,6 +300,8 @@ def render_exposition(frontdoor_summary: Dict,
         _add_slo(b, slo)
     if pressure:
         _add_pressure(b, pressure)
+    if locks:
+        _add_locks(b, locks)
     return b.render()
 
 

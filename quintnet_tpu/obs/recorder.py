@@ -78,12 +78,15 @@ class StepRecorder:
     heartbeat thread drains increments for the wire and stats RPCs
     snapshot the whole ring."""
 
-    def __init__(self, *, capacity: int = 512, clock=time.monotonic):
+    def __init__(self, *, capacity: int = 512, clock=time.monotonic,
+                 lock=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.clock = clock
-        self._lock = threading.Lock()
+        # ``lock=`` accepts an analysis.lockrt.InstrumentedLock so a
+        # lock_audit=True fleet folds this mutex into its order graph
+        self._lock = lock if lock is not None else threading.Lock()
         self._ring: "deque[StepRecord]" = deque(maxlen=self.capacity)
         self._total = 0          # records ever appended
         self._drained = 0        # records shipped via drain_new()

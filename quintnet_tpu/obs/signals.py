@@ -97,13 +97,16 @@ class SignalBus:
     front door renders ``gauges()`` and a crash handler snapshots."""
 
     def __init__(self, *, clock: Callable[[], float] = time.monotonic,
-                 halflife_s: float = 2.0, history: int = 256):
+                 halflife_s: float = 2.0, history: int = 256,
+                 lock=None):
         if history < 1:
             raise ValueError(f"history must be >= 1, got {history}")
         self.clock = clock
         self.halflife_s = float(halflife_s)
         self.history_cap = int(history)
-        self._lock = threading.Lock()
+        # ``lock=`` accepts an analysis.lockrt.InstrumentedLock so a
+        # lock_audit=True fleet folds this mutex into its order graph
+        self._lock = lock if lock is not None else threading.Lock()
         # (name, pool) -> {"ewma": Ewma, "hist": deque[(t, v)],
         #                  "last": float, "t": float, "n": int}
         self._gauges: Dict[Tuple[str, str], Dict] = {}

@@ -86,7 +86,7 @@ class Tracer:
 
     def __init__(self, *, clock=time.monotonic,
                  max_traces: int = 1024,
-                 max_spans_per_trace: int = 512):
+                 max_spans_per_trace: int = 512, lock=None):
         if max_traces < 1 or max_spans_per_trace < 4:
             raise ValueError(
                 f"need max_traces >= 1 and max_spans_per_trace >= 4, "
@@ -94,7 +94,9 @@ class Tracer:
         self.clock = clock
         self.max_traces = int(max_traces)
         self.max_spans_per_trace = int(max_spans_per_trace)
-        self._lock = threading.Lock()
+        # ``lock=`` accepts an analysis.lockrt.InstrumentedLock so a
+        # lock_audit=True fleet folds this mutex into its order graph
+        self._lock = lock if lock is not None else threading.Lock()
         # trace_id -> {"spans": [Span], "dropped": int}; OrderedDict
         # gives LRU-by-first-touch eviction of whole timelines
         self._traces: "OrderedDict[str, Dict]" = OrderedDict()

@@ -197,13 +197,17 @@ class AdapterRegistry:
     soon as pins release."""
 
     def __init__(self, *, byte_budget: Optional[int] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, lock=None):
         if byte_budget is not None and byte_budget <= 0:
             raise ValueError(f"byte_budget must be positive or None; "
                              f"got {byte_budget}")
         self.byte_budget = byte_budget
         self.clock = clock
-        self._lock = threading.RLock()
+        # ``lock=`` accepts an analysis.lockrt re-entrant
+        # InstrumentedLock (audit.rlock) so a lock_audit=True fleet
+        # folds the registry mutex into its order graph; must be
+        # re-entrant — eviction runs under registration's hold
+        self._lock = lock if lock is not None else threading.RLock()
         self._entries: Dict[str, AdapterEntry] = {}
         self.evictions = 0
 

@@ -1,0 +1,902 @@
+"""qtcheck-threads goldens: the static lock-discipline auditor
+(analysis/threads.py), its committed baseline gate, and the
+instrumented-lock runtime (analysis/lockrt.py) it is twinned with.
+
+Four layers, mirroring tests/test_qtcheck.py's structure for the lint
+pass:
+
+- **synthetic rules** — QT201 (lock-order cycles, lexical and
+  interprocedural), QT202 (guarded-by inference on thread-reachable
+  paths), QT203 (spawn census, BOTH directions), and the
+  ``# qtcheck: ok[RULE]`` pragma contract, all over in-memory sources;
+- **repo gate** — the committed tools/qtcheck_threads_baseline.json
+  matches the live tree EXACTLY (new and stale both fail), every entry
+  carries a justifying note, the real lock-order graph is cycle-free,
+  and a seeded inverted acquisition IS caught (then reverted);
+- **runtime** — LockOrderError on the second edge direction naming
+  both stacks, ledgers under an injected clock, the held-too-long
+  watchdog, Condition protocol, and an 8-thread AdmissionQueue stress
+  behind one InstrumentedLock (the queue's real locking contract: the
+  fleet serialises, the queue owns only policy);
+- **fleet** — lock_audit=True is INERT: the kill-migration golden
+  stays token-identical to the oracle (which the lock_audit=False
+  golden in test_fleet.py already pins), zero order violations under
+  real chaos, and the quintnet_lock_* families pass the strict
+  exposition parser. The process-fleet SIGKILL twin is slow-tier.
+"""
+
+import ast
+import json
+import os
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from quintnet_tpu.analysis.lint import (SourceFile, collect_sources,
+                                        compare_baseline, load_baseline,
+                                        violations_to_baseline)
+from quintnet_tpu.analysis.lockrt import (InstrumentedLock, LockAudit,
+                                          LockOrderError)
+from quintnet_tpu.analysis.threads import (THREAD_PATHS, audit_parsed,
+                                           audit_paths, audit_sources,
+                                           load_thread_specs,
+                                           thread_spawn_census)
+from quintnet_tpu.fleet import AdmissionQueue, Overloaded
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "qtcheck_threads_baseline.json")
+LINT_BASELINE = os.path.join(REPO, "tools", "qtcheck_baseline.json")
+
+
+def _src(text):
+    return textwrap.dedent(text).strip() + "\n"
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------
+# QT201: lock-order cycles
+# ---------------------------------------------------------------------
+
+_CYCLE = _src("""
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    pass
+""")
+
+
+class TestQT201:
+    def test_inverted_acquisition_names_both_chains(self):
+        vs = audit_sources([("pkg/mod.py", _CYCLE)], rules=["QT201"])
+        assert len(vs) == 1
+        v = vs[0]
+        assert v.rule == "QT201"
+        # the finding names BOTH locks and BOTH directions' call chains
+        assert "pkg/mod.py:S._a" in v.symbol
+        assert "pkg/mod.py:S._b" in v.symbol
+        assert " <-> " in v.symbol
+        assert v.message.startswith("lock-order cycle (")
+        assert "S.fwd" in v.message and "S.rev" in v.message
+        assert "->" in v.message
+
+    def test_consistent_order_is_clean(self):
+        one_way = _CYCLE.replace("with self._b:\n            with "
+                                 "self._a:\n                pass",
+                                 "pass")
+        vs = audit_sources([("pkg/mod.py", one_way)], rules=["QT201"])
+        assert vs == []
+
+    def test_pragma_suppresses_the_edge(self):
+        # suppressing the b->a edge at its acquisition site breaks the
+        # cycle: pragma honored exactly like the lint rules
+        pragmad = _CYCLE.replace(
+            "with self._b:\n            with self._a:",
+            "with self._b:\n            with self._a:"
+            "  # qtcheck: ok[QT201]")
+        assert pragmad != _CYCLE
+        vs = audit_sources([("pkg/mod.py", pragmad)], rules=["QT201"])
+        assert vs == []
+
+    def test_interprocedural_cycle_via_resolved_call(self):
+        """Holding B while CALLING a method that acquires A is a B->A
+        edge — the bounded call-graph half of the pass."""
+        src = _src("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def fwd(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def rev(self):
+                    with self._b:
+                        self._grab()
+
+                def _grab(self):
+                    with self._a:
+                        pass
+            """)
+        vs = audit_sources([("pkg/mod.py", src)], rules=["QT201"])
+        assert len(vs) == 1
+        assert "_grab" in vs[0].message    # the chain is readable
+
+
+# ---------------------------------------------------------------------
+# QT202: guarded-by inference
+# ---------------------------------------------------------------------
+
+_GUARDED = _src("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def start(self):
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def _loop(self):
+            return self._n
+""")
+
+
+class TestQT202:
+    def test_unguarded_read_on_thread_path_flagged(self):
+        vs = audit_sources([("pkg/mod.py", _GUARDED)], rules=["QT202"])
+        assert len(vs) == 1
+        v = vs[0]
+        assert v.symbol == "C._loop"
+        assert "load of self._n" in v.message
+        assert "pkg/mod.py:C._lock" in v.message
+        assert "thread-reachable" in v.message
+
+    def test_guarded_read_is_clean(self):
+        fixed = _GUARDED.replace(
+            "def _loop(self):\n        return self._n",
+            "def _loop(self):\n        with self._lock:\n"
+            "            return self._n")
+        assert fixed != _GUARDED
+        vs = audit_sources([("pkg/mod.py", fixed)], rules=["QT202"])
+        assert vs == []
+
+    def test_init_is_exempt_both_sides(self):
+        # __init__'s unguarded write of _n classifies nothing and
+        # triggers nothing: construction happens-before every thread
+        vs = audit_sources([("pkg/mod.py", _GUARDED.replace(
+            "def _loop(self):\n        return self._n",
+            "def _loop(self):\n        pass"))], rules=["QT202"])
+        assert vs == []
+
+    def test_pragma_suppresses(self):
+        pragmad = _GUARDED.replace(
+            "return self._n",
+            "return self._n  # qtcheck: ok[QT202]")
+        vs = audit_sources([("pkg/mod.py", pragmad)], rules=["QT202"])
+        assert vs == []
+
+    def test_ambient_held_makes_locked_convention_clean(self):
+        """The repo's ``*_locked`` convention: a method ONLY ever
+        called with the lock held inherits it as ambient — no
+        annotation needed, no false positive."""
+        src = _src("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def start(self):
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._n += 1
+                        self._flush_locked()
+
+                def _flush_locked(self):
+                    return self._n
+            """)
+        vs = audit_sources([("pkg/mod.py", src)], rules=["QT202"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------
+# QT203: thread-spawn census, both directions
+# ---------------------------------------------------------------------
+
+_SPAWNER = _src("""
+    import threading
+
+    class W:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def stop(self):
+            self._t.join()
+
+        def _run(self):
+            pass
+""")
+
+_SPAWN_SPEC = {"pkg/w.py": [{"symbol": "W.start", "target": "self._run",
+                             "daemon": True, "joined": True}]}
+
+
+class TestQT203:
+    def test_census_matches_spec_clean(self):
+        vs = audit_sources([("pkg/w.py", _SPAWNER)], rules=["QT203"],
+                           specs=_SPAWN_SPEC)
+        assert vs == []
+
+    def test_unexpected_spawn_fails(self):
+        vs = audit_sources([("pkg/w.py", _SPAWNER)], rules=["QT203"],
+                           specs={})
+        assert len(vs) == 1
+        assert vs[0].symbol == "W.start[self._run]"
+        assert "unexpected Thread spawn" in vs[0].message
+        assert "THREAD_SPAWN_SPECS" in vs[0].message
+
+    def test_stale_spec_entry_fails(self):
+        specs = {"pkg/w.py": _SPAWN_SPEC["pkg/w.py"] + [
+            {"symbol": "W.start", "target": "self._gone",
+             "daemon": True, "joined": True}]}
+        vs = audit_sources([("pkg/w.py", _SPAWNER)], rules=["QT203"],
+                           specs=specs)
+        assert len(vs) == 1
+        assert vs[0].symbol == "W.start[self._gone]"
+        assert "no longer has it" in vs[0].message
+
+    def test_daemon_flag_mismatch_fails(self):
+        specs = {"pkg/w.py": [dict(_SPAWN_SPEC["pkg/w.py"][0],
+                                   daemon=False)]}
+        vs = audit_sources([("pkg/w.py", _SPAWNER)], rules=["QT203"],
+                           specs=specs)
+        assert len(vs) == 1
+        assert "daemon: spec False, tree True" in vs[0].message
+
+    def test_census_shape(self):
+        parsed = [SourceFile("pkg/w.py", _SPAWNER,
+                             ast.parse(_SPAWNER))]
+        census = thread_spawn_census(parsed)
+        assert census == [{"module": "pkg/w.py", "symbol": "W.start",
+                           "line": census[0]["line"],
+                           "target": "self._run", "daemon": True,
+                           "joined": True, "kind": "Thread"}]
+
+
+# ---------------------------------------------------------------------
+# repo gate: committed baseline == live tree, exactly
+# ---------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_threads_baseline_gate(self):
+        """The no-drift contract, both directions: a NEW violation
+        (fix it or pragma it with a note) and a STALE entry (you fixed
+        one — regenerate with --write-baseline) both fail tier-1."""
+        violations = audit_paths(root=REPO)
+        new, stale = compare_baseline(violations,
+                                      load_baseline(BASELINE))
+        assert not new, f"new concurrency violations: {new}"
+        assert not stale, f"stale baseline entries: {stale}"
+
+    def test_baseline_entries_all_carry_notes(self):
+        """Every grandfathered finding must say WHY it is benign — a
+        baseline without justifications is just a mute button."""
+        baseline = load_baseline(BASELINE)
+        missing = [e for e in baseline["violations"]
+                   if not e.get("note")]
+        assert not missing, missing
+
+    def test_lock_order_graph_is_cycle_free(self):
+        """The acceptance bar for pool actuation: ZERO QT201 findings
+        on the real tree — no baseline rides for deadlocks."""
+        assert audit_paths(root=REPO, rules=["QT201"]) == []
+
+    def test_spawn_census_matches_spec(self):
+        """QT203 clean against the committed THREAD_SPAWN_SPECS — and
+        the spec is non-trivial (the fleet really does spawn)."""
+        assert audit_paths(root=REPO, rules=["QT203"]) == []
+        specs = load_thread_specs()
+        assert sum(len(v) for v in specs.values()) >= 8
+
+    def test_seeded_inversion_is_caught_then_reverted(self):
+        """Seed an inverted acquisition into the live parse set: the
+        gate MUST catch it (this is the whole point of the pass), and
+        the unseeded set must stay clean."""
+        parsed = list(collect_sources(list(THREAD_PATHS), root=REPO))
+        src = _CYCLE
+        seed = SourceFile("quintnet_tpu/fleet/_seeded_demo.py", src,
+                          ast.parse(src))
+        vs = audit_parsed(parsed + [seed], rules=["QT201"])
+        assert any(v.rule == "QT201"
+                   and "_seeded_demo" in v.symbol for v in vs)
+        # reverted: the real tree alone is cycle-free
+        assert audit_parsed(parsed, rules=["QT201"]) == []
+
+
+# ---------------------------------------------------------------------
+# CLI: --select / --json / both-direction failures / timed smoke
+# ---------------------------------------------------------------------
+
+class TestCLI:
+    def test_select_qt2_with_baseline_clean(self):
+        from quintnet_tpu.tools.qtcheck import main
+
+        rc = main(["--select", "QT2", "--threads-baseline", BASELINE,
+                   "--root", REPO])
+        assert rc == 0
+
+    def test_select_single_rule_without_baseline(self, capsys):
+        """--select arms the concurrency pass even with no baseline;
+        QT203 alone is clean on the real tree, so rc 0."""
+        from quintnet_tpu.tools.qtcheck import main
+
+        rc = main(["--select", "QT203", "--root", REPO])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "0 violation(s)" in out
+
+    def test_json_gate_output(self, capsys):
+        from quintnet_tpu.tools.qtcheck import main
+
+        rc = main(["--select", "QT2", "--threads-baseline", BASELINE,
+                   "--root", REPO, "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert payload["new"] == [] and payload["stale"] == []
+        assert payload["total"] >= 1   # the baselined benign findings
+
+    def test_json_listing_output(self, capsys):
+        from quintnet_tpu.tools.qtcheck import main
+
+        rc = main(["--select", "QT203", "--root", REPO, "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0 and payload == []
+
+    def test_new_violation_fails_gate(self, tmp_path, capsys):
+        """Direction 1: tree has findings an (empty) baseline lacks."""
+        from quintnet_tpu.tools.qtcheck import main
+
+        p = tmp_path / "empty.json"
+        p.write_text(json.dumps(violations_to_baseline([])))
+        rc = main(["--select", "QT2", "--threads-baseline", str(p),
+                   "--root", REPO])
+        out = capsys.readouterr().out
+        assert rc == 1 and "NEW" in out
+
+    def test_stale_entry_fails_gate(self, tmp_path, capsys):
+        """Direction 2: baseline carries an entry the tree no longer
+        produces."""
+        from quintnet_tpu.tools.qtcheck import main
+
+        base = load_baseline(BASELINE)
+        base["violations"] = base["violations"] + [
+            {"rule": "QT202", "path": "quintnet_tpu/fleet/fleet.py",
+             "symbol": "ServeFleet.fixed_long_ago", "count": 1}]
+        p = tmp_path / "stale.json"
+        p.write_text(json.dumps(base))
+        rc = main(["--select", "QT2", "--threads-baseline", str(p),
+                   "--root", REPO])
+        out = capsys.readouterr().out
+        assert rc == 1 and "STALE" in out
+
+    def test_full_tree_both_passes_timed_smoke(self):
+        """Both passes over the whole tree share ONE parse
+        (qtcheck.py hoists collect_sources): the combined run is
+        bounded — this is the perf regression tripwire for the CLI."""
+        from quintnet_tpu.tools.qtcheck import main
+
+        t0 = time.monotonic()
+        rc = main(["--baseline", LINT_BASELINE,
+                   "--threads-baseline", BASELINE, "--root", REPO])
+        elapsed = time.monotonic() - t0
+        assert rc == 0
+        assert elapsed < 60.0, f"full-tree qtcheck took {elapsed:.1f}s"
+
+
+# ---------------------------------------------------------------------
+# runtime: LockAudit / InstrumentedLock
+# ---------------------------------------------------------------------
+
+class TestLockRuntime:
+    def test_inversion_raises_typed_with_both_stacks(self):
+        audit = LockAudit()
+        a, b = audit.lock("A"), audit.lock("B")
+        with a:
+            with b:
+                pass
+        seen = []
+        audit.on_violation = seen.append
+        with b:
+            with pytest.raises(LockOrderError) as ei:
+                a.acquire()
+        err = ei.value
+        assert err.first == "A" and err.second == "B"
+        assert err.thread == threading.current_thread().name
+        assert err.forward_stack and err.reverse_stack
+        # the message is the readable deadlock report: both directions
+        assert "earlier A -> B" in str(err)
+        assert "current B -> A" in str(err)
+        # raised BEFORE blocking: B is still cleanly held/releasable,
+        # and the callback saw the same info the exception carries
+        assert seen and seen[0]["first"] == "A"
+        assert seen[0]["second"] == "B"
+        assert seen[0]["forward_stack"] == err.forward_stack
+        s = audit.summary()
+        assert s["order_violations"] == 1
+        assert s["order_edges"] == 1       # only A->B was recorded
+
+    def test_consistent_order_records_edges_silently(self):
+        audit = LockAudit()
+        a, b = audit.lock("A"), audit.lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        s = audit.summary()
+        assert s["order_edges"] == 1 and s["order_violations"] == 0
+        assert s["locks"]["A"]["acquisitions"] == 3
+
+    def test_self_deadlock_on_non_reentrant(self):
+        audit = LockAudit()
+        a = audit.lock("A")
+        with a:
+            with pytest.raises(LockOrderError) as ei:
+                a.acquire()
+        assert "self-deadlock" in ei.value.forward_stack
+
+    def test_rlock_reacquire_is_fine(self):
+        audit = LockAudit()
+        r = audit.rlock("R")
+        with r:
+            with r:
+                pass
+        assert audit.summary()["locks"]["R"]["acquisitions"] == 2
+        assert audit.summary()["order_violations"] == 0
+
+    def test_mint_same_name_returns_same_lock(self):
+        """Replica restarts and re-armed subsystems re-mint by name:
+        same name + same kind is the SAME node (one story per name);
+        a kind mismatch is a hard error."""
+        audit = LockAudit()
+        assert audit.lock("X") is audit.lock("X")
+        with pytest.raises(ValueError, match="already minted"):
+            audit.rlock("X")
+
+    def test_ledgers_with_injected_clock(self):
+        clk = FakeClock()
+        audit = LockAudit(clock=clk, hold_budget_s=1.0)
+        a = audit.lock("A")
+        a.acquire()
+        clk.advance(2.5)
+        a.release()
+        led = audit.summary()["locks"]["A"]
+        assert led["hold_s"] == pytest.approx(2.5)
+        assert led["max_hold_s"] == pytest.approx(2.5)
+        assert led["held_too_long"] == 1   # 2.5s > 1.0s budget
+
+    def test_check_held_watchdog_deterministic(self):
+        clk = FakeClock()
+        audit = LockAudit(clock=clk, hold_budget_s=1.0)
+        a = audit.lock("A")
+        a.acquire()
+        clk.advance(5.0)
+        offenders = audit.check_held()
+        assert len(offenders) == 1
+        assert offenders[0]["lock"] == "A"
+        assert offenders[0]["held_s"] == pytest.approx(5.0)
+        assert offenders[0]["holder"] == threading.current_thread().name
+        a.release()
+        assert audit.check_held() == []
+
+    def test_watchdog_thread_counts_long_holds(self):
+        audit = LockAudit(hold_budget_s=0.005,
+                          watchdog_interval_s=0.005)
+        a = audit.lock("A")
+        a.acquire()
+        deadline = time.monotonic() + 5.0
+        while (audit.summary()["locks"]["A"]["held_too_long"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        a.release()
+        audit.close()
+        assert audit.summary()["locks"]["A"]["held_too_long"] >= 1
+
+    def test_contended_acquire_counted(self):
+        audit = LockAudit()
+        a = audit.lock("A")
+        a.acquire()
+        started = threading.Event()
+
+        def worker():
+            started.set()
+            with a:
+                pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        started.wait(5.0)
+        time.sleep(0.05)       # let the worker hit the blocking path
+        a.release()
+        t.join(5.0)
+        assert not t.is_alive()
+        assert audit.summary()["locks"]["A"]["contended"] >= 1
+
+    def test_condition_wait_releases_audit_entry(self):
+        """Condition over an instrumented RLock: wait() fully releases
+        (a sleeping waiter holds NOTHING in the audit's model) and the
+        notify/wake handshake works — if _release_save didn't release
+        the inner lock, the producer below would deadlock."""
+        audit = LockAudit()
+        cond = audit.condition("C")
+        state = {"flag": False, "done": False}
+
+        def consumer():
+            with cond:
+                while not state["flag"]:
+                    cond.wait(timeout=5.0)
+                state["done"] = True
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            state["flag"] = True
+            cond.notify()
+        t.join(5.0)
+        assert not t.is_alive() and state["done"]
+        lk = cond._lock
+        assert isinstance(lk, InstrumentedLock)
+        assert lk.holder is None           # nothing residually held
+        assert audit.summary()["order_violations"] == 0
+
+
+# ---------------------------------------------------------------------
+# satellite 3: AdmissionQueue under an 8-thread barrier stress
+# ---------------------------------------------------------------------
+
+class _QItem:
+    __slots__ = ("ident", "deadline", "submit_time", "adapter_id")
+
+    def __init__(self, ident, now):
+        self.ident = ident
+        self.deadline = None
+        self.submit_time = now
+        self.adapter_id = None
+
+
+class TestAdmissionStress:
+    def test_eight_thread_barrier_stress_under_one_lock(self):
+        """The queue's REAL concurrency contract, stressed: it is not
+        internally locked — the fleet serialises all access under its
+        condition lock. Eight threads (pushers, a migration re-queuer,
+        a targeted remover, a popper, a pressure observer) hammer it
+        behind ONE InstrumentedLock. Afterwards: no item lost, none
+        duplicated, shed items never entered, the audit saw zero order
+        violations, and the ledger accounts every acquisition."""
+        audit = LockAudit()
+        lock = audit.lock("fleet._cv")
+        q = AdmissionQueue(max_pending=64)
+        barrier = threading.Barrier(8)
+        errors = []
+        pushed_ok, shed = [], []
+        popped, removed = [], []
+        push_lists = [[f"p{w}-{i}" for i in range(150)]
+                      for w in range(3)]
+
+        def run(fn):
+            def wrapped():
+                try:
+                    barrier.wait(timeout=30.0)
+                    fn()
+                except Exception as e:      # pragma: no cover
+                    errors.append(e)
+            return wrapped
+
+        def pusher(idents):
+            def go():
+                for ident in idents:
+                    with lock:
+                        it = _QItem(ident, time.monotonic())
+                        try:
+                            q.push(it)
+                            pushed_ok.append(ident)
+                        except Overloaded as e:
+                            assert e.reason == "queue_full"
+                            shed.append(ident)
+            return go
+
+        def requeuer():
+            # migration path: pop + push_front is ONE atomic re-queue
+            # under the fleet lock; net queue membership is unchanged
+            for _ in range(300):
+                with lock:
+                    it = q.pop()
+                    if it is not None:
+                        q.push_front([it])
+
+        def remover():
+            for _ in range(300):
+                with lock:
+                    items = q.items()
+                    if items:
+                        it = items[len(items) // 2]
+                        q.remove(it)
+                        removed.append(it.ident)
+
+        def popper():
+            for _ in range(400):
+                with lock:
+                    it = q.pop()
+                    if it is not None:
+                        popped.append(it.ident)
+
+        def observer():
+            for _ in range(400):
+                with lock:
+                    depth = len(q)
+                    wait_s = q.oldest_wait_s()
+                    q.peek_adapter_id()
+                    assert depth >= 0 and wait_s >= 0.0
+
+        threads = [threading.Thread(target=run(fn)) for fn in
+                   [pusher(push_lists[0]), pusher(push_lists[1]),
+                    pusher(push_lists[2]), requeuer, remover, popper,
+                    observer,
+                    lambda: None]]          # 8th: pure barrier party
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+            assert not t.is_alive()
+        assert errors == []
+
+        with lock:
+            remaining = [i.ident for i in q.drain_all()]
+            assert len(q) == 0
+
+        # conservation: every accepted item is in EXACTLY one place
+        consumed = sorted(popped + removed + remaining)
+        assert consumed == sorted(pushed_ok)
+        assert len(set(consumed)) == len(consumed)   # no duplication
+        # shed items never entered the queue
+        assert not set(shed) & set(pushed_ok)
+        assert len(pushed_ok) + len(shed) == 450
+        # the instrumented fleet lock observed a clean discipline
+        s = audit.summary()
+        assert s["order_violations"] == 0
+        assert s["locks"]["fleet._cv"]["acquisitions"] >= 450
+
+
+# ---------------------------------------------------------------------
+# fleet: lock_audit=True is inert (token-identical) and observable
+# ---------------------------------------------------------------------
+
+jax = pytest.importorskip("jax")
+
+from quintnet_tpu.fleet import ServeFleet                    # noqa: E402
+from quintnet_tpu.ft import ChaosMonkey                      # noqa: E402
+from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init   # noqa: E402
+from quintnet_tpu.models.gpt2_generate import gpt2_generate  # noqa: E402
+from quintnet_tpu.obs.prom import (parse_exposition,         # noqa: E402
+                                   render_exposition, sample)
+from quintnet_tpu.serve import ServeEngine, gpt2_family      # noqa: E402
+
+CFG = GPT2Config.tiny(n_layer=2)
+TEMP, TOPK = 0.8, 5
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.key(0), CFG)
+
+
+@pytest.fixture
+def factory(params):
+    def make():
+        return ServeEngine(gpt2_family(CFG), params, max_slots=2,
+                           block_size=4, num_blocks=24, max_seq_len=24,
+                           temperature=TEMP, top_k=TOPK)
+
+    return make
+
+
+def _oracle(params, prompt, max_new, key):
+    return np.asarray(gpt2_generate(
+        params, prompt[None], CFG, max_new_tokens=max_new,
+        temperature=TEMP, top_k=TOPK, key=key)[0])
+
+
+def _prompts(rng, lengths):
+    return [np.asarray(rng.integers(0, CFG.vocab_size, (t,)), np.int32)
+            for t in lengths]
+
+
+class TestFleetLockAudit:
+    def test_kill_migration_golden_with_lock_audit(self, factory,
+                                                   params, rng):
+        """THE inertness proof: the kill-migration golden from
+        test_fleet.py rerun with lock_audit=True (+obs). Every request
+        is token-identical to the undisturbed oracle — the same oracle
+        the lock_audit=False golden pins — so the audited path changes
+        no observable byte. And under real chaos (a death, a
+        migration, a restart) the instrumented locks saw ZERO order
+        violations: the discipline the static pass proves on resolvable
+        paths holds dynamically too."""
+        prompts = _prompts(rng, (5, 7, 3, 6, 4, 8, 5, 6, 4))
+        keys = [jax.random.key(500 + i) for i in range(9)]
+        monkey = ChaosMonkey(kill_at_step=3, mode="raise", target="r1")
+        fleet = ServeFleet(factory, n_replicas=3, policy="round_robin",
+                           chaos=monkey, obs=True, lock_audit=True)
+        try:
+            fids = [fleet.submit(p, 8, key=k)
+                    for p, k in zip(prompts, keys)]
+            outs = [fleet.result(f, timeout=300) for f in fids]
+            for p, k, o in zip(prompts, keys, outs):
+                np.testing.assert_array_equal(
+                    o, _oracle(params, p, 8, k))
+
+            m = fleet.metrics
+            assert m.replica_deaths == 1 and m.restarts == 1
+            assert m.migrations >= 1
+            assert m.finished == 9 and m.shed == 0
+
+            s = fleet.lock_audit.summary()
+            assert s["order_violations"] == 0
+            assert s["locks"]["fleet._cv"]["acquisitions"] > 0
+            assert "obs.events" in s["locks"]
+            # zero violations -> zero lock_order_violation events
+            assert fleet.events.snapshot(
+                kind="lock_order_violation") == []
+            # the black box carries the ledgers at death
+            assert fleet.last_crash is not None
+            assert fleet.last_crash["locks"]["order_violations"] == 0
+            assert "fleet._cv" in fleet.last_crash["locks"]["locks"]
+
+            # quintnet_lock_* families pass the STRICT parser
+            text = render_exposition(fleet.metrics.summary(),
+                                     locks=fleet.lock_audit.summary())
+            parsed = parse_exposition(text)
+            assert sample(parsed,
+                          "quintnet_lock_order_violations_total") == 0.0
+            assert sample(parsed, "quintnet_lock_order_edges") >= 0.0
+            assert sample(parsed, "quintnet_lock_acquisitions_total",
+                          lock="fleet._cv") > 0.0
+            assert sample(parsed, "quintnet_lock_contended_total",
+                          lock="fleet._cv") >= 0.0
+            assert sample(parsed, "quintnet_lock_hold_seconds_total",
+                          lock="fleet._cv") >= 0.0
+        finally:
+            fleet.drain(timeout=120)
+
+    def test_violation_wiring_emits_event(self, factory):
+        """The on_violation callback the fleet installs turns an
+        inversion into a typed lock_order_violation event (the same
+        record the crash dump's events section would carry)."""
+        fleet = ServeFleet(factory, n_replicas=1, obs=True,
+                           lock_audit=True)
+        try:
+            fleet.lock_audit.on_violation(
+                {"first": "A", "second": "B", "thread": "t-demo",
+                 "forward_stack": "fwd", "reverse_stack": "rev"})
+            evs = fleet.events.snapshot(kind="lock_order_violation")
+            assert len(evs) == 1
+            assert evs[0]["first"] == "A" and evs[0]["second"] == "B"
+            assert evs[0]["thread"] == "t-demo"
+        finally:
+            fleet.drain(timeout=60)
+
+    def test_off_path_constructs_stock_primitives(self, factory):
+        """lock_audit=False (the default): no LockAudit exists and the
+        fleet's condition is the stock threading.Condition — the
+        off-path really is what it always was."""
+        fleet = ServeFleet(factory, n_replicas=1)
+        try:
+            assert fleet.lock_audit is None
+            assert not isinstance(
+                getattr(fleet._cv, "_lock", None), InstrumentedLock)
+        finally:
+            fleet.drain(timeout=60)
+
+
+# ---------------------------------------------------------------------
+# slow tier: the process-fleet SIGKILL golden, audited
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_golden_with_lock_audit(params, rng):
+    """The cross-process twin: os.kill(SIGKILL) on p1-of-3 mid-stream
+    with the parent's locks instrumented. Token identity to the
+    undisturbed oracle (pinned for the unaudited path by
+    test_fleet_proc.py) plus zero observed order violations across
+    death, journal-replay migration and supervised restart."""
+    import signal as _signal
+
+    from quintnet_tpu.fleet import Backoff, ProcessFleet
+
+    FACTORY_FILE = os.path.join(os.path.dirname(__file__),
+                                "_proc_factories.py")
+    spec = {"file": FACTORY_FILE, "func": "build_tiny_gpt2",
+            "kwargs": {"temperature": TEMP, "top_k": TOPK,
+                       "max_seq_len": 40}}
+    fleet = ProcessFleet(spec, n_replicas=3, policy="round_robin",
+                         platform="cpu", heartbeat_s=0.05,
+                         backoff=Backoff(base_s=0.01, cap_s=0.1),
+                         obs=True, lock_audit=True)
+    try:
+        big = [np.asarray(rng.integers(0, CFG.vocab_size, (t,)),
+                          np.int32) for t in (5, 7, 3, 6, 4, 8, 5, 6, 4)]
+        keys = [jax.random.key(500 + i) for i in range(9)]
+        streamed = []
+        fids = []
+        for i, (p, k) in enumerate(zip(big, keys)):
+            cb = ((lambda fid, tok, last: streamed.append(tok))
+                  if i == 1 else None)     # round_robin: i=1 -> p1
+            fids.append(fleet.submit(p, 24, key=k, on_token=cb))
+        victim = fleet.replica("p1")
+        t0 = time.monotonic()
+        while len(streamed) < 3:
+            if time.monotonic() - t0 > 120:
+                raise AssertionError("victim never started streaming")
+            time.sleep(0.01)
+        os.kill(victim.pid, _signal.SIGKILL)
+
+        outs = [fleet.result(f, timeout=300) for f in fids]
+        for p, k, o in zip(big, keys, outs):
+            np.testing.assert_array_equal(
+                o, np.asarray(gpt2_generate(
+                    params, p[None], CFG, max_new_tokens=24,
+                    temperature=TEMP, top_k=TOPK, key=k)[0]))
+
+        assert fleet.metrics.replica_deaths == 1
+        assert fleet.metrics.migrations >= 1
+        assert fleet.metrics.finished == 9 and fleet.metrics.shed == 0
+
+        s = fleet.lock_audit.summary()
+        assert s["order_violations"] == 0
+        assert s["locks"]["fleet._cv"]["acquisitions"] > 0
+        # the victim's per-replica locks joined the same graph
+        assert any(name.startswith("proc.p1.") for name in s["locks"])
+        assert fleet.events.snapshot(kind="lock_order_violation") == []
+        assert fleet.last_crash["locks"]["order_violations"] == 0
+    finally:
+        fleet.drain(timeout=180)
