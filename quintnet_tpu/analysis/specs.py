@@ -311,17 +311,36 @@ def expected_serve_sp_prefill(n_layers: int, sp: int, *,
 def kv_layout_policies() -> Tuple[str, ...]:
     """THE canonical KV-pool layout-policy ladder (serve/kv_quant.py):
     ``f32``/``bf16`` passthrough, ``int8`` with per-block-per-head
-    absmax scales, and the ``fake_quant`` identity-scale proof policy.
-    Pinned here for the same reason the bucket ladders are: the policy
-    must NOT change the compiled-program census. Per policy the engine
-    compiles exactly the same sentinel set — ``len(prefill_buckets)``
-    prefill programs, 1 decode (or one per LoRA rank bucket), and
-    ``len(verify_buckets)`` verify programs — because a scaled policy
-    only widens the pool operand list (k, v -> k, v, k_scale, v_scale)
-    inside the SAME programs; it never adds a program, a collective,
-    or a recompile (tests/test_kv_quant.py pins the compile counts,
+    absmax scales, ``fp8`` unscaled float8_e4m3fn passthrough (scales
+    are OPTIONAL in the shared LayoutPolicy protocol — the read path
+    is one upcast in the gathered view), and the ``fake_quant``
+    identity-scale proof policy. Pinned here for the same reason the
+    bucket ladders are: the policy must NOT change the
+    compiled-program census. Per policy the engine compiles exactly
+    the same sentinel set — ``len(prefill_buckets)`` prefill programs,
+    1 decode (or one per LoRA rank bucket), and ``len(verify_buckets)``
+    verify programs — because a scaled policy only widens the pool
+    operand list (k, v -> k, v, k_scale, v_scale) inside the SAME
+    programs; it never adds a program, a collective, or a recompile
+    (tests/test_kv_quant.py pins the compile counts,
     tests/test_qtcheck.py the collective + dtype censuses)."""
-    return ("f32", "bf16", "int8", "fake_quant")
+    return ("f32", "bf16", "int8", "fp8", "fake_quant")
+
+
+def weight_layout_policies() -> Tuple[str, ...]:
+    """THE canonical weight layout-policy ladder
+    (serve/weight_quant.py): ``f32`` identity (the param tree passes
+    through untouched), ``bf16`` passthrough narrowing, ``int8``/
+    ``fp8`` with per-output-channel absmax scales, and the
+    ``fake_quant`` identity-scale proof policy (bit-identical to f32).
+    Pinned for the zero-new-programs promise: the policy is baked into
+    the param tree at engine BUILD (packed ``w`` + ``w_scale`` leaves,
+    nn/layers.quantized_matmul dequants inside the existing dots), so
+    per policy the engine compiles exactly the same sentinel set, with
+    the same collective census — the per-channel scale multiply is
+    rank-local elementwise math (tests/test_weight_quant.py pins the
+    zero-backend-compile trace, tests/test_qtcheck.py the censuses)."""
+    return ("f32", "bf16", "int8", "fp8", "fake_quant")
 
 
 def attn_kernels() -> Tuple[str, ...]:

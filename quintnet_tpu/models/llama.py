@@ -35,8 +35,9 @@ from quintnet_tpu.core.pytree import tree_stack
 from quintnet_tpu.nn.attention import (apply_rope, repeat_kv, rope_cos_sin,
                                        sdpa)
 from quintnet_tpu.nn.layers import (cast_floating, linear_init,
-                                    rms_norm_apply, rms_norm_init,
-                                    swiglu_apply, swiglu_init)
+                                    quantized_matmul, rms_norm_apply,
+                                    rms_norm_init, swiglu_apply,
+                                    swiglu_init)
 from quintnet_tpu.nn.moe import moe_apply, moe_init, moe_specs
 from quintnet_tpu.nn.transformer import stacked_blocks_apply
 
@@ -328,7 +329,7 @@ def llama_qkv(p_attn, a_in, cfg: LlamaConfig, cos, sin, *, tp: int = 1,
     hd = cfg.head_dim
 
     def heads(name, n):
-        y = jnp.dot(a_in, p_attn[name]["w"])
+        y = quantized_matmul(a_in, p_attn[name])
         if lora is not None and name in lora:
             from quintnet_tpu.nn.layers import lora_delta
 
@@ -347,7 +348,7 @@ def llama_attn_residual(p_attn, x, o, *, tp_axis: Optional[str] = None,
     (row-parallel partial sums compose — nn/layers.lora_delta)."""
     b = o.shape[0]
     o = o.transpose(0, 2, 1, 3).reshape(b, o.shape[2], -1)
-    y = jnp.dot(o, p_attn["o"]["w"])
+    y = quantized_matmul(o, p_attn["o"])
     if lora is not None and "o" in lora:
         from quintnet_tpu.nn.layers import lora_delta
 

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from einops import rearrange
 
-from quintnet_tpu.nn.layers import linear_init, linear_apply, lora_delta
+from quintnet_tpu.nn.layers import (linear_init, linear_apply, lora_delta,
+                                    quantized_matmul)
 
 
 def mha_init(key, dim: int, *, qkv_bias: bool = True, dtype=jnp.float32):
@@ -187,7 +188,7 @@ def mha_apply(
                  segment_ids=segment_ids)
 
     o = rearrange(o, "b h s d -> b s (h d)")
-    y = jnp.dot(o, p["proj"]["w"])
+    y = quantized_matmul(o, p["proj"])
     if tp_axis is not None:
         # RowParallel all-reduce (reference: layers.py:216 -> All_Reduce)
         y = lax.psum(y, tp_axis)
@@ -256,6 +257,12 @@ def paged_gather_dequant(policy, cache, scales, block_tables, *,
     :func:`paged_gather`."""
     view = paged_gather(cache, block_tables, block_size=block_size)
     if scales is None:
+        # float8 pools (unscaled fp8 policy) upcast HERE — float8 has no
+        # implicit-promotion path in jax, so the view must be widened
+        # before the softmax math. f32/bf16 views pass through
+        # untouched (bit-identical to the pre-policy read).
+        if str(view.dtype).startswith("float8"):
+            return view.astype(jnp.float32)
         return view
     return policy.dequant(
         view, paged_gather_scales(scales, block_tables,
@@ -536,7 +543,7 @@ def mha_prefill_paged(p, x, k_cache, v_cache, positions, tail_len, *,
         o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
 
     o = rearrange(o, "b h s d -> b s (h d)")
-    y = jnp.dot(o, p["proj"]["w"])
+    y = quantized_matmul(o, p["proj"])
     if lora is not None and "proj" in lora:
         y = y + lora_delta(o, lora["proj"], lora_scale)
     if tp_axis is not None:
@@ -720,7 +727,7 @@ def mha_prefill_paged_sp(p, x, k_cache, v_cache, start, t0, *,
         kv_scales=kv_scales, policy=policy)
     o, pools = out[0], out[1:]
     o = rearrange(o, "b h s d -> b s (h d)")
-    y = jnp.dot(o, p["proj"]["w"])
+    y = quantized_matmul(o, p["proj"])
     if tp_axis is not None:
         y = lax.psum(y, tp_axis)
     if "b" in p["proj"]:
@@ -841,7 +848,7 @@ def mha_verify_paged(p, x, k_cache, v_cache, positions, tail_lens, *,
         o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
 
     o = rearrange(o, "b h s d -> b s (h d)")
-    y = jnp.dot(o, p["proj"]["w"])
+    y = quantized_matmul(o, p["proj"])
     if lora is not None and "proj" in lora:
         y = y + lora_delta(o, lora["proj"], lora_scale)
     if tp_axis is not None:
@@ -971,7 +978,7 @@ def mha_decode(p, x, k_cache, v_cache, pos, *, num_heads: int,
         o = jnp.einsum("bhst,bhtd->bhsd", probs, v_all)
 
     o = rearrange(o, "b h s d -> b s (h d)")
-    y = jnp.dot(o, p["proj"]["w"])
+    y = quantized_matmul(o, p["proj"])
     if lora is not None and "proj" in lora:
         y = y + lora_delta(o, lora["proj"], lora_scale)
     if tp_axis is not None:

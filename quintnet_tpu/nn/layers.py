@@ -73,8 +73,40 @@ def linear_init(key, in_features: int, out_features: int, *,
     return p
 
 
+def _is_packed(dtype) -> bool:
+    """True for quantized weight storage dtypes (int8 / float8) that
+    must be upcast EXPLICITLY before the dot — float8 has no implicit
+    promotion path in jax, and an integer dot is not what weight-only
+    quantization means."""
+    return (jnp.dtype(dtype) == jnp.dtype(jnp.int8)
+            or str(jnp.dtype(dtype)).startswith("float8"))
+
+
+def quantized_matmul(x, node, *, precision=None):
+    """``x @ dequant(node)`` — THE weight-only-quantization seam every
+    serving matmul routes through (serve/weight_quant.py).
+
+    ``node`` is a linear param node ``{"w": [.., in, out]}`` that MAY
+    carry a packed weight (int8/fp8 storage) and a per-output-channel
+    ``"w_scale"`` [.., out] f32 leaf. The per-channel scale commutes
+    out of the contraction, so dequant is one multiply on the OUTPUT —
+    ``(x @ w_q) * scale`` — and the wide weight is never materialized.
+    Without ``w_scale`` and without a packed dtype this IS
+    ``jnp.dot(x, node["w"])``, byte-identical to the pre-policy
+    programs; with the fake_quant policy (f32 storage, all-ones scale)
+    the result is BIT-identical (``y * 1.0``). Bias and LoRA deltas are
+    the caller's job — both stay full-precision on top."""
+    w = node["w"]
+    if w.dtype != x.dtype and _is_packed(w.dtype):
+        w = w.astype(x.dtype)
+    y = jnp.dot(x, w, precision=precision)
+    if "w_scale" in node:
+        y = y * node["w_scale"]
+    return y
+
+
 def linear_apply(p, x, *, precision=None):
-    y = jnp.dot(x, p["w"], precision=precision)
+    y = quantized_matmul(x, p, precision=precision)
     if "b" in p:
         y = y + p["b"]
     return y
@@ -154,14 +186,14 @@ def swiglu_apply(p, x, *, tp_axis: Optional[str] = None, lora=None,
     multi-LoRA path (:func:`lora_delta`) — each present target
     (gate/up/down) adds its low-rank delta on that matmul, before the
     activation/psum, exactly where a merged weight would land."""
-    g = jnp.dot(x, p["gate"]["w"])
-    u = jnp.dot(x, p["up"]["w"])
+    g = quantized_matmul(x, p["gate"])
+    u = quantized_matmul(x, p["up"])
     if lora is not None and "gate" in lora:
         g = g + lora_delta(x, lora["gate"], lora_scale)
     if lora is not None and "up" in lora:
         u = u + lora_delta(x, lora["up"], lora_scale)
     h = jax.nn.silu(g) * u
-    y = jnp.dot(h, p["down"]["w"])
+    y = quantized_matmul(h, p["down"])
     if lora is not None and "down" in lora:
         y = y + lora_delta(h, lora["down"], lora_scale)
     if tp_axis is not None:
@@ -237,7 +269,7 @@ def mlp_apply(p, x, *, act=gelu, tp_axis: Optional[str] = None,
     if lora is not None and "fc" in lora:
         h = h + lora_delta(x, lora["fc"], lora_scale)
     h = act(h)
-    y = jnp.dot(h, p["proj"]["w"])
+    y = quantized_matmul(h, p["proj"])
     if lora is not None and "proj" in lora:
         y = y + lora_delta(h, lora["proj"], lora_scale)
     if tp_axis is not None:

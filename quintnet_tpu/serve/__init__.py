@@ -15,12 +15,20 @@ scheduling; vLLM-style paged KV blocks):
   retention of refcount-zero blocks and copy-on-write sharing, so
   requests with a common prompt prefix (and preemption-resumes /
   migrations) reuse resident KV instead of recomputing it;
-- :mod:`kv_quant` — KV-pool LAYOUT POLICIES: f32/bf16 passthrough,
+- :mod:`kv_quant` — KV-pool LAYOUT POLICIES: f32/bf16/fp8 passthrough,
   int8 blocks with per-block-per-head absmax scales (dequantized
   inside the gathered-view attention kernels, quantized on scatter —
   the same pool bytes hold ~4x the blocks), and the fake-quant
   identity policy whose engine is bit-identical to f32 (the proof the
-  scaled code path is numerically inert);
+  scaled code path is numerically inert); also home of the shared
+  :class:`~quintnet_tpu.serve.kv_quant.LayoutPolicy` protocol;
+- :mod:`weight_quant` — WEIGHT layout policies on the same protocol:
+  int8/fp8 per-output-channel absmax weights packed once at engine
+  build and dequantized INSIDE the serving matmuls
+  (nn/layers.quantized_matmul — one per-column multiply, the wide
+  weight never materialized), f32/bf16 passthrough, and the same
+  fake-quant bit-identity proof; the LoRA delta path stays
+  full-precision on top;
 - :mod:`scheduler` — waiting queue, admission by UNCACHED-block budget,
   FCFS + optional priority, preemption-by-eviction of the youngest
   request when the pool is exhausted;
@@ -55,7 +63,10 @@ from quintnet_tpu.serve.api import generate, generate_stream
 from quintnet_tpu.serve.engine import (ServeEngine, check_admissible)
 from quintnet_tpu.serve.families import gpt2_family, llama_family
 from quintnet_tpu.serve.kv_pool import AdmitPlan, KVPool
-from quintnet_tpu.serve.kv_quant import KVLayoutPolicy, make_policy
+from quintnet_tpu.serve.kv_quant import (KVLayoutPolicy, LayoutPolicy,
+                                         make_policy)
+from quintnet_tpu.serve.weight_quant import (WeightLayoutPolicy,
+                                             make_weight_policy)
 from quintnet_tpu.serve.longctx import ChunkState, plan_chunks
 from quintnet_tpu.serve.metrics import ServeMetrics, aggregate
 from quintnet_tpu.serve.scheduler import (DeadlineExceeded, Request,
@@ -70,6 +81,7 @@ __all__ = [
     "DeadlineExceeded",
     "KVLayoutPolicy",
     "KVPool",
+    "LayoutPolicy",
     "NgramDrafter",
     "Request",
     "RequestProgress",
@@ -77,6 +89,7 @@ __all__ = [
     "ServeEngine",
     "ServeMetrics",
     "SpecConfig",
+    "WeightLayoutPolicy",
     "aggregate",
     "check_admissible",
     "generate",
@@ -84,5 +97,6 @@ __all__ = [
     "gpt2_family",
     "llama_family",
     "make_policy",
+    "make_weight_policy",
     "plan_chunks",
 ]

@@ -115,6 +115,11 @@ from quintnet_tpu.serve.families import Family
 from quintnet_tpu.serve.kv_pool import KVPool
 from quintnet_tpu.serve.kv_quant import make_policy
 from quintnet_tpu.serve.kv_tier import HostTier, PromotionState
+from quintnet_tpu.serve.weight_quant import (augment_weight_specs,
+                                             make_weight_policy,
+                                             present_targets,
+                                             quantize_params,
+                                             weight_bytes)
 from quintnet_tpu.serve.metrics import ServeMetrics
 from quintnet_tpu.serve.scheduler import (FINISHED, PROMOTING, WAITING,
                                           DeadlineExceeded, Request,
@@ -200,6 +205,7 @@ class ServeEngine:
                  chunked_prefill: bool = False,
                  prefill_chunk_budget: Optional[int] = None,
                  kv_dtype=None,
+                 weights_dtype=None,
                  kv_tier_bytes: int = 0,
                  kv_tier_promote_budget_bytes: Optional[int] = None,
                  attn_kernel: str = "xla",
@@ -496,14 +502,42 @@ class ServeEngine:
                 f"prefill_chunk_budget must be >= 1; got "
                 f"{self.prefill_chunk_budget}")
 
+        # Weight layout policy (serve/weight_quant.py): the targeted
+        # block matmuls' weights are packed ONCE here, host-side —
+        # deliberately AFTER adapter setup (the LoRA pack dtypes above
+        # read the full-precision tree; the delta path stays
+        # full-precision ON TOP of the packed base) and before any
+        # program is built, so the policy is baked into the param tree
+        # ahead of the first trace: same program ladder, same compile
+        # counts per policy (analysis/specs.weight_layout_policies).
+        self.weight_policy = make_weight_policy(weights_dtype)
+        self.weights_dtype = self.weight_policy.name
+        self._weight_targets = present_targets(params,
+                                               family.weight_targets)
+        if self.weight_policy.name != "f32" and not self._weight_targets:
+            raise ValueError(
+                f"family {family.name!r} has no weight targets in this "
+                f"param tree; weights_dtype={self.weights_dtype!r} "
+                f"would be a silent no-op")
+        self.params = quantize_params(params, self._weight_targets,
+                                      self.weight_policy)
+        self.weight_bytes = weight_bytes(self.params,
+                                         self._weight_targets)
+
         # KV layout policy (serve/kv_quant.py): kv_dtype is "f32" /
-        # "bf16" / "int8" / "fake_quant", a raw dtype (the pre-policy
-        # surface), or a KVLayoutPolicy. Scaled policies add the
-        # per-block-per-head scale arrays to the pool state — the SAME
-        # program ladder compiles either way (compile counts per
+        # "bf16" / "int8" / "fp8" / "fake_quant", a raw dtype (the
+        # pre-policy surface), or a KVLayoutPolicy. Scaled policies add
+        # the per-block-per-head scale arrays to the pool state — the
+        # SAME program ladder compiles either way (compile counts per
         # policy are pinned unchanged, analysis/specs.py).
         self.kv_policy = make_policy(
             kv_dtype if kv_dtype is not None else family.kv_dtype)
+        if self.attn_kernel == "pallas" and self.kv_policy.name == "fp8":
+            raise NotImplementedError(
+                "attn_kernel='pallas' does not yet support the fp8 KV "
+                "policy (the fused kernel dequantizes int8 on load; "
+                "float8 tiles are a future extension) — use "
+                "attn_kernel='xla' or kv_dtype='int8'")
         sharding = scale_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -851,6 +885,11 @@ class ServeEngine:
         if self.kv_policy.scaled:
             pool_specs = pool_specs + (P(None, None, self.tp_axis),) * 2
         pspecs = self.family.partition_specs(self.tp_axis, self.ep_axis)
+        if self.weight_policy.scaled:
+            # scaled weight policies add a w_scale leaf per target; its
+            # spec shards exactly like the out dim of its weight
+            # (serve/weight_quant.py) — zero new collectives
+            pspecs = augment_weight_specs(pspecs, self._weight_targets)
         # MoE families widen every program's return by one trailing
         # routing-stats dict, computed from the replicated router masks
         # — identical on every rank, so a single replicated prefix spec
@@ -2031,6 +2070,8 @@ class ServeEngine:
             kv_blocks_total=self.pool.usable_blocks,
             kv_pool_bytes=self.pool.pool_bytes,
             kv_bytes_per_token=self.pool.bytes_per_token,
+            weight_bytes=self.weight_bytes,
+            weights_dtype=self.weights_dtype,
             prefill_tokens=prefill_tokens,
             decode_tokens=decode_tokens,
             prefix_hit_tokens=prefix_hit_tokens,

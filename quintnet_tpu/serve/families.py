@@ -115,6 +115,11 @@ class Family:
     # default LoRA target names for this family's blocks (engine's
     # lora_targets default — models/lora.py ladder names)
     lora_targets: Tuple[str, ...] = ()
+    # paths (relative to one block node) of the linear nodes a weight
+    # layout policy packs (serve/weight_quant.py): the decode-bandwidth
+    # matmuls. Embeddings, head, LNs and MoE experts stay
+    # full-precision.
+    weight_targets: Tuple[Tuple[str, ...], ...] = ()
     # host-side layout hook: (path, b_factor [L, r, out], tp) -> the
     # factor permuted into the layout the SERVING weights use under tp.
     # GPT-2's fused qkv stores tp-BLOCKED columns (gpt2_to_tp_layout);
@@ -325,6 +330,8 @@ def gpt2_family(cfg) -> Family:
         partition_specs=lambda tp_axis, ep_axis=None: gpt2_partition_specs(
             cfg, tp_axis=tp_axis, ep_axis=ep_axis),
         lora_targets=DEFAULT_TARGETS, lora_layout=lora_layout,
+        weight_targets=(("attn", "qkv"), ("attn", "proj"),
+                        ("mlp", "fc"), ("mlp", "proj")),
     )
 
 
@@ -466,4 +473,7 @@ def llama_family(cfg) -> Family:
         partition_specs=lambda tp_axis, ep_axis=None: llama_partition_specs(
             cfg, tp_axis=tp_axis, ep_axis=ep_axis),
         lora_targets=LLAMA_TARGETS,
+        weight_targets=(("attn", "q"), ("attn", "k"), ("attn", "v"),
+                        ("attn", "o"), ("mlp", "gate"), ("mlp", "up"),
+                        ("mlp", "down")),
     )

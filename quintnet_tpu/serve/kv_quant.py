@@ -23,6 +23,12 @@ without forking any kernel:
   blocks and a published chain's bytes never change underneath a
   reader. Under tp the scales shard on the head dim exactly like the
   pool.
+- ``fp8`` — UNSCALED narrow-float storage (``float8_e4m3fn``, same
+  1 byte/slot as int8 with NO scale arrays): writes narrow through
+  the existing ``astype(cache.dtype)`` scatter, reads upcast once in
+  the gathered view (``dequant(q, None)``). e4m3's ~2 mantissa-bit
+  dynamic range absorbs KV outliers without per-block bookkeeping —
+  the cheapest rung between bf16 and int8 on the quality ladder.
 - ``fake_quant`` — the PROOF policy: f32 storage, the scale arrays
   exist and are all-ones, and every kernel runs the full scaled code
   path (gather -> dequantize -> insert -> requantize -> scatter) with
@@ -57,15 +63,20 @@ import numpy as np
 
 
 @dataclass(frozen=True)
-class KVLayoutPolicy:
-    """How paged KV blocks are laid out on device.
+class LayoutPolicy:
+    """The shared quantize/dequant/scale-layout contract for paged KV
+    blocks AND packed weights (serve/weight_quant.py subclasses this).
 
     ``scaled`` selects the code path: False = the original passthrough
-    scatter/gather (no scale arrays exist), True = per-block-per-head
-    scale arrays ride beside the pools and every paged kernel runs
+    scatter/gather (no scale arrays exist), True = absmax scale arrays
+    ride beside the stored data and every consumer runs
     gather->dequant / requant->scatter. ``qmax`` = 0 marks the
     identity (fake-quant) policy: no rounding, no clipping, scales
-    pinned at 1.0 — the bit-exactness proof of the scaled path."""
+    pinned at 1.0 — the bit-exactness proof of the scaled path.
+    Scales are OPTIONAL at dequant time: ``dequant(q, None)`` is the
+    plain f32 upcast, which is what lets an UNSCALED narrow-float
+    layout (fp8) share the contract — future formats (int4 groups, MX)
+    are policy objects, not kernel forks."""
 
     name: str
     store_dtype: Any
@@ -74,10 +85,11 @@ class KVLayoutPolicy:
 
     # ---- quant math (traced inside the serving programs) ------------
     def compute_scale(self, x, axes: Tuple[int, ...]):
-        """Absmax scale of one block per kv head: reduce ``axes`` (the
-        slot and head-feature dims) of f32 ``x``. Identity policy:
-        exactly 1.0 everywhere. The floor keeps an all-zero (never
-        written) block's scale finite — its dequant is exactly 0.0."""
+        """Absmax scale of one quantization group: reduce ``axes`` (the
+        slot and head-feature dims of a KV block; the in-features dim
+        of a weight) of f32 ``x``. Identity policy: exactly 1.0
+        everywhere. The floor keeps an all-zero group's scale finite —
+        its dequant is exactly 0.0."""
         if self.qmax == 0.0:
             return jnp.ones(
                 tuple(d for i, d in enumerate(x.shape) if i not in
@@ -85,17 +97,31 @@ class KVLayoutPolicy:
         amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axes)
         return jnp.maximum(amax / self.qmax, 1e-8)
 
-    def quant(self, x, scale):
-        """f32 block -> stored block. ``scale`` broadcastable to x."""
-        if self.qmax == 0.0:
+    def quant(self, x, scale=None):
+        """f32 data -> stored data. ``scale`` broadcastable to x;
+        None (unscaled policies) is the plain narrowing cast. Integer
+        storage rounds to the grid; float storage (scaled fp8 weights)
+        keeps the fraction — the narrowing cast IS the rounding."""
+        if scale is None or self.qmax == 0.0:
             return x.astype(self.store_dtype)
-        q = jnp.round(x.astype(jnp.float32) / scale)
+        q = x.astype(jnp.float32) / scale
+        if jnp.issubdtype(jnp.dtype(self.store_dtype), jnp.integer):
+            q = jnp.round(q)
         return jnp.clip(q, -self.qmax, self.qmax).astype(self.store_dtype)
 
-    def dequant(self, q, scale):
-        """Stored block -> f32. With the identity policy this is
-        ``x * 1.0`` — bit-exact for every finite f32."""
+    def dequant(self, q, scale=None):
+        """Stored data -> f32. With the identity policy this is
+        ``x * 1.0`` — bit-exact for every finite f32. ``scale=None``
+        (unscaled policies, e.g. fp8) is the plain upcast."""
+        if scale is None:
+            return q.astype(jnp.float32)
         return q.astype(jnp.float32) * scale
+
+
+@dataclass(frozen=True)
+class KVLayoutPolicy(LayoutPolicy):
+    """How paged KV blocks are laid out on device (the KV face of
+    :class:`LayoutPolicy`, plus the pool capacity equation)."""
 
     # ---- capacity math (host-side) -----------------------------------
     def bytes_per_block(self, *, n_layers: int, n_kv_heads: int,
@@ -110,10 +136,16 @@ class KVLayoutPolicy:
         return data + scale
 
 
+# float8_e4m3fn where the backend ships it (ml_dtypes); the ladder
+# entry exists either way so the pinned policy list stays static —
+# make_policy raises a clear error if the dtype is actually missing.
+FLOAT8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
 _POLICIES = {
     "f32": KVLayoutPolicy("f32", jnp.float32, scaled=False),
     "bf16": KVLayoutPolicy("bf16", jnp.bfloat16, scaled=False),
     "int8": KVLayoutPolicy("int8", jnp.int8, scaled=True, qmax=127.0),
+    "fp8": KVLayoutPolicy("fp8", FLOAT8_DTYPE, scaled=False),
     "fake_quant": KVLayoutPolicy("fake_quant", jnp.float32, scaled=True,
                                  qmax=0.0),
 }
@@ -139,12 +171,19 @@ def make_policy(kv_dtype) -> KVLayoutPolicy:
             raise ValueError(
                 f"unknown kv_dtype {kv_dtype!r}; expected one of "
                 f"{policy_names()}")
-        return _POLICIES[kv_dtype]
+        pol = _POLICIES[kv_dtype]
+        if pol.store_dtype is None:
+            raise ValueError(
+                f"kv_dtype {kv_dtype!r} needs jnp.float8_e4m3fn, which "
+                "this jax build does not provide")
+        return pol
     dt = jnp.dtype(kv_dtype)
     if dt == jnp.dtype(jnp.float32):
         return _POLICIES["f32"]
     if dt == jnp.dtype(jnp.bfloat16):
         return _POLICIES["bf16"]
+    if FLOAT8_DTYPE is not None and dt == jnp.dtype(FLOAT8_DTYPE):
+        return _POLICIES["fp8"]
     raise ValueError(
         f"no passthrough policy for dtype {dt}; use one of "
         f"{policy_names()}")

@@ -171,6 +171,13 @@ class ServeMetrics:
     # (an int8 pool shows ~4x the blocks at the same kv_pool_bytes)
     kv_pool_bytes: int = 0
     kv_bytes_per_token: float = 0.0
+    # weight layout gauges (serve/weight_quant.py): device bytes of the
+    # packed weight targets (w + w_scale) and the policy name — the
+    # f32/int8 weight_bytes ratio is the decode-bandwidth win the A/B
+    # gate ratios (>= 3.5x for int8). Mirrored each step like
+    # kv_pool_bytes; the engine owns the truth.
+    weight_bytes: int = 0
+    weights_dtype: str = "f32"
 
     # monotone counters ----------------------------------------------
     steps: int = 0
@@ -276,6 +283,8 @@ class ServeMetrics:
                     prefill_chunks: int = 0,
                     kv_pool_bytes: int = 0,
                     kv_bytes_per_token: float = 0.0,
+                    weight_bytes: int = 0,
+                    weights_dtype: str = "f32",
                     kv_cache_evictions: int = 0,
                     kv_demotions: int = 0,
                     kv_promotions: int = 0,
@@ -298,6 +307,8 @@ class ServeMetrics:
         self.kv_blocks_total = kv_blocks_total
         self.kv_pool_bytes = kv_pool_bytes
         self.kv_bytes_per_token = kv_bytes_per_token
+        self.weight_bytes = weight_bytes
+        self.weights_dtype = weights_dtype
         self.prefill_tokens += prefill_tokens
         self.decode_tokens += decode_tokens
         self.prefix_hit_tokens += prefix_hit_tokens
@@ -511,6 +522,8 @@ class ServeMetrics:
             "peak_kv_utilization": round(self.peak_kv_utilization, 4),
             "kv_pool_bytes": self.kv_pool_bytes,
             "kv_bytes_per_token": round(self.kv_bytes_per_token, 4),
+            "weight_bytes": self.weight_bytes,
+            "weights_dtype": self.weights_dtype,
             "peak_running": self.peak_running,
             "adapters": {
                 aid: {"requests": d["requests"],
@@ -651,6 +664,13 @@ def aggregate(all_metrics: List["ServeMetrics"]) -> Dict:
         "kv_bytes_per_token": round(
             max((m.kv_bytes_per_token for m in all_metrics), default=0.0),
             4),
+        # fleet weight residency is the SUM of the replicas' packed
+        # trees; the dtype roll-up names every policy in play so a
+        # mixed-layout fleet is legible at a glance
+        "weight_bytes": sum(m.weight_bytes for m in all_metrics),
+        "weights_dtype": ",".join(sorted(
+            {m.weights_dtype for m in all_metrics if m.weights_dtype}))
+        or "f32",
         "peak_running": max((m.peak_running for m in all_metrics),
                             default=0),
         "adapters": {

@@ -21,7 +21,8 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                     n_positions=None, prefill_len=None,
                     chunked_prefill: bool = False,
                     prefill_chunk_budget=None,
-                    kv_dtype=None, prefix_cache: bool = True,
+                    kv_dtype=None, weights_dtype=None,
+                    prefix_cache: bool = True,
                     attn_kernel: str = "xla",
                     kv_tier_bytes: int = 0,
                     n_experts: int = 0, expert_top_k: int = 2,
@@ -43,7 +44,8 @@ def build_tiny_gpt2(*, seed: int = 0, n_layer: int = 2, max_slots: int = 2,
                        max_seq_len=max_seq_len, prefill_len=prefill_len,
                        chunked_prefill=chunked_prefill,
                        prefill_chunk_budget=prefill_chunk_budget,
-                       kv_dtype=kv_dtype, prefix_cache=prefix_cache,
+                       kv_dtype=kv_dtype, weights_dtype=weights_dtype,
+                       prefix_cache=prefix_cache,
                        attn_kernel=attn_kernel, temperature=temperature,
                        top_k=top_k, eos_token_id=eos_token_id,
                        kv_tier_bytes=kv_tier_bytes)

@@ -141,6 +141,31 @@ def test_two_process_spawn_smoke(params, rng):
     assert ei.value.reason == "shutdown"
 
 
+def test_process_replica_serves_quantized_weights(params, rng):
+    """A replica built with ``weights_dtype`` rides the same spawn
+    path (serve/weight_quant.py through tests/_proc_factories.py):
+    ``fake_quant`` weights are bit-identical to the dense oracle
+    ACROSS the socket, and each child's stats frame surfaces the
+    weight-bytes accounting."""
+    fleet = ProcessFleet(_spec(weights_dtype="fake_quant"),
+                         n_replicas=2, policy="round_robin",
+                         platform="cpu")
+    try:
+        prompts = _prompts(rng, (5, 4))
+        keys = [jax.random.key(500 + i) for i in range(2)]
+        outs = fleet.generate(prompts, max_new_tokens=6, keys=keys,
+                              timeout=300)
+        for p, k, o in zip(prompts, keys, outs):
+            np.testing.assert_array_equal(o, _oracle(params, p, 6, k))
+        engines = fleet.summary()["engines"]
+        assert engines
+        for name, s in engines.items():
+            assert s["weights_dtype"] == "fake_quant", name
+            assert s["weight_bytes"] > 0, name
+    finally:
+        fleet.drain(timeout=120)
+
+
 def test_stalled_replica_detected_and_routed_around(params, rng):
     """The wedge path, distinct from clean death: chaos mode='stall'
     makes p1 stop heartbeating (and stepping) while its SOCKET STAYS

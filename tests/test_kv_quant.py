@@ -19,6 +19,12 @@ The contract ladder:
    sentinel set), and the capacity metrics (`bytes_per_block`,
    `pool_bytes`, `kv_pool_bytes`/`kv_bytes_per_token` in
    summary/aggregate) report the ~4x equal-bytes win int8 buys.
+4. **fp8 passthrough** — the ``fp8`` rung stores blocks as UNSCALED
+   ``float8_e4m3fn`` (narrow on scatter, upcast on gather — no scale
+   arrays at all), buying int8's exact 4x byte ratio WITHOUT the
+   per-block scale overhead; gated by the same paged-ppl delta, and
+   explicitly rejected by the pallas kernel path until a float8 tile
+   lands.
 """
 
 import jax
@@ -29,10 +35,14 @@ import pytest
 from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
 from quintnet_tpu.serve import (KVLayoutPolicy, KVPool, ServeEngine,
                                 SpecConfig, gpt2_family, make_policy)
-from quintnet_tpu.serve.kv_quant import (dequant_roundtrip_error,
+from quintnet_tpu.serve.kv_quant import (FLOAT8_DTYPE,
+                                         dequant_roundtrip_error,
                                          paged_eval_nll)
 
 CFG = GPT2Config.tiny(n_layer=2)
+
+needs_fp8 = pytest.mark.skipif(FLOAT8_DTYPE is None,
+                               reason="no float8_e4m3fn in this jax")
 
 
 @pytest.fixture(scope="module")
@@ -101,6 +111,24 @@ class TestPolicy:
         assert make_policy("int8").scaled
         assert make_policy("fake_quant").scaled
         assert isinstance(make_policy("int8"), KVLayoutPolicy)
+
+    @needs_fp8
+    def test_fp8_resolution_and_capacity(self):
+        """fp8 is UNSCALED passthrough: raw float8 dtype resolves to
+        the policy, no scale arrays, and a block costs exactly 1/4 of
+        f32's bytes (int8's data shrink without its scale tax)."""
+        pol = make_policy("fp8")
+        assert pol.name == "fp8" and not pol.scaled
+        assert make_policy(FLOAT8_DTYPE) is pol
+        kw = dict(n_layers=2, n_kv_heads=4, head_dim=8, block_size=16)
+        f32 = make_policy("f32").bytes_per_block(**kw)
+        fp8 = pol.bytes_per_block(**kw)
+        assert fp8 * 4 == f32
+        assert fp8 < make_policy("int8").bytes_per_block(**kw)
+        pool = KVPool(n_layers=2, n_kv_heads=2, head_dim=4,
+                      block_size=4, num_blocks=8, policy="fp8")
+        assert len(pool.caches()) == 2     # passthrough: no scales
+        assert pool.k.dtype == jnp.dtype(FLOAT8_DTYPE)
 
     def test_bytes_per_block_capacity_math(self):
         kw = dict(n_layers=2, n_kv_heads=4, head_dim=8, block_size=16)
@@ -359,6 +387,36 @@ class TestInt8Quality:
         assert stats["prefill"] == 1 and stats["decode"] == 1
         assert stats["verify"] <= len(eng.spec.buckets)
         eng.assert_compile_count()
+
+    @needs_fp8
+    def test_fp8_ppl_delta_gate(self, params, rng):
+        """The unscaled fp8 pool passes the same serving quality gate
+        the int8 pool does."""
+        fam = gpt2_family(CFG)
+        rows = rng.integers(0, CFG.vocab_size, (4, 24)).astype(np.int32)
+        nll32 = paged_eval_nll(fam, params, self._pool("f32"), rows)
+        nll8 = paged_eval_nll(fam, params, self._pool("fp8"), rows)
+        assert abs(nll8 - nll32) < 0.05, (
+            f"fp8 paged ppl delta too large: {nll8:.4f} vs {nll32:.4f}")
+
+    @needs_fp8
+    def test_fp8_serves_and_compile_bound_holds(self, params, rng):
+        """Mixed staggered trace on the fp8 pool: everything finishes
+        and the compile counts are exactly the f32 engine's."""
+        prompts = _prompts(rng, (3, 5, 4))
+        eng = _engine(params, "fp8")
+        outs = _serve(eng, prompts, 5, arrivals=[0, 1, 2])
+        assert all(len(o) == len(p) + 5
+                   for o, p in zip(outs, prompts))
+        assert eng.compile_stats() == {"prefill": 1, "decode": 1}
+        eng.assert_compile_count()
+
+    @needs_fp8
+    def test_fp8_pallas_rejected(self, params):
+        """The fused pallas kernels have no float8 tile yet — the
+        combination must fail loudly at build, not mis-serve."""
+        with pytest.raises(NotImplementedError, match="fp8"):
+            _engine(params, "fp8", attn_kernel="pallas")
 
 
 # ---------------------------------------------------------------------

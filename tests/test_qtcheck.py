@@ -661,6 +661,97 @@ class TestServeDtypeCensus:
 
 
 # ---------------------------------------------------------------------
+# serve programs: dtype-promotion census per WEIGHT layout policy
+# ---------------------------------------------------------------------
+
+class TestWeightDtypeCensus:
+    """The same census ladder for the packed-weight policies
+    (serve/weight_quant.py): the int8/fp8 programs dequantize inside
+    the serving matmuls (nn/layers.quantized_matmul upcasts the packed
+    operand, dots in f32, applies the per-channel scale after), so no
+    policy may introduce a half-accum dot or a silent x64 — the int8
+    storage is NOT an accumulation dtype. The collective census is
+    weight-policy-invariant too: under tp the w_scale leaves shard
+    with their columns (augment_weight_specs) and the per-column
+    multiply is rank-local, so the scaled programs carry exactly the
+    f32 census and the single-device programs stay collective-free."""
+
+    @pytest.fixture(scope="class")
+    def gpt2(self):
+        from quintnet_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+        cfg = GPT2Config.tiny(n_layer=2)
+        return cfg, gpt2_init(jax.random.key(0), cfg)
+
+    def _engine(self, cfg, params, weights_dtype, mesh=None, **kw):
+        from quintnet_tpu.serve import ServeEngine, SpecConfig, gpt2_family
+
+        kw.setdefault("max_slots", 3)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("num_blocks", 24)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("spec", SpecConfig())
+        return ServeEngine(gpt2_family(cfg), params, mesh=mesh,
+                           weights_dtype=weights_dtype, **kw)
+
+    # same program surface as the KV census — but invoked with the
+    # engine's own (policy-packed) param tree
+    _cases = TestServeDtypeCensus._cases
+
+    @pytest.mark.parametrize("weights_dtype", [
+        "f32", "bf16", "int8",
+        pytest.param("fp8", marks=pytest.mark.skipif(
+            not hasattr(jnp, "float8_e4m3fn"),
+            reason="no float8_e4m3fn in this jax")),
+        "fake_quant"])
+    def test_dtype_census_clean_every_policy(self, gpt2, weights_dtype):
+        cfg, params = gpt2
+        eng = self._engine(cfg, params, weights_dtype)
+        assert eng.weight_policy.name == weights_dtype
+        for fn, args in self._cases(eng, eng.params):
+            issues = dtype_report(fn, *args)
+            assert issues == [], (weights_dtype,
+                                  [i.detail for i in issues])
+
+    def test_int8_tp_collective_census_unchanged(self, gpt2):
+        """Packed weights add NO collectives under tp=2: the programs
+        carry exactly the f32 census (row-parallel psums per block,
+        nothing for the w_scale leaves)."""
+        cfg, params = gpt2
+        from quintnet_tpu.models.gpt2 import gpt2_to_tp_layout
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        tp_params = gpt2_to_tp_layout(params, cfg, 2)
+        eng = self._engine(cfg, tp_params, "int8", mesh=mesh)
+        specs = [census_specs.expected_serve_prefill(cfg.n_layer,
+                                                     tp_axis="tp"),
+                 census_specs.expected_serve_decode(cfg.n_layer,
+                                                    tp_axis="tp"),
+                 census_specs.expected_serve_verify(cfg.n_layer,
+                                                    tp_axis="tp")]
+        for (fn, args), spec in zip(self._cases(eng, eng.params),
+                                    specs):
+            census = collective_census(fn, *args)
+            assert census.diff(spec) == [], census.as_dict()
+
+    def test_int8_single_device_collective_free(self, gpt2):
+        cfg, params = gpt2
+        eng = self._engine(cfg, params, "int8")
+        for fn, args in self._cases(eng, eng.params):
+            assert collective_census(fn, *args).total() == 0
+
+    def test_packed_programs_keep_pool_donation(self, gpt2):
+        """Packing the weights must not disturb the donation story:
+        the KV pools still alias in place, and the packed w/w_scale
+        leaves (read-only params) are correctly NOT aliasable."""
+        cfg, params = gpt2
+        eng = self._engine(cfg, params, "int8")
+        for fn, args in self._cases(eng, eng.params):
+            rep = donation_report(fn, *args)
+            assert rep.undonated_aliasable == [], rep.summary()
+
+
+# ---------------------------------------------------------------------
 # recompile sentinel unit behaviour
 # ---------------------------------------------------------------------
 

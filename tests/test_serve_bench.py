@@ -51,6 +51,15 @@ skew exceeds the diverse side's, the routing ledger accounts exactly
 (per-expert demand sums to the routed total, drops bounded by it,
 drop rate reported for both sides), and the compile bound does not
 move (MoE adds zero programs: same prefill ladder, one decode).
+artifacts/serve_r21.json gates quantized weights
+(serve/weight_quant.py): the --weights-ab record's gates are
+structural and wall-noise-free — the int8 side's targeted-node byte
+ratio >= 3.5x (per-channel scale overhead included) with a
+paged_eval_nll quality delta under the serving gate, both sides
+finishing the identical trace — and a second record serves fp8
+weights + fp8 KV end-to-end through the default trace (the fp8 pool
+bytes/token at exactly 1/4 of f32's). CPU walls are recorded but
+never gated.
 """
 
 import json
@@ -74,6 +83,7 @@ OBS_METRIC = "serve_gpt2_tiny_obs_tokens_per_sec"
 KERNEL_METRIC = "serve_gpt2_tiny_kernel_tokens_per_sec"
 TIER_METRIC = "serve_gpt2_tiny_tier_tokens_per_sec"
 MOE_METRIC = "serve_gpt2_tiny_moe_tokens_per_sec"
+WEIGHTS_METRIC = "serve_gpt2_tiny_weights_tokens_per_sec"
 R09 = os.path.join(REPO, "artifacts", "serve_r09.json")
 R10 = os.path.join(REPO, "artifacts", "serve_r10.json")
 R11 = os.path.join(REPO, "artifacts", "serve_r11.json")
@@ -83,6 +93,7 @@ R15 = os.path.join(REPO, "artifacts", "obs_r15.json")
 R18 = os.path.join(REPO, "artifacts", "serve_r18.json")
 R19 = os.path.join(REPO, "artifacts", "serve_r19.json")
 R20 = os.path.join(REPO, "artifacts", "serve_r20.json")
+R21 = os.path.join(REPO, "artifacts", "serve_r21.json")
 
 
 @pytest.mark.fast
@@ -861,6 +872,99 @@ def test_moe_artifact_surfaces_in_staleness_scan():
     last = bench.last_known_result(metric=MOE_METRIC)
     assert last is not None
     assert last["metric"] == MOE_METRIC
+    assert last["value"] > 0
+    assert last["source"].startswith("artifacts")
+    assert last["as_of"]
+
+
+# ---------------------------------------------------------------------
+# quantized weights (serve/weight_quant.py, --weights-ab)
+# ---------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_weights_ab_smoke_cli():
+    """`serve_bench.py --weights-ab` runs the f32-vs-int8 weight A/B
+    end-to-end on CPU (tiny trace, run to completion): both engines
+    finish the identical trace, the packed side really shrinks the
+    targeted weight bytes, and the quality delta is reported."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--synthetic", "--weights-ab", "--requests", "6",
+         "--rate", "0.3", "--max-new", "4"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == WEIGHTS_METRIC
+    assert rec["rc"] == 0
+    e = rec["extras"]
+    for k in ("weight_bytes_ratio", "f32_weight_bytes",
+              "q_weight_bytes", "eval_nll_f32", "eval_nll_q",
+              "eval_nll_delta", "f32_tokens_per_sec", "f32_wall_s"):
+        assert k in e, k
+    assert e["weights_dtype"] == "int8"
+    assert e["q_weight_bytes"] < e["f32_weight_bytes"]
+    assert e["weight_bytes_ratio"] >= 3.5
+    assert e["finished"] == e["submitted"] == 6
+    assert e["f32_finished"] == 6
+
+    # --weights-dtype rides the default trace too (int8 end-to-end)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--steps", "3", "--synthetic", "--weights-dtype", "int8"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == SERVE_METRIC
+    assert rec["extras"]["weights_dtype"] == "int8"
+
+
+@pytest.mark.fast
+def test_committed_weights_artifact_meets_acceptance():
+    """The committed serve_r21.json is the quantized-weights PR's
+    acceptance evidence. The CI gates are STRUCTURAL (wall-noise
+    free, never a cross-era tok/s comparison): the int8 side's
+    targeted-node byte ratio >= 3.5x (the 3.94x raw int8 shrink minus
+    the per-channel f32 scale overhead), the paged teacher-forced NLL
+    delta under the serving quality gate, both sides finishing the
+    identical trace; and the second record serves fp8 weights + fp8
+    KV end-to-end with the pool's bytes/token at exactly 1/4 of
+    f32's 512."""
+    with open(R21) as f:
+        records = json.load(f)
+    by_metric = {r["metric"]: r for r in records}
+
+    rec = by_metric[WEIGHTS_METRIC]
+    e = rec["extras"]
+    assert e["weights_ab"] is True
+    assert e["weights_dtype"] == "int8"
+    # THE structural gate: >= 3.5x fewer bytes on the serving matmul
+    # weights (scale overhead included), quality within the gate
+    assert e["weight_bytes_ratio"] >= 3.5, (
+        f"int8 packed only {e['weight_bytes_ratio']}x")
+    assert e["q_weight_bytes"] < e["f32_weight_bytes"]
+    assert abs(e["eval_nll_delta"]) < 0.05
+    assert e["finished"] == e["submitted"] == e["requests"]
+    assert e["f32_finished"] == e["requests"]
+    assert rec["value"] > 0  # wall recorded, never gated cross-era
+
+    # fp8 end-to-end: weights AND KV pool in float8 on the default
+    # trace — the pool's per-token bytes at exactly f32/4
+    fp8 = by_metric[SERVE_METRIC]
+    fe = fp8["extras"]
+    assert fe["weights_dtype"] == "fp8"
+    assert fe["kv_dtype"] == "fp8"
+    assert fe["kv_bytes_per_token"] == 128.0
+    assert fe["finished"] == fe["submitted"] == fe["requests"]
+    assert fp8["value"] > 0
+
+
+@pytest.mark.fast
+def test_weights_artifact_surfaces_in_staleness_scan():
+    last = bench.last_known_result(metric=WEIGHTS_METRIC)
+    assert last is not None
+    assert last["metric"] == WEIGHTS_METRIC
     assert last["value"] > 0
     assert last["source"].startswith("artifacts")
     assert last["as_of"]

@@ -179,7 +179,8 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False,
                  prefill_chunk_budget=None, kv_dtype=None,
                  num_blocks=None, attn_kernel=None,
                  kv_tier_bytes: int = 0,
-                 kv_tier_promote_budget_bytes=None):
+                 kv_tier_promote_budget_bytes=None,
+                 weights_dtype=None):
     from quintnet_tpu.serve import ServeEngine, SpecConfig
 
     family, params = build_model(args, params=params)
@@ -199,6 +200,8 @@ def build_engine(args, *, prefix_cache: bool, spec: bool = False,
         eos_token_id=args.eos, temperature=args.temperature,
         policy=args.policy, prefix_cache=prefix_cache,
         kv_dtype=kv_dtype if kv_dtype is not None else args.kv_dtype,
+        weights_dtype=(weights_dtype if weights_dtype is not None
+                       else args.weights_dtype),
         attn_kernel=(attn_kernel if attn_kernel is not None
                      else args.kernel),
         spec=SpecConfig(max_draft=args.max_draft) if spec else None,
@@ -773,6 +776,90 @@ def run(args) -> dict:
             "extras": extras,
         }
 
+    if args.weights_ab:
+        # weight-quant A/B (serve/weight_quant.py) over the SAME
+        # default Poisson trace: f32 weights vs the --weights-dtype
+        # packed side, everything else equal (same init, same KV pool,
+        # same arrivals). Decode at serving batch sizes is
+        # weight-bandwidth-bound, so the committed signals are
+        # STRUCTURAL: the targeted-node byte ratio (~3.9x for int8
+        # before the per-channel-scale overhead) and the paged
+        # teacher-forced NLL delta under original vs packed params —
+        # CPU walls are recorded but never the gate (off-TPU the
+        # bandwidth saving prices emulation, not the policy).
+        import jax as _jax
+        import numpy as np
+
+        from quintnet_tpu.serve.kv_pool import KVPool
+        from quintnet_tpu.serve.kv_quant import paged_eval_nll
+        from quintnet_tpu.serve.weight_quant import (make_weight_policy,
+                                                     present_targets,
+                                                     quantize_params)
+
+        family, params = build_model(args)
+        q_name = (args.weights_dtype if args.weights_dtype != "f32"
+                  else "int8")
+        prefix_cache = args.prefix_cache == "on"
+        eng_f = build_engine(args, prefix_cache=prefix_cache,
+                             params=params, weights_dtype="f32")
+        trace = poisson_trace(args, eng_f.family.cfg.vocab_size)
+        s_f = replay(eng_f, trace, args)
+        eng_q = build_engine(args, prefix_cache=prefix_cache,
+                             params=params, weights_dtype=q_name)
+        s_q = replay(eng_q, trace, args)
+
+        # quality: the SAME held-out rows scored through a fresh f32
+        # KV pool under both param trees — the delta isolates the
+        # weight rounding (KV layout held fixed)
+        rng = np.random.default_rng(args.seed + 1)
+        rows = rng.integers(
+            0, family.cfg.vocab_size,
+            (4, min(24, family.max_positions - 1))).astype(np.int32)
+        targets = present_targets(params, family.weight_targets)
+        qparams = quantize_params(params, targets,
+                                  make_weight_policy(q_name))
+
+        def _fresh_pool():
+            return KVPool(n_layers=family.n_layers,
+                          n_kv_heads=family.n_kv_heads,
+                          head_dim=family.head_dim,
+                          block_size=args.block_size,
+                          num_blocks=args.num_blocks)
+
+        nll_f = paged_eval_nll(family, params, _fresh_pool(), rows)
+        nll_q = paged_eval_nll(family, qparams, _fresh_pool(), rows)
+
+        extras = _common_extras(args, s_q)
+        ratio = (round(eng_f.weight_bytes / eng_q.weight_bytes, 3)
+                 if eng_q.weight_bytes else 0.0)
+        extras.update({
+            "weights_ab": True,
+            "weights_dtype": q_name,
+            "f32_weight_bytes": int(eng_f.weight_bytes),
+            "q_weight_bytes": int(eng_q.weight_bytes),
+            # THE structural gate (CI-pinned): targeted-node bytes,
+            # f32 over packed — scale overhead included
+            "weight_bytes_ratio": ratio,
+            "eval_nll_f32": round(float(nll_f), 6),
+            "eval_nll_q": round(float(nll_q), 6),
+            "eval_nll_delta": round(float(nll_q - nll_f), 6),
+            "f32_tokens_per_sec": s_f["tokens_per_sec"],
+            "f32_wall_s": s_f["wall_s"],
+            "f32_finished": s_f["finished"],
+            "cpu_wall_not_gated": _jax.default_backend() != "tpu",
+        })
+        return {
+            "metric": (f"serve_{args.model}_{tag}"
+                       "_weights_tokens_per_sec"),
+            "value": s_q["tokens_per_sec"],
+            "unit": "tok/s",
+            "vs_baseline": (round(s_q["tokens_per_sec"]
+                                  / s_f["tokens_per_sec"], 3)
+                            if s_f["tokens_per_sec"] else 0.0),
+            "rc": 0,
+            "extras": extras,
+        }
+
     if args.tier_trace:
         # tiered-KV A/B (serve/kv_tier.py) over the many-tenant churn
         # trace: the SAME engine twice — host tier armed vs evict-only
@@ -1128,6 +1215,7 @@ def run(args) -> dict:
     extras["prefix_cache"] = prefix_cache
     extras["spec"] = spec
     extras["kv_dtype"] = args.kv_dtype
+    extras["weights_dtype"] = args.weights_dtype
     extras["attn_kernel"] = args.kernel
     if obs is not None:
         extras.update(_obs_summary(*obs))
@@ -1171,11 +1259,28 @@ def main():
     ap.add_argument("--prefix-cache", default="on", choices=("on", "off"),
                     help="prefix-cache A/B switch for the default trace")
     ap.add_argument("--kv-dtype", default="f32",
-                    choices=("f32", "bf16", "int8", "fake_quant"),
+                    choices=("f32", "bf16", "int8", "fp8",
+                             "fake_quant"),
                     help="KV-pool layout policy (serve/kv_quant.py): "
                          "int8 stores blocks quantized with per-block-"
                          "per-head scales, dequantized inside the "
-                         "gathered-view attention kernels")
+                         "gathered-view attention kernels; fp8 is "
+                         "unscaled float8_e4m3fn passthrough")
+    ap.add_argument("--weights-dtype", default="f32",
+                    choices=("f32", "bf16", "int8", "fp8",
+                             "fake_quant"),
+                    help="packed-weight layout policy "
+                         "(serve/weight_quant.py): int8/fp8 store the "
+                         "serving matmul weights with per-output-"
+                         "channel absmax scales, dequantized inside "
+                         "the dot (nn/layers.quantized_matmul)")
+    ap.add_argument("--weights-ab", action="store_true",
+                    help="weight-quant A/B over the default trace: "
+                         "f32 weights vs --weights-dtype (int8 unless "
+                         "set otherwise), everything else equal; the "
+                         "committed gates are the targeted-node byte "
+                         "ratio and the paged_eval_nll delta — CPU "
+                         "walls recorded, never gated")
     ap.add_argument("--kernel", default="xla",
                     choices=("xla", "pallas"),
                     help="serving attention backend "
